@@ -1,0 +1,80 @@
+"""Benchmark: PCA.fit throughput on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: the full PCA fit computation (column means + fused centered
+covariance GEMM + eigendecomposition + sign flip + explained variance) on a
+1M x 1024 float32 row matrix — the north-star shape's single-chip slice
+(BASELINE.md config 5 is 100M x 1024 on 8 chips).
+
+Data is generated on-device and timing covers the fit computation only (a
+scalar readback syncs the stream): this environment reaches the TPU through a
+~20 MB/s relay tunnel, so host->device transfer would measure the tunnel, not
+the framework. The baseline is correspondingly compute-only: a roofline
+estimate of the reference's fp64 cuBLAS DGEMM covariance + cuSolver syevd on
+a V100 (the GPU class current when the reference was written; the reference
+publishes no numbers — BASELINE.md): 2*n*d^2 / (7 TFLOP/s * 0.7) for the
+GEMM plus ~0.1 s for syevd at d=1024.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_ROWS = 1_000_000
+N_COLS = 1024
+K = 16
+
+
+def _baseline_rows_per_sec() -> float:
+    gemm_t = (2.0 * N_ROWS * N_COLS * N_COLS) / (7.0e12 * 0.7)
+    syevd_t = 0.1
+    return N_ROWS / (gemm_t + syevd_t)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.covariance import centered_gram_blocked
+    from spark_rapids_ml_tpu.ops.eigh import eigh_descending
+
+    @jax.jit
+    def fit(x):
+        mean = jnp.mean(x, axis=0)
+        cov = centered_gram_blocked(x, mean, block_rows=131_072) / (x.shape[0] - 1)
+        w, v = eigh_descending(cov)
+        w = jnp.maximum(w, 0)
+        return v[:, :K], (w / jnp.sum(w))[:K]
+
+    x = jax.random.normal(jax.random.key(7), (N_ROWS, N_COLS), dtype=jnp.float32)
+    float(jnp.sum(x[0]))  # materialize input before timing
+
+    def run_once() -> float:
+        t0 = time.perf_counter()
+        pc, ev = fit(x)
+        float(ev[0])  # sync: force the computation to complete
+        return time.perf_counter() - t0
+
+    run_once()  # warmup: compile
+    times = sorted(run_once() for _ in range(3))
+    elapsed = times[len(times) // 2]
+    rows_per_sec = N_ROWS / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "pca_fit_rows_per_sec_single_chip_1Mx1024",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / _baseline_rows_per_sec(), 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""spark_rapids_ml_tpu — a TPU-native accelerator for Spark-ML-style estimators.
+
+Built from scratch with the capabilities of the CUDA-based reference
+(pxLi/spark-rapids-ml): drop-in estimators whose numeric kernels run on TPU
+via JAX/XLA instead of cuBLAS/cuSolver via JNI.
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+  - ``feature`` / ``models``  — user-facing estimators (reference L1/L2:
+    com.nvidia.spark.ml.feature.PCA / RapidsPCA, RapidsPCA.scala)
+  - ``linalg``                — distributed linear algebra orchestration
+    (reference L3: RapidsRowMatrix.scala)
+  - ``ops``                   — the accelerated kernels as XLA computations
+    (reference L4-L6: RAPIDSML.scala -> JniRAPIDSML.java -> rapidsml_jni.cu)
+  - ``parallel``              — device-mesh sharding + collectives (the
+    reference delegates this to Spark RDD reduce/broadcast)
+  - ``utils.tracing``         — profiling ranges (reference L7: NvtxRange)
+  - ``native``                — C++ host runtime (reference: native/ JNI lib)
+"""
+
+from spark_rapids_ml_tpu.version import __version__
+
+__all__ = ["__version__"]

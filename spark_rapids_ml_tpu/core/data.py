@@ -1,0 +1,213 @@
+"""Input data handling: vectors, partitions, and a minimal DataFrame shim.
+
+The reference consumes a Spark DataFrame with a Vector column and immediately
+lowers it to ``RDD[Vector]`` (reference RapidsPCA.scala:114-116); rows may be
+dense or sparse and both must produce identical results (PCASuite.scala:155-190,
+the dense/sparse equivalence test). Partitions are the unit of data parallelism
+(RapidsRowMatrix.scala:170).
+
+Here the native representations are:
+  - ``numpy.ndarray`` (n, d)            — a single dense partition
+  - ``scipy.sparse`` matrix             — sparse rows, densified per block
+  - ``pandas.DataFrame`` + input column — column of array-likes / SparseVector
+  - ``list`` of any of the above        — explicit partitions (the RDD analogue)
+  - ``DataFrame`` shim below            — named columns over the same storage
+
+Everything funnels through :func:`as_partitions`, which yields dense row-major
+float blocks — the same contract as the reference's per-partition
+"concat rows -> row-major DenseMatrix B" step (RapidsRowMatrix.scala:183-189),
+but vectorized instead of per-row JVM loops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:  # scipy is available in the image; gate anyway for safety
+    import scipy.sparse as _sp
+except ImportError:  # pragma: no cover
+    _sp = None
+
+
+class SparseVector:
+    """Spark-ML-style sparse vector: (size, indices, values)."""
+
+    __slots__ = ("size", "indices", "values")
+
+    def __init__(self, size: int, indices: Sequence[int], values: Sequence[float]):
+        self.size = int(size)
+        self.indices = np.asarray(indices, dtype=np.int32)
+        self.values = np.asarray(values, dtype=np.float64)
+        if self.indices.shape != self.values.shape:
+            raise ValueError("indices and values must have the same length")
+
+    def toArray(self) -> np.ndarray:
+        out = np.zeros(self.size, dtype=np.float64)
+        out[self.indices] = self.values
+        return out
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __repr__(self) -> str:
+        return f"SparseVector({self.size}, {self.indices.tolist()}, {self.values.tolist()})"
+
+
+class DenseVector:
+    """Spark-ML-style dense vector (thin ndarray wrapper for API parity)."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, values: Sequence[float]):
+        self.values = np.asarray(values, dtype=np.float64)
+
+    def toArray(self) -> np.ndarray:
+        return self.values
+
+    def __len__(self) -> int:
+        return self.values.shape[0]
+
+    def __repr__(self) -> str:
+        return f"DenseVector({self.values.tolist()})"
+
+
+def Vectors_dense(*values) -> DenseVector:
+    if len(values) == 1 and isinstance(values[0], (list, tuple, np.ndarray)):
+        return DenseVector(values[0])
+    return DenseVector(values)
+
+
+def Vectors_sparse(size: int, indices, values) -> SparseVector:
+    return SparseVector(size, indices, values)
+
+
+class Vectors:
+    """Namespace matching org.apache.spark.ml.linalg.Vectors factory methods."""
+
+    dense = staticmethod(Vectors_dense)
+    sparse = staticmethod(Vectors_sparse)
+
+
+def _row_to_array(row: Any) -> np.ndarray:
+    if isinstance(row, (SparseVector, DenseVector)):
+        return row.toArray()
+    if _sp is not None and _sp.issparse(row):
+        return np.asarray(row.todense()).ravel()
+    return np.asarray(row, dtype=np.float64).ravel()
+
+
+def _block_to_dense(block: Any) -> np.ndarray:
+    """Convert one partition-like object to a dense (rows, d) float array."""
+    if isinstance(block, np.ndarray):
+        if block.ndim == 1:
+            return block[None, :].astype(np.float64, copy=False)
+        return np.ascontiguousarray(block, dtype=np.float64)
+    if _sp is not None and _sp.issparse(block):
+        return np.asarray(block.todense(), dtype=np.float64)
+    if isinstance(block, (SparseVector, DenseVector)):
+        return _row_to_array(block)[None, :]
+    # iterable of rows
+    rows = [_row_to_array(r) for r in block]
+    if not rows:
+        return np.zeros((0, 0), dtype=np.float64)
+    return np.stack(rows)
+
+
+class DataFrame:
+    """Minimal named-column frame so estimator code reads like Spark ML.
+
+    Columns are stored as-is (list/array of rows, or partition lists). A
+    pyspark adapter with the same surface lives in
+    :mod:`spark_rapids_ml_tpu.spark` (gated on pyspark availability).
+    """
+
+    def __init__(self, columns: Optional[dict] = None):
+        self._columns: dict = dict(columns or {})
+
+    @classmethod
+    def from_rows(cls, rows: Iterable[Tuple], schema: Sequence[str]) -> "DataFrame":
+        cols: dict = {name: [] for name in schema}
+        for row in rows:
+            for name, value in zip(schema, row):
+                cols[name].append(value)
+        return cls(cols)
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._columns)
+
+    def select(self, name: str):
+        if name not in self._columns:
+            raise KeyError(f"no column {name!r}; have {self.columns}")
+        return self._columns[name]
+
+    def withColumn(self, name: str, values) -> "DataFrame":
+        cols = dict(self._columns)
+        cols[name] = values
+        return DataFrame(cols)
+
+    def count(self) -> int:
+        first = next(iter(self._columns.values()))
+        return len(first)
+
+    def collect(self) -> List[tuple]:
+        names = self.columns
+        return list(zip(*(self._columns[n] for n in names)))
+
+
+def extract_column(dataset: Any, input_col: Optional[str]) -> Any:
+    """Pull the raw vector column out of whatever ``dataset`` is."""
+    if isinstance(dataset, DataFrame):
+        if input_col is None:
+            raise ValueError("inputCol must be set for DataFrame input")
+        return dataset.select(input_col)
+    try:
+        import pandas as pd
+
+        if isinstance(dataset, pd.DataFrame):
+            if input_col is not None and input_col in dataset.columns:
+                return dataset[input_col].tolist()
+            if input_col is not None:
+                raise KeyError(f"no column {input_col!r} in pandas DataFrame")
+            return dataset
+    except ImportError:  # pragma: no cover
+        pass
+    return dataset
+
+
+def as_partitions(data: Any, num_partitions: Optional[int] = None) -> List[np.ndarray]:
+    """Normalize input into a list of dense (rows_i, d) float64 partitions.
+
+    ``list``/``tuple`` of 2-D blocks is treated as pre-partitioned (the RDD
+    analogue); anything else becomes one partition, optionally re-split into
+    ``num_partitions`` roughly equal row blocks.
+    """
+    if isinstance(data, (list, tuple)) and data and _is_block(data[0]):
+        parts = [_block_to_dense(b) for b in data]
+    else:
+        parts = [_block_to_dense(data)]
+    d = parts[0].shape[1]
+    for p in parts:
+        if p.shape[1] != d:
+            raise ValueError(f"inconsistent feature dims: {p.shape[1]} vs {d}")
+    if num_partitions is not None and len(parts) == 1 and num_partitions > 1:
+        parts = [np.ascontiguousarray(b) for b in np.array_split(parts[0], num_partitions)]
+    return parts
+
+
+def _is_block(obj: Any) -> bool:
+    if isinstance(obj, np.ndarray) and obj.ndim == 2:
+        return True
+    if _sp is not None and _sp.issparse(obj):
+        return True
+    return False
+
+
+def as_matrix(data: Any) -> np.ndarray:
+    """Normalize input into one dense (n, d) float64 matrix."""
+    parts = as_partitions(data)
+    if len(parts) == 1:
+        return parts[0]
+    return np.concatenate(parts, axis=0)

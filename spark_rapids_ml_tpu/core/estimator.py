@@ -1,0 +1,50 @@
+"""Estimator / Model base classes mirroring Spark ML's abstractions.
+
+The reference's L2 layer (RapidsPCA.scala) extends Spark's
+``Estimator[Model]`` with a ``Params`` trait; ``fit`` validates the schema
+then delegates to the distributed linalg layer. Here the same shape exists
+without a JVM: ``Estimator.fit(dataset)`` -> ``Model`` (a ``Transformer``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from spark_rapids_ml_tpu.core.params import Param, Params, toString
+from spark_rapids_ml_tpu.core.persistence import MLReadable
+
+
+class HasInputCol(Params):
+    inputCol = Param("_", "inputCol", "input column name", toString)
+
+    def getInputCol(self) -> Optional[str]:
+        return self.getOrDefault(self.inputCol) if self.isDefined(self.inputCol) else None
+
+    def setInputCol(self, value: str):
+        return self.set(self.inputCol, value)
+
+
+class HasOutputCol(Params):
+    outputCol = Param("_", "outputCol", "output column name", toString)
+
+    def getOutputCol(self) -> str:
+        if self.isDefined(self.outputCol):
+            return self.getOrDefault(self.outputCol)
+        return f"{self.uid}__output"
+
+    def setOutputCol(self, value: str):
+        return self.set(self.outputCol, value)
+
+
+class Transformer(Params):
+    def transform(self, dataset: Any) -> Any:
+        raise NotImplementedError
+
+
+class Estimator(Params):
+    def fit(self, dataset: Any):
+        raise NotImplementedError
+
+
+class Model(Transformer, MLReadable):
+    """A fitted transformer; carries a parent uid via copyValues like Spark."""

@@ -1,0 +1,255 @@
+"""Model/estimator persistence, format-compatible with Spark ML.
+
+Reference (RapidsPCA.scala:207-255): ``DefaultParamsWriter.saveMetadata``
+writes ``<path>/metadata/part-00000`` — one JSON line with class, timestamp,
+sparkVersion, uid, paramMap, defaultParamMap — and the model writer puts a
+single-partition parquet of ``(pc: Matrix, explainedVariance: Vector)`` under
+``<path>/data``. SURVEY.md §3.4: the build must keep this exact on-disk
+format (including Spark's MatrixUDT/VectorUDT struct encoding), so a model
+saved here loads in upstream Spark and vice versa.
+
+Matrix UDT struct: (type: int8 [1=dense], numRows, numCols, colPtrs,
+rowIndices, values: float64[], isTransposed). Vector UDT struct:
+(type: int8 [1=dense], size, indices, values).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    _HAS_ARROW = True
+except ImportError:  # pragma: no cover
+    _HAS_ARROW = False
+
+from spark_rapids_ml_tpu.version import __version__
+
+
+def _matrix_struct(m: np.ndarray) -> dict:
+    """Encode a dense column-major matrix as Spark's MatrixUDT struct."""
+    m = np.asarray(m, dtype=np.float64)
+    return {
+        "type": 1,
+        "numRows": int(m.shape[0]),
+        "numCols": int(m.shape[1]),
+        "colPtrs": None,
+        "rowIndices": None,
+        "values": np.asfortranarray(m).ravel(order="F").tolist(),
+        "isTransposed": False,
+    }
+
+
+def _vector_struct(v: np.ndarray) -> dict:
+    v = np.asarray(v, dtype=np.float64)
+    return {"type": 1, "size": int(v.shape[0]), "indices": None, "values": v.tolist()}
+
+
+def matrix_from_struct(s: dict) -> np.ndarray:
+    values = np.asarray(s["values"], dtype=np.float64)
+    n_rows, n_cols = int(s["numRows"]), int(s["numCols"])
+    if s.get("isTransposed"):
+        return values.reshape(n_rows, n_cols)  # row-major storage
+    return values.reshape(n_cols, n_rows).T  # column-major storage
+
+
+def vector_from_struct(s: dict) -> np.ndarray:
+    if s["type"] == 0:  # sparse
+        out = np.zeros(int(s["size"]), dtype=np.float64)
+        out[np.asarray(s["indices"], dtype=np.int64)] = np.asarray(s["values"])
+        return out
+    return np.asarray(s["values"], dtype=np.float64)
+
+
+_MATRIX_TYPE = None
+_VECTOR_TYPE = None
+if _HAS_ARROW:
+    _MATRIX_TYPE = pa.struct(
+        [
+            ("type", pa.int8()),
+            ("numRows", pa.int32()),
+            ("numCols", pa.int32()),
+            ("colPtrs", pa.list_(pa.int32())),
+            ("rowIndices", pa.list_(pa.int32())),
+            ("values", pa.list_(pa.float64())),
+            ("isTransposed", pa.bool_()),
+        ]
+    )
+    _VECTOR_TYPE = pa.struct(
+        [
+            ("type", pa.int8()),
+            ("size", pa.int32()),
+            ("indices", pa.list_(pa.int32())),
+            ("values", pa.list_(pa.float64())),
+        ]
+    )
+
+
+def save_metadata(
+    instance,
+    path: str,
+    extra_metadata: Optional[Dict[str, Any]] = None,
+    class_name: Optional[str] = None,
+) -> None:
+    """DefaultParamsWriter.saveMetadata equivalent (RapidsPCA.scala:221)."""
+    meta_dir = os.path.join(path, "metadata")
+    os.makedirs(meta_dir, exist_ok=True)
+    param_map = {p.name: v for p, v in instance._paramMap.items()}
+    default_map = {p.name: v for p, v in instance._defaultParamMap.items()}
+    metadata = {
+        "class": class_name or f"{type(instance).__module__}.{type(instance).__name__}",
+        "timestamp": int(time.time() * 1000),
+        "sparkVersion": f"spark-rapids-ml-tpu/{__version__}",
+        "uid": instance.uid,
+        "paramMap": param_map,
+        "defaultParamMap": default_map,
+    }
+    if extra_metadata:
+        metadata.update(extra_metadata)
+    with open(os.path.join(meta_dir, "part-00000"), "w") as f:
+        f.write(json.dumps(metadata, separators=(",", ":")) + "\n")
+    open(os.path.join(meta_dir, "_SUCCESS"), "w").close()
+
+
+def load_metadata(path: str, expected_class: Optional[str] = None) -> Dict[str, Any]:
+    """DefaultParamsReader.loadMetadata equivalent (RapidsPCA.scala:243)."""
+    parts = sorted(glob.glob(os.path.join(path, "metadata", "part-*")))
+    if not parts:
+        raise FileNotFoundError(f"no metadata under {path}")
+    with open(parts[0]) as f:
+        metadata = json.loads(f.readline())
+    if expected_class is not None:
+        cls = metadata.get("class", "")
+        # Accept both our class path and the reference's JVM class path.
+        if not (cls.endswith(expected_class) or expected_class.endswith(cls.rsplit(".", 1)[-1])):
+            raise ValueError(f"metadata class {cls!r} != expected {expected_class!r}")
+    return metadata
+
+
+def get_and_set_params(instance, metadata: Dict[str, Any]) -> None:
+    """metadata.getAndSetParams equivalent (RapidsPCA.scala:251)."""
+    for name, value in metadata.get("defaultParamMap", {}).items():
+        if instance.hasParam(name):
+            param = instance.getParam(name)
+            instance._defaultParamMap[param] = param.type_converter(value)
+    for name, value in metadata.get("paramMap", {}).items():
+        if instance.hasParam(name):
+            instance.set(instance.getParam(name), value)
+
+
+def save_data(path: str, columns: Dict[str, tuple]) -> None:
+    """Write ``<path>/data`` as one-row single-partition parquet.
+
+    ``columns`` maps name -> ("matrix"|"vector"|"scalar", value). Mirrors the
+    reference's ``Seq(Data(pc, explainedVariance)).toDF.repartition(1)
+    .write.parquet`` (RapidsPCA.scala:222-224). Falls back to .npz if pyarrow
+    is unavailable.
+    """
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    if _HAS_ARROW:
+        fields, arrays = [], []
+        for name, (kind, value) in columns.items():
+            if kind == "matrix":
+                fields.append((name, _MATRIX_TYPE))
+                arrays.append(pa.array([_matrix_struct(value)], type=_MATRIX_TYPE))
+            elif kind == "vector":
+                fields.append((name, _VECTOR_TYPE))
+                arrays.append(pa.array([_vector_struct(value)], type=_VECTOR_TYPE))
+            else:
+                arr = pa.array([value])
+                fields.append((name, arr.type))
+                arrays.append(arr)
+        table = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+        pq.write_table(table, os.path.join(data_dir, "part-00000.parquet"))
+        open(os.path.join(data_dir, "_SUCCESS"), "w").close()
+    else:  # pragma: no cover
+        np.savez(
+            os.path.join(data_dir, "part-00000.npz"),
+            **{name: np.asarray(value) for name, (kind, value) in columns.items()},
+        )
+
+
+def load_data(path: str) -> Dict[str, Any]:
+    """Read ``<path>/data`` back into {name: decoded value}."""
+    data_dir = os.path.join(path, "data")
+    parquets = sorted(glob.glob(os.path.join(data_dir, "*.parquet"))) or sorted(
+        glob.glob(os.path.join(data_dir, "part-*"))
+    )
+    parquets = [p for p in parquets if not p.endswith("_SUCCESS")]
+    if parquets and _HAS_ARROW:
+        table = pq.read_table(parquets[0])
+        row = table.to_pylist()[0]
+        out: Dict[str, Any] = {}
+        for name, value in row.items():
+            if isinstance(value, dict) and "numRows" in value:
+                out[name] = matrix_from_struct(value)
+            elif isinstance(value, dict) and "size" in value:
+                out[name] = vector_from_struct(value)
+            else:
+                out[name] = value
+        return out
+    npzs = sorted(glob.glob(os.path.join(data_dir, "*.npz")))  # pragma: no cover
+    if npzs:  # pragma: no cover
+        with np.load(npzs[0]) as z:
+            return {k: z[k] for k in z.files}
+    raise FileNotFoundError(f"no data files under {data_dir}")
+
+
+class MLWriter:
+    """Spark-style ``model.write.overwrite().save(path)`` chain."""
+
+    def __init__(self, instance):
+        self._instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "MLWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        if os.path.exists(path):
+            if not self._overwrite:
+                raise FileExistsError(f"{path} exists; use .overwrite()")
+            import shutil
+
+            shutil.rmtree(path)
+        self._instance._save_impl(path)
+
+
+class MLReadable:
+    """Mixin granting ``.write`` / ``.save`` / ``.load`` (DefaultParamsReadable)."""
+
+    @property
+    def write(self) -> MLWriter:
+        return MLWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write.save(path)
+
+    def _save_impl(self, path: str) -> None:
+        save_metadata(self, path)
+
+    @classmethod
+    def load(cls: Type, path: str):
+        return cls._load_impl(path)
+
+    @classmethod
+    def _load_impl(cls: Type, path: str):
+        metadata = load_metadata(path, expected_class=cls.__name__)
+        instance = cls()
+        instance.uid = metadata["uid"]
+        # Params were bound to the old uid prefix string only cosmetically;
+        # rebind parents for repr parity.
+        for param in instance._params.values():
+            param.parent = instance.uid
+        get_and_set_params(instance, metadata)
+        return instance

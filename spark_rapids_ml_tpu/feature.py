@@ -1,0 +1,10 @@
+"""User-facing feature namespace — parity with ``com.nvidia.spark.ml.feature``.
+
+The reference's public class is a thin rename of the internal estimator
+(PCA.scala:17-31, the "split-package trick" SURVEY.md §1 says to preserve):
+the real implementation lives one package in, the public name is stable.
+"""
+
+from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+
+__all__ = ["PCA", "PCAModel"]

@@ -1,0 +1,3 @@
+from spark_rapids_ml_tpu.linalg.row_matrix import RowMatrix
+
+__all__ = ["RowMatrix"]

@@ -1,0 +1,23 @@
+"""Accelerated kernels as XLA computations.
+
+This package replaces the reference's entire native layer
+(native/src/rapidsml_jni.cu: cublasDspr / cublasDgemm / raft eigDC+signFlip)
+with jitted JAX/XLA functions. Per-call cudaMalloc/memcpy disappears: jit
+compiles once per shape and XLA manages HBM buffers.
+"""
+
+from spark_rapids_ml_tpu.ops.linalg import gemm_syrk, gemm_project, spr, triu_to_full
+from spark_rapids_ml_tpu.ops.eigh import eigh_descending, sign_flip, cal_svd
+from spark_rapids_ml_tpu.ops.covariance import covariance, mean_and_covariance
+
+__all__ = [
+    "gemm_syrk",
+    "gemm_project",
+    "spr",
+    "triu_to_full",
+    "eigh_descending",
+    "sign_flip",
+    "cal_svd",
+    "covariance",
+    "mean_and_covariance",
+]

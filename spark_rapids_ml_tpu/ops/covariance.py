@@ -1,0 +1,136 @@
+"""Covariance kernels — fused center+scale+GEMM as single XLA executables.
+
+Reference pipeline (RapidsRowMatrix.scala:149-257): per-row JVM centering
+(:176-182, HOT LOOP 1), concat to row-major B (:183-189), JNI dgemm C=BᵀB
+(:195), Spark reduce of n×n partials (:201). SURVEY.md §7 flags the per-row
+JVM centering as the thing that belongs *inside* the compiled program on TPU —
+here centering, scaling and the rank-k update are one jitted computation that
+XLA fuses; there is no host-side row loop at all.
+
+Normalization: the reference GEMM path scales by 1/√(numCols−1) while the spr
+path divides by numRows−1 (RapidsRowMatrix.scala:169 vs :240-246) — a quirk
+SURVEY.md §7 says to fix, not copy. Both paths here normalize by (n_rows − 1).
+PCA outputs are invariant to the scalar, so the test oracle is unaffected.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.ops.linalg import _dot_precision, _triu_indices_packed
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def centered_gram(x: jax.Array, mean: jax.Array, precision: str = "highest") -> jax.Array:
+    """(x − mean)ᵀ(x − mean) — the per-partition covariance partial.
+
+    This is the distributed unit of work: each data shard computes its local
+    centered Gram against the *global* mean (broadcast, like
+    RapidsRowMatrix.scala:162), and partials are summed by a collective.
+    """
+    b = x - mean
+    return jnp.matmul(b.T, b, precision=_dot_precision(precision))
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def mean_and_covariance(x: jax.Array, precision: str = "highest"):
+    """Single-device fused path: returns (column means, covariance).
+
+    Covariance normalized by (n − 1), matching the spr/treeAggregate path
+    (RapidsRowMatrix.scala:240-246) — the statistically correct sample
+    covariance.
+    """
+    n = x.shape[0]
+    mean = jnp.mean(x, axis=0)
+    cov = centered_gram(x, mean, precision=precision) / (n - 1)
+    return mean, cov
+
+
+def covariance(x: jax.Array, precision: str = "highest") -> jax.Array:
+    return mean_and_covariance(x, precision=precision)[1]
+
+
+@partial(jax.jit, static_argnames=("block_rows", "precision"))
+def centered_gram_blocked(
+    x: jax.Array, mean: jax.Array, block_rows: int = 4096, precision: str = "highest"
+) -> jax.Array:
+    """Streaming centered Gram over row blocks via lax.scan.
+
+    For row counts whose (n, d) activation would not fit HBM alongside the
+    result, accumulate BᵀB block-by-block. Padding rows are filled with
+    ``mean`` so their centered contribution is exactly zero — no masking
+    needed inside the scan body, keeping the MXU matmul dense and static.
+    """
+    n, d = x.shape
+    nb = -(-n // block_rows)
+    pad = nb * block_rows - n
+    x = jnp.concatenate([x, jnp.broadcast_to(mean, (pad, d))], axis=0) if pad else x
+    blocks = x.reshape(nb, block_rows, d)
+    prec = _dot_precision(precision)
+
+    def body(acc, blk):
+        b = blk - mean
+        return acc + jnp.matmul(b.T, b, precision=prec), None
+
+    acc0 = jnp.zeros((d, d), dtype=x.dtype)
+    acc, _ = jax.lax.scan(body, acc0, blocks)
+    return acc
+
+
+@jax.jit
+def centered_gram_packed(x: jax.Array, mean: jax.Array) -> jax.Array:
+    """Packed-upper-triangular centered Gram — the spr/treeAggregate path.
+
+    Surface parity with the reference's packed accumulation
+    (RapidsRowMatrix.scala:207-233, layout of cublasDspr FILL_MODE_UPPER).
+    Computed as a full Gram then packed: on TPU a dense MXU matmul beats
+    n_rows sequential rank-1 updates by orders of magnitude, so the packed
+    layout is kept only as the aggregation/wire format (n ≤ 65535 constraint
+    inherited from the layout, RapidsRowMatrix.scala:66-68).
+    """
+    full = centered_gram(x, mean)
+    rows, cols = _triu_indices_packed(x.shape[1])
+    return full[rows, cols]
+
+
+def welford_init(d: int, dtype=jnp.float64) -> tuple:
+    """(count, mean, M2) accumulator for streaming column stats.
+
+    The reference's mean pass is mllib ``Statistics.colStats``
+    (RapidsRowMatrix.scala:156), a Welford-style treeAggregate. These three
+    functions reproduce that contract for partitioned/distributed input.
+    """
+    return (
+        jnp.zeros((), dtype=dtype),
+        jnp.zeros((d,), dtype=dtype),
+        jnp.zeros((d,), dtype=dtype),
+    )
+
+
+@jax.jit
+def welford_add_block(state: tuple, x: jax.Array) -> tuple:
+    count, mean, m2 = state
+    n_b = x.shape[0]
+    mean_b = jnp.mean(x, axis=0)
+    m2_b = jnp.sum((x - mean_b) ** 2, axis=0)
+    new_count = count + n_b
+    delta = mean_b - mean
+    new_mean = mean + delta * (n_b / new_count)
+    new_m2 = m2 + m2_b + delta**2 * (count * n_b / new_count)
+    return (new_count, new_mean, new_m2)
+
+
+@jax.jit
+def welford_merge(a: tuple, b: tuple) -> tuple:
+    count_a, mean_a, m2_a = a
+    count_b, mean_b, m2_b = b
+    count = count_a + count_b
+    safe = jnp.maximum(count, 1)
+    delta = mean_b - mean_a
+    mean = mean_a + delta * (count_b / safe)
+    m2 = m2_a + m2_b + delta**2 * (count_a * count_b / safe)
+    return (count, mean, m2)

@@ -1,0 +1,60 @@
+"""Symmetric eigendecomposition + SVD-from-covariance, in XLA.
+
+Replaces the reference's ``calSVD`` JNI export (rapidsml_jni.cu:302-356):
+raft::linalg::eigDC (cuSolver syevd) -> colReverse/rowReverse (descending
+order) -> seqRoot (sqrt eigenvalues -> singular values) -> deterministic
+signFlip (thrust device lambda, rapidsml_jni.cu:37-64).
+
+On TPU, ``jnp.linalg.eigh`` lowers to XLA's self-adjoint eigensolver (a
+QDWH/Jacobi family algorithm — the cyclic-Jacobi approach cited in SURVEY.md
+§7); the reverse/sqrt/sign-flip postprocessing ops fuse into the same
+executable instead of being separate RAFT kernel launches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def sign_flip(u: jax.Array) -> jax.Array:
+    """Deterministic per-column sign convention.
+
+    For each column, if the element with the largest |value| is negative,
+    negate the column — exactly the reference's thrust ``signFlip`` device
+    lambda (rapidsml_jni.cu:37-64). ``argmax`` ties resolve to the first
+    index, matching the sequential scan in the reference's for-loop.
+    """
+    idx = jnp.argmax(jnp.abs(u), axis=0)
+    pivot = u[idx, jnp.arange(u.shape[1])]
+    signs = jnp.where(pivot < 0, -1.0, 1.0).astype(u.dtype)
+    return u * signs[None, :]
+
+
+@jax.jit
+def eigh_descending(a: jax.Array):
+    """Eigendecomposition of symmetric ``a`` with eigenvalues descending.
+
+    Returns ``(eigenvalues, eigenvectors)`` with columns sign-flipped
+    deterministically. Covers eigDC + colReverse + rowReverse + signFlip
+    (rapidsml_jni.cu:338-343).
+    """
+    w, v = jnp.linalg.eigh(a)  # ascending
+    w = w[::-1]
+    v = v[:, ::-1]
+    return w, sign_flip(v)
+
+
+@jax.jit
+def cal_svd(a: jax.Array):
+    """SVD of a symmetric PSD matrix via eigendecomposition.
+
+    Returns ``(u, s)`` with singular values ``s = sqrt(max(eigenvalues, 0))``
+    descending — the reference's full ``calSVD`` contract
+    (rapidsml_jni.cu:302-356, seqRoot at :341). Negative eigenvalues (tiny
+    numerical noise on a PSD input) clamp to zero rather than produce NaN.
+    """
+    w, v = eigh_descending(a)
+    s = jnp.sqrt(jnp.maximum(w, 0))
+    return v, s

@@ -1,0 +1,95 @@
+"""Core GEMM ops — the XLA equivalents of the reference's cuBLAS calls.
+
+Reference surface (RAPIDSML.scala:40-71 -> JniRAPIDSML.java:64-69 ->
+rapidsml_jni.cu):
+  - ``dgemm``   C = BᵀB       (rapidsml_jni.cu:159-222, cublasDgemm OP_N/OP_T)
+  - ``dgemm_b`` C = AᵀB       (rapidsml_jni.cu:224-300) — batch projection
+  - ``dspr``    packed rank-1 (rapidsml_jni.cu:94-157, cublasDspr; dead on the
+                 reference's main path, see SURVEY.md §3.2 — implemented here
+                 for surface parity AND used by the native CPU fallback)
+  - ``triuToFull`` packed-upper -> full symmetric (RapidsRowMatrix.scala:265-287)
+
+TPU numerics: the MXU natively multiplies bf16 with fp32 accumulation.
+``precision=HIGHEST`` runs the 3/6-pass bf16 decomposition giving ~fp32 product
+precision; fp64 (the reference's ``double[]`` surface) has no TPU hardware
+path, so fp64 inputs are computed via double-float ("double-double") emulation
+(see :mod:`spark_rapids_ml_tpu.ops.doubledouble`) when requested, else fp32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _dot_precision(precision: str):
+    return {
+        "default": jax.lax.Precision.DEFAULT,
+        "high": jax.lax.Precision.HIGH,
+        "highest": jax.lax.Precision.HIGHEST,
+    }[precision]
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def gemm_syrk(b: jax.Array, precision: str = "highest") -> jax.Array:
+    """C = BᵀB for row-major B (rows, cols) -> (cols, cols).
+
+    Replaces JNI ``dgemm`` (rapidsml_jni.cu:190-197): the reference feeds
+    row-major B as column-major A=Bᵀ into cublasDgemm(OP_N, OP_T). Here it is
+    a single dot_general that XLA tiles directly onto the MXU.
+    """
+    return jnp.matmul(b.T, b, precision=_dot_precision(precision))
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def gemm_project(a: jax.Array, b: jax.Array, precision: str = "highest") -> jax.Array:
+    """C = AᵀB — the batched projection kernel.
+
+    Replaces JNI ``dgemm_b`` (rapidsml_jni.cu:269-276). In the reference the
+    consumer (GPU batch transform) is disabled as too slow
+    (RapidsPCA.scala:172-185); here it is the live transform path.
+    """
+    return jnp.matmul(a.T, b, precision=_dot_precision(precision))
+
+
+@jax.jit
+def spr(x: jax.Array, packed: jax.Array) -> jax.Array:
+    """Packed upper-triangular (column-major, BLAS 'U') rank-1 update.
+
+    A_packed += x xᵀ, only the upper triangle stored: element (i, j), i <= j,
+    lives at ``j*(j+1)/2 + i`` — the same layout as cublasDspr FILL_MODE_UPPER
+    (rapidsml_jni.cu:133-136) and Spark's BLAS.spr, so the treeAggregate path
+    (RapidsRowMatrix.scala:208-233) is reproducible bit-for-layout.
+    """
+    n = x.shape[0]
+    outer = jnp.outer(x, x)
+    iu = _triu_indices_packed(n)
+    return packed + outer[iu[0], iu[1]]
+
+
+def _triu_indices_packed(n: int):
+    """(row, col) indices ordered by the packed-upper column-major layout."""
+    cols = np.concatenate([np.full(j + 1, j) for j in range(n)])
+    rows = np.concatenate([np.arange(j + 1) for j in range(n)])
+    return rows, cols
+
+
+@jax.jit
+def triu_to_full(packed: jax.Array) -> jax.Array:
+    """Packed upper-triangular -> full symmetric matrix.
+
+    Replaces ``RapidsRowMatrix.triuToFull`` (RapidsRowMatrix.scala:265-287).
+    n is recovered from nt = n(n+1)/2.
+    """
+    nt = packed.shape[0]
+    n = int((np.sqrt(8 * nt + 1) - 1) / 2)
+    if n * (n + 1) // 2 != nt:
+        raise ValueError(f"packed length {nt} is not triangular")
+    rows, cols = _triu_indices_packed(n)
+    full = jnp.zeros((n, n), dtype=packed.dtype)
+    full = full.at[rows, cols].set(packed)
+    off_diag = jnp.where(jnp.arange(n)[:, None] < jnp.arange(n)[None, :], full, 0.0)
+    return full + off_diag.T

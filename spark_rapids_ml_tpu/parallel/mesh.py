@@ -1,0 +1,79 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference delegates all parallelism to Spark: RDD partitions are the data-
+parallel unit and driver-side reduce/broadcast the communication backend
+(SURVEY.md §2 checklist). TPU-native, the equivalent fabric is a
+``jax.sharding.Mesh`` over the slice's chips: the ``data`` axis replaces RDD
+row-partitioning, the ``model`` axis shards the feature dimension (the
+reference's scaling axis, SURVEY.md §5 "long-context"), and XLA collectives
+over ICI (psum / reduce_scatter / all_gather) replace Spark's
+``reduce``/``treeAggregate``/``broadcast`` (RapidsRowMatrix.scala:162-234).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    axis_names: Tuple[str, str] = (DATA_AXIS, MODEL_AXIS),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 2-D (data × model) mesh over the available devices.
+
+    Default: all devices on the data axis (pure DP — the reference's only
+    parallelism), model axis 1. Pass ``shape=(dp, mp)`` to also shard the
+    feature dimension.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = (n, 1)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    device = device or jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape(1, 1), (DATA_AXIS, MODEL_AXIS))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows over the data axis, features over the model axis."""
+    return NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(x, mesh: Mesh, pad_value: float = 0.0):
+    """Place a host (n, d) array onto the mesh row-sharded, padding n up to a
+    multiple of the data axis (and d up to the model axis).
+
+    Returns ``(x_sharded, row_mask_sharded, n_true_rows)``; the mask weights
+    padded rows to zero inside the compiled computations.
+    """
+    x = np.asarray(x)
+    n, d = x.shape
+    dp = mesh.shape[DATA_AXIS]
+    mp = mesh.shape[MODEL_AXIS]
+    n_pad = (-n) % dp
+    d_pad = (-d) % mp
+    if n_pad or d_pad:
+        x = np.pad(x, ((0, n_pad), (0, d_pad)), constant_values=pad_value)
+    mask = np.zeros(n + n_pad, dtype=x.dtype)
+    mask[:n] = 1.0
+    xs = jax.device_put(x, row_sharding(mesh))
+    ms = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
+    return xs, ms, n

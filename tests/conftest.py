@@ -1,0 +1,77 @@
+"""Test harness configuration.
+
+The reference's tests run on local[*] Spark with 2 RDD partitions standing in
+for "distributed" (SURVEY.md §4). Here the analogue is a virtual 8-device CPU
+mesh (xla_force_host_platform_device_count), which exercises the real sharded
+code path — psum/all_gather collectives included — without TPU hardware, plus
+x64 so the fp64 oracle tolerance (absTol 1e-5, PCASuite.scala:71) is
+meaningful.
+"""
+
+import os
+
+# Force the CPU platform for tests (the env may pre-select a TPU platform);
+# set SPARK_TPU_ML_TEST_PLATFORM to override, e.g. to run the suite on-chip.
+_platform = os.environ.get("SPARK_TPU_ML_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# jax may already be imported by interpreter-level site customization that
+# captured the original JAX_PLATFORMS env; override via config as well.
+jax.config.update("jax_platforms", _platform)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+# The 3x5 synthetic dataset from the reference suite (PCASuite.scala:42-46):
+# one all-zero sparse row, one sparse row, one dense row.
+REFERENCE_DATA = [
+    ("sparse_zero", 5, [], []),
+    ("sparse", 5, [1, 3], [1.0, 7.0]),
+    ("dense", [2.0, 0.0, 3.0, 4.0, 5.0], None, None),
+]
+
+
+@pytest.fixture
+def reference_rows():
+    from spark_rapids_ml_tpu.core.data import Vectors
+
+    return [
+        Vectors.sparse(5, [], []),
+        Vectors.sparse(5, [1, 3], [1.0, 7.0]),
+        Vectors.dense(2.0, 0.0, 3.0, 4.0, 5.0),
+    ]
+
+
+def numpy_pca_oracle(x: np.ndarray, k: int):
+    """CPU ground truth — the Spark mllib RowMatrix oracle analogue
+    (PCASuite.scala:50-52): eigendecomposition of the sample covariance.
+    """
+    x = np.asarray(x, dtype=np.float64)
+    n = x.shape[0]
+    mean = x.mean(axis=0)
+    b = x - mean
+    cov = b.T @ b / (n - 1)
+    # SVD of the symmetric PSD covariance (LAPACK, like breeze brzSvd in the
+    # mllib oracle): singular values are its eigenvalues, descending. Using
+    # LAPACK SVD on both sides keeps rank-deficient cases (null-space basis
+    # is arbitrary) comparable — same reason the reference suite passes.
+    v, w, _ = np.linalg.svd(cov)
+    # deterministic sign flip: largest-|.| element of each column positive
+    idx = np.argmax(np.abs(v), axis=0)
+    signs = np.where(v[idx, np.arange(v.shape[1])] < 0, -1.0, 1.0)
+    v = v * signs
+    total = np.clip(w, 0, None).sum()
+    explained = np.clip(w, 0, None) / total if total > 0 else w
+    return v[:, :k], explained[:k]

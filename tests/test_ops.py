@@ -1,0 +1,154 @@
+"""Unit tests for the ops layer — the XLA replacements for the reference's
+JNI kernels (rapidsml_jni.cu), each checked against a numpy oracle."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.ops import (
+    cal_svd,
+    covariance,
+    eigh_descending,
+    gemm_project,
+    gemm_syrk,
+    mean_and_covariance,
+    sign_flip,
+    spr,
+    triu_to_full,
+)
+from spark_rapids_ml_tpu.ops.covariance import (
+    centered_gram,
+    centered_gram_blocked,
+    centered_gram_packed,
+    welford_add_block,
+    welford_init,
+    welford_merge,
+)
+
+
+class TestGemm:
+    def test_syrk(self, rng):
+        b = rng.normal(size=(50, 8))
+        np.testing.assert_allclose(gemm_syrk(b), b.T @ b, atol=1e-10)
+
+    def test_project(self, rng):
+        a = rng.normal(size=(8, 50))
+        b = rng.normal(size=(8, 3))
+        np.testing.assert_allclose(gemm_project(a, b), a.T @ b, atol=1e-10)
+
+
+class TestPacked:
+    def test_spr_matches_blas_layout(self, rng):
+        """Packed upper, column-major — cublasDspr/Spark BLAS.spr layout."""
+        n = 5
+        x = rng.normal(size=(n,))
+        packed = np.zeros(n * (n + 1) // 2)
+        result = np.asarray(spr(x, packed))
+        outer = np.outer(x, x)
+        expected = np.concatenate([outer[: j + 1, j] for j in range(n)])
+        np.testing.assert_allclose(result, expected, atol=1e-12)
+
+    def test_triu_to_full_roundtrip(self, rng):
+        a = rng.normal(size=(6, 6))
+        sym = a + a.T
+        packed = np.concatenate([sym[: j + 1, j] for j in range(6)])
+        np.testing.assert_allclose(triu_to_full(packed), sym, atol=1e-12)
+
+    def test_triu_to_full_rejects_bad_length(self):
+        with pytest.raises(ValueError):
+            triu_to_full(np.zeros(7))
+
+
+class TestEigh:
+    def test_sign_flip(self):
+        u = np.array([[0.9, -0.2], [-0.1, -0.8]])
+        flipped = np.asarray(sign_flip(u))
+        # col 0: max-|.| elem is 0.9 (positive) -> unchanged
+        np.testing.assert_allclose(flipped[:, 0], u[:, 0])
+        # col 1: max-|.| elem is -0.8 (negative) -> negated
+        np.testing.assert_allclose(flipped[:, 1], -u[:, 1])
+
+    def test_sign_flip_idempotent(self, rng):
+        u = rng.normal(size=(10, 10))
+        once = np.asarray(sign_flip(u))
+        twice = np.asarray(sign_flip(once))
+        np.testing.assert_allclose(once, twice)
+
+    def test_eigh_descending(self, rng):
+        a = rng.normal(size=(12, 12))
+        sym = a @ a.T
+        w, v = eigh_descending(sym)
+        w, v = np.asarray(w), np.asarray(v)
+        assert np.all(np.diff(w) <= 1e-9)  # descending
+        np.testing.assert_allclose(sym @ v, v * w, atol=1e-8)
+
+    def test_cal_svd_psd(self, rng):
+        """Full calSVD contract: U orthonormal, s = sqrt(eigvals) descending."""
+        a = rng.normal(size=(15, 15))
+        cov = a @ a.T / 15
+        u, s = cal_svd(cov)
+        u, s = np.asarray(u), np.asarray(s)
+        expected_s = np.sqrt(np.sort(np.linalg.eigvalsh(cov))[::-1])
+        np.testing.assert_allclose(s, expected_s, atol=1e-8)
+        np.testing.assert_allclose(u.T @ u, np.eye(15), atol=1e-8)
+
+    def test_cal_svd_clamps_negative_eigs(self):
+        """Near-singular PSD input must not produce NaN singular values."""
+        cov = np.outer([1.0, 1.0], [1.0, 1.0])  # rank-1, eigvals {2, 0±eps}
+        _, s = cal_svd(cov)
+        assert not np.any(np.isnan(np.asarray(s)))
+
+
+class TestCovariance:
+    def test_mean_and_covariance(self, rng):
+        x = rng.normal(size=(100, 10))
+        mean, cov = mean_and_covariance(x)
+        np.testing.assert_allclose(mean, x.mean(axis=0), atol=1e-10)
+        np.testing.assert_allclose(cov, np.cov(x, rowvar=False), atol=1e-10)
+
+    def test_covariance_normalization_is_n_minus_1(self, rng):
+        """Both paths normalize by (n-1) — the reference GEMM path's
+        1/sqrt(numCols-1) mis-scaling (RapidsRowMatrix.scala:169) is fixed."""
+        x = rng.normal(size=(40, 6))
+        np.testing.assert_allclose(covariance(x), np.cov(x, rowvar=False), atol=1e-10)
+
+    def test_blocked_matches_dense(self, rng):
+        x = rng.normal(size=(1000, 16))
+        mean = x.mean(axis=0)
+        dense = centered_gram(x, mean)
+        blocked = centered_gram_blocked(x, mean, block_rows=128)
+        np.testing.assert_allclose(blocked, dense, atol=1e-8)
+
+    def test_blocked_padding_is_exact_zero_contribution(self, rng):
+        """n not a multiple of block_rows: mean-padding adds nothing."""
+        x = rng.normal(size=(130, 4))
+        mean = x.mean(axis=0)
+        np.testing.assert_allclose(
+            centered_gram_blocked(x, mean, block_rows=64),
+            centered_gram(x, mean),
+            atol=1e-10,
+        )
+
+    def test_packed_matches_dense(self, rng):
+        x = rng.normal(size=(30, 5))
+        mean = x.mean(axis=0)
+        full = np.asarray(centered_gram(x, mean))
+        packed = np.asarray(centered_gram_packed(x, mean))
+        expected = np.concatenate([full[: j + 1, j] for j in range(5)])
+        np.testing.assert_allclose(packed, expected, atol=1e-10)
+
+    def test_welford_streaming_mean(self, rng):
+        x = rng.normal(size=(500, 8)) * 3 + 7
+        state = welford_init(8)
+        for blk in np.array_split(x, 7):
+            state = welford_add_block(state, blk)
+        count, mean, m2 = state
+        assert int(count) == 500
+        np.testing.assert_allclose(mean, x.mean(axis=0), atol=1e-10)
+        np.testing.assert_allclose(m2 / (500 - 1), x.var(axis=0, ddof=1), atol=1e-9)
+
+    def test_welford_merge_associative(self, rng):
+        x = rng.normal(size=(100, 4))
+        a = welford_add_block(welford_init(4), x[:30])
+        b = welford_add_block(welford_init(4), x[30:])
+        merged = welford_merge(a, b)
+        np.testing.assert_allclose(merged[1], x.mean(axis=0), atol=1e-10)
