@@ -171,7 +171,9 @@ def extract_column(dataset: Any, input_col: Optional[str]) -> Any:
                 return dataset[input_col].tolist()
             if input_col is not None:
                 raise KeyError(f"no column {input_col!r} in pandas DataFrame")
-            return dataset
+            # No input column: treat the frame itself as the feature matrix
+            # (iterating a DataFrame would yield column labels, not rows).
+            return dataset.to_numpy(dtype=np.float64)
     except ImportError:  # pragma: no cover
         pass
     return dataset

@@ -13,6 +13,7 @@ DefaultParamsWriter-style persistence in :mod:`spark_rapids_ml_tpu.core.persiste
 
 from __future__ import annotations
 
+import numbers
 import threading
 import uuid
 from typing import Any, Callable, Dict, Iterator, List, Optional
@@ -52,15 +53,17 @@ class Param:
 
 
 def toInt(value: Any) -> int:
-    if isinstance(value, bool) or not isinstance(value, (int, float)):
+    """Accepts any Integral (incl. numpy ints) and integral floats, like
+    pyspark's TypeConverters.toInt."""
+    if isinstance(value, bool) or not isinstance(value, (numbers.Integral, numbers.Real)):
         raise TypeError(f"Could not convert {value!r} to int")
-    if isinstance(value, float) and not value.is_integer():
+    if not isinstance(value, numbers.Integral) and not float(value).is_integer():
         raise TypeError(f"Could not convert non-integral {value!r} to int")
     return int(value)
 
 
 def toFloat(value: Any) -> float:
-    if isinstance(value, bool) or not isinstance(value, (int, float)):
+    if isinstance(value, bool) or not isinstance(value, numbers.Real):
         raise TypeError(f"Could not convert {value!r} to float")
     return float(value)
 

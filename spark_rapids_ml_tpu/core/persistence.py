@@ -246,10 +246,9 @@ class MLReadable:
     def _load_impl(cls: Type, path: str):
         metadata = load_metadata(path, expected_class=cls.__name__)
         instance = cls()
+        # Note: only the uid attribute changes; the bound Params keep their
+        # original parent string (mutating Param.parent would change hashes
+        # of keys already stored in the param maps).
         instance.uid = metadata["uid"]
-        # Params were bound to the old uid prefix string only cosmetically;
-        # rebind parents for repr parity.
-        for param in instance._params.values():
-            param.parent = instance.uid
         get_and_set_params(instance, metadata)
         return instance

@@ -107,7 +107,7 @@ class RowMatrix:
             raise ValueError(f"need at least 2 rows, got {n}")
         with TraceRange("compute cov", TraceColor.RED):
             if self.mesh is not None:
-                return self._covariance_mesh()[1]
+                return self._covariance_mesh()[1]  # honors mean_centering
             mean = (
                 self.column_means()
                 if self.mean_centering
@@ -149,7 +149,9 @@ class RowMatrix:
         x = np.concatenate(self.partitions, axis=0).astype(np.dtype(self.dtype))
         d = x.shape[1]
         xs, mask, _ = shard_rows(x, self.mesh)
-        mean, cov = distributed_mean_and_covariance(xs, mask, self.mesh, precision=self.precision)
+        mean, cov = distributed_mean_and_covariance(
+            xs, mask, self.mesh, precision=self.precision, center=self.mean_centering
+        )
         # Strip model-axis feature padding (padded columns are exactly zero).
         return mean[:d], cov[:d, :d]
 

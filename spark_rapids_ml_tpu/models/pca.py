@@ -150,6 +150,11 @@ class PCAModel(_PCAParams, Model):
         self.set(self.outputCol, value)
         return self
 
+    def copy(self, extra=None) -> "PCAModel":
+        """Model.copy preserves fitted state (Spark's Model.copy contract)."""
+        that = PCAModel(self.uid, self.pc, self.explainedVariance)
+        return self._copyValues(that, extra)
+
     def transform(self, dataset: Any) -> Any:
         """Project rows onto the principal subspace: out = X · pc.
 

@@ -115,6 +115,8 @@ def welford_init(d: int, dtype=jnp.float64) -> tuple:
 def welford_add_block(state: tuple, x: jax.Array) -> tuple:
     count, mean, m2 = state
     n_b = x.shape[0]
+    if n_b == 0:  # static shape: an empty partition contributes nothing
+        return state
     mean_b = jnp.mean(x, axis=0)
     m2_b = jnp.sum((x - mean_b) ** 2, axis=0)
     new_count = count + n_b

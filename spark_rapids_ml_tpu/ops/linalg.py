@@ -10,10 +10,10 @@ rapidsml_jni.cu):
   - ``triuToFull`` packed-upper -> full symmetric (RapidsRowMatrix.scala:265-287)
 
 TPU numerics: the MXU natively multiplies bf16 with fp32 accumulation.
-``precision=HIGHEST`` runs the 3/6-pass bf16 decomposition giving ~fp32 product
-precision; fp64 (the reference's ``double[]`` surface) has no TPU hardware
-path, so fp64 inputs are computed via double-float ("double-double") emulation
-(see :mod:`spark_rapids_ml_tpu.ops.doubledouble`) when requested, else fp32.
+``precision=HIGHEST`` runs the multi-pass bf16 decomposition giving ~fp32
+product precision. fp64 (the reference's ``double[]`` surface) has no TPU
+hardware path: under ``jax_enable_x64`` on CPU these ops run in true fp64
+(the test oracle's numerics bar); on TPU, inputs compute in fp32-HIGHEST.
 """
 
 from __future__ import annotations

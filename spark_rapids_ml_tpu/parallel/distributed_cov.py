@@ -30,24 +30,32 @@ from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
 def distributed_mean_and_covariance(
-    x: jax.Array, mask: jax.Array, mesh: Mesh, precision: str = "highest"
+    x: jax.Array, mask: jax.Array, mesh: Mesh, precision: str = "highest", center: bool = True
 ):
     """Mean + sample covariance of row-sharded ``x`` with row ``mask``.
 
     ``x``: (n_padded, d) sharded P(data, model); ``mask``: (n_padded,)
     sharded P(data). Returns (mean: (d,), cov: (d, d)) replicated.
+    ``center=False`` reproduces the meanCentering=false estimator semantics
+    (second-moment matrix about zero); the returned mean is still the true
+    column mean either way, matching the single-device path.
     """
     prec = _dot_precision(precision)
 
-    @partial(jax.jit, out_shardings=(NamedSharding(mesh, P()), NamedSharding(mesh, P())))
-    def _fit(x, mask):
+    @partial(
+        jax.jit,
+        static_argnames=("center",),
+        out_shardings=(NamedSharding(mesh, P()), NamedSharding(mesh, P())),
+    )
+    def _fit(x, mask, center: bool = True):
         count = jnp.sum(mask)
         mean = jnp.sum(x * mask[:, None], axis=0) / count
-        b = (x - mean) * mask[:, None]
+        offset = mean if center else jnp.zeros_like(mean)
+        b = (x - offset) * mask[:, None]
         gram = jnp.matmul(b.T, b, precision=prec)
         return mean, gram / (count - 1)
 
-    return _fit(x, mask)
+    return _fit(x, mask, center=center)
 
 
 def distributed_covariance_shard_map(

@@ -1,0 +1,71 @@
+"""Regression tests for review findings on the round-1 core slice."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.core.data import DataFrame
+from spark_rapids_ml_tpu.feature import PCA, PCAModel
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+
+def test_mesh_honors_mean_centering_false(rng):
+    """Mesh path must respect meanCentering=False like the local path."""
+    mesh = make_mesh((8, 1))
+    x = rng.normal(size=(64, 6)) + 3.0
+    m_mesh = PCA(mesh=mesh).setK(3).setMeanCentering(False).fit(x)
+    m_local = PCA().setK(3).setMeanCentering(False).setUseCuSolverSVD(False).fit(x)
+    np.testing.assert_allclose(np.abs(m_mesh.pc), np.abs(m_local.pc), atol=1e-6)
+    # and differs from the centered fit (sanity that the flag had an effect)
+    m_centered = PCA(mesh=mesh).setK(3).fit(x)
+    assert not np.allclose(np.abs(m_mesh.pc), np.abs(m_centered.pc), atol=1e-3)
+
+
+def test_empty_partition_does_not_nan(rng):
+    x = rng.normal(size=(20, 5))
+    parts = [np.zeros((0, 5)), x[:10], np.zeros((0, 5)), x[10:]]
+    model = PCA().setK(2).setUseCuSolverSVD(False).fit(parts)
+    assert not np.any(np.isnan(model.pc))
+    ref = PCA().setK(2).setUseCuSolverSVD(False).fit(x)
+    np.testing.assert_allclose(model.pc, ref.pc, atol=1e-8)
+
+
+def test_pandas_without_input_col_uses_rows(rng):
+    import pandas as pd
+
+    x = rng.normal(size=(30, 4))
+    model = PCA().setK(2).setUseCuSolverSVD(False).fit(pd.DataFrame(x))
+    ref = PCA().setK(2).setUseCuSolverSVD(False).fit(x)
+    np.testing.assert_allclose(model.pc, ref.pc, atol=1e-10)
+
+
+def test_model_copy_preserves_fitted_state(rng):
+    x = rng.normal(size=(20, 5))
+    model = PCA().setK(2).setInputCol("f").setUseCuSolverSVD(False).fit(
+        DataFrame({"f": list(x)})
+    )
+    clone = model.copy()
+    np.testing.assert_allclose(clone.pc, model.pc)
+    out = clone.setOutputCol("o").transform(DataFrame({"f": list(x)}))
+    assert "o" in out.columns
+
+
+def test_setters_accept_numpy_ints():
+    model = PCA().setK(np.int64(3))
+    assert model.getK() == 3
+    model.setGpuId(np.int32(0))
+    assert model.getGpuId() == 0
+
+
+def test_generic_load_keeps_params_reachable(tmp_path):
+    """After load(), default params must still resolve (hash stability)."""
+    path = str(tmp_path / "est")
+    PCA().setK(5).save(path)
+    loaded = PCA.load(path)
+    # defaults reachable
+    assert loaded.getMeanCentering() is True
+    assert loaded.getUseGemm() is True
+    assert loaded.getK() == 5
+    # no duplicate keys: setting again overrides cleanly
+    loaded.setMeanCentering(False)
+    assert loaded.getMeanCentering() is False
+    assert len([p for p in loaded._paramMap if p.name == "meanCentering"]) == 1
