@@ -1,0 +1,287 @@
+// tpuml_host — native host runtime for spark_rapids_ml_tpu.
+//
+// The reference's native library (native/src/rapidsml_jni.cu) owns three
+// concerns: device compute (cuBLAS/cuSolver kernels), per-call device memory
+// management, and NVTX profiling push/pop. In the TPU build, device compute
+// and HBM management moved wholesale into XLA/PJRT (spark_rapids_ml_tpu.ops);
+// what remains native are the HOST-side responsibilities the reference leaves
+// in the JVM:
+//
+//   * the per-row centering / "concat before cov" hot loop
+//     (RapidsRowMatrix.scala:176-189) -> csr_to_dense / assemble_rows here,
+//     vectorized C++ instead of per-row JVM allocation;
+//   * a true-fp64 packed covariance accumulator (the spr/treeAggregate path,
+//     RapidsRowMatrix.scala:202-251 + cublasDspr layout rapidsml_jni.cu:
+//     133-136) — fp64 on the host CPU, since TPU hardware has no fp64: this
+//     is the numerics oracle / fallback path;
+//   * trace range push/pop mirroring the NVTX exports
+//     (rapidsml_jni.cu:69-92), recording wall-clock ranges in a
+//     process-local ring buffer.
+//
+// Exposed as a plain C ABI consumed via ctypes (no JVM in this build; the
+// extract-and-load pattern of JniRAPIDSML.java:34-58 becomes a dlopen from
+// the package directory).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Version / capability probe
+// ---------------------------------------------------------------------------
+
+int32_t tpuml_abi_version() { return 1; }
+
+// ---------------------------------------------------------------------------
+// Packed fp64 covariance accumulator (spr path)
+// ---------------------------------------------------------------------------
+// Layout: packed upper triangular, column-major ("U"): (i, j), i <= j at
+// j*(j+1)/2 + i — identical to cublasDspr FILL_MODE_UPPER and Spark BLAS.spr.
+
+struct SprAccumulator {
+  int64_t n_cols = 0;
+  int64_t n_rows = 0;
+  bool shifted = false;            // shift initialized from first row seen
+  std::vector<double> shift;       // provisional per-column shift K
+  std::vector<double> packed;      // n(n+1)/2: sum of (x-K)(x-K)^T
+  std::vector<double> comp;        // Kahan compensation terms
+  std::vector<double> sum;         // column sums of (x-K)
+};
+
+void* tpuml_spr_create(int64_t n_cols) {
+  if (n_cols <= 0 || n_cols > 65535) return nullptr;  // reference cap
+  auto* acc = new SprAccumulator();
+  acc->n_cols = n_cols;
+  acc->shift.assign(n_cols, 0.0);
+  acc->packed.assign(static_cast<size_t>(n_cols) * (n_cols + 1) / 2, 0.0);
+  acc->comp.assign(acc->packed.size(), 0.0);
+  acc->sum.assign(n_cols, 0.0);
+  return acc;
+}
+
+void tpuml_spr_destroy(void* handle) {
+  delete static_cast<SprAccumulator*>(handle);
+}
+
+// Add a dense row-major block (rows x n_cols) of fp64. Accumulates the
+// SHIFTED second-moment sum S = sum (x-K)(x-K)^T (K = the first row ever
+// seen) with Kahan compensation, plus shifted column sums. The shift defuses
+// the catastrophic cancellation of the textbook XtX - n*mean*mean^T form
+// when |mean| >> stddev; the centered covariance finalizes as
+//   Cov = (S - n * m m^T) / (n - 1),  m = mean(x) - K,
+// where both terms are O(stddev^2), not O(mean^2).
+int32_t tpuml_spr_add_block(void* handle, const double* block, int64_t rows) {
+  auto* acc = static_cast<SprAccumulator*>(handle);
+  if (!acc || !block || rows < 0) return -1;
+  const int64_t n = acc->n_cols;
+  if (!acc->shifted && rows > 0) {
+    std::memcpy(acc->shift.data(), block, n * sizeof(double));
+    acc->shifted = true;
+  }
+  std::vector<double> s(n);
+  for (int64_t r = 0; r < rows; ++r) {
+    const double* x = block + r * n;
+    for (int64_t j = 0; j < n; ++j) s[j] = x[j] - acc->shift[j];
+    size_t p = 0;
+    for (int64_t j = 0; j < n; ++j) {
+      const double sj = s[j];
+      acc->sum[j] += sj;
+      for (int64_t i = 0; i <= j; ++i, ++p) {
+        // Kahan-compensated accumulate of s[i]*s[j]
+        const double y = s[i] * sj - acc->comp[p];
+        const double t = acc->packed[p] + y;
+        acc->comp[p] = (t - acc->packed[p]) - y;
+        acc->packed[p] = t;
+      }
+    }
+  }
+  acc->n_rows += rows;
+  return 0;
+}
+
+// Merge another accumulator into this one (treeAggregate combOp,
+// RapidsRowMatrix.scala:226-233). The two sides generally carry different
+// shifts; b's sums are re-based onto a's shift:
+//   sum(x - Ka) = sum_b + n_b * d,            d = Kb - Ka
+//   sum (x-Ka)(x-Ka)^T = S_b + d sum_b^T + sum_b d^T + n_b d d^T
+int32_t tpuml_spr_merge(void* handle, const void* other_handle) {
+  auto* a = static_cast<SprAccumulator*>(handle);
+  const auto* b = static_cast<const SprAccumulator*>(other_handle);
+  if (!a || !b || a->n_cols != b->n_cols) return -1;
+  const int64_t n = a->n_cols;
+  if (!a->shifted) {
+    a->shift = b->shift;
+    a->shifted = b->shifted;
+  }
+  std::vector<double> d(n);
+  for (int64_t j = 0; j < n; ++j) d[j] = b->shift[j] - a->shift[j];
+  const double nb = static_cast<double>(b->n_rows);
+  size_t p = 0;
+  for (int64_t j = 0; j < n; ++j) {
+    for (int64_t i = 0; i <= j; ++i, ++p) {
+      a->packed[p] += b->packed[p] + d[i] * b->sum[j] + b->sum[i] * d[j] +
+                      nb * d[i] * d[j];
+    }
+  }
+  for (int64_t j = 0; j < n; ++j) a->sum[j] += b->sum[j] + nb * d[j];
+  a->n_rows += b->n_rows;
+  return 0;
+}
+
+int64_t tpuml_spr_rows(const void* handle) {
+  const auto* acc = static_cast<const SprAccumulator*>(handle);
+  return acc ? acc->n_rows : -1;
+}
+
+// Write the full symmetric covariance (n x n, row-major) into out.
+// center != 0 -> subtract the mean outer product (sample covariance);
+// center == 0 -> raw second-moment matrix / (n_rows - 1).
+// Also writes the column means into mean_out (length n) if non-null.
+int32_t tpuml_spr_finalize(const void* handle, double* out, double* mean_out,
+                           int32_t center) {
+  const auto* acc = static_cast<const SprAccumulator*>(handle);
+  if (!acc || !out) return -1;
+  const int64_t n = acc->n_cols;
+  const int64_t m = acc->n_rows;
+  if (m < 2) return -2;
+  const double md = static_cast<double>(m);
+  // ms = mean of shifted data; true mean = K + ms.
+  std::vector<double> ms(n);
+  for (int64_t j = 0; j < n; ++j) ms[j] = acc->sum[j] / md;
+  if (mean_out) {
+    for (int64_t j = 0; j < n; ++j) mean_out[j] = acc->shift[j] + ms[j];
+  }
+  const double denom = static_cast<double>(m - 1);
+  size_t p = 0;
+  for (int64_t j = 0; j < n; ++j) {
+    for (int64_t i = 0; i <= j; ++i, ++p) {
+      double v;
+      if (center) {
+        // Cov = (S - m * ms ms^T) / (m-1); both terms O(var), no blow-up.
+        v = acc->packed[p] - md * ms[i] * ms[j];
+      } else {
+        // Raw X^T X = S + K sum^T + sum K^T + m K K^T (then / (m-1)).
+        v = acc->packed[p] + acc->shift[i] * acc->sum[j] +
+            acc->sum[i] * acc->shift[j] + md * acc->shift[i] * acc->shift[j];
+      }
+      v /= denom;
+      out[i * n + j] = v;
+      out[j * n + i] = v;
+    }
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Batch assembly: sparse CSR rows -> dense row-major fp64/fp32 block
+// (the "concat before cov" hot loop, RapidsRowMatrix.scala:183-189)
+// ---------------------------------------------------------------------------
+
+int32_t tpuml_csr_to_dense_f64(const int64_t* indptr, const int32_t* indices,
+                               const double* values, int64_t n_rows,
+                               int64_t n_cols, double* out) {
+  if (!indptr || !out || n_rows < 0 || n_cols <= 0) return -1;
+  std::memset(out, 0, static_cast<size_t>(n_rows) * n_cols * sizeof(double));
+  for (int64_t r = 0; r < n_rows; ++r) {
+    double* row = out + r * n_cols;
+    for (int64_t p = indptr[r]; p < indptr[r + 1]; ++p) {
+      const int32_t c = indices[p];
+      if (c < 0 || c >= n_cols) return -2;
+      row[c] = values[p];
+    }
+  }
+  return 0;
+}
+
+int32_t tpuml_csr_to_dense_f32(const int64_t* indptr, const int32_t* indices,
+                               const double* values, int64_t n_rows,
+                               int64_t n_cols, float* out) {
+  if (!indptr || !out || n_rows < 0 || n_cols <= 0) return -1;
+  std::memset(out, 0, static_cast<size_t>(n_rows) * n_cols * sizeof(float));
+  for (int64_t r = 0; r < n_rows; ++r) {
+    float* row = out + r * n_cols;
+    for (int64_t p = indptr[r]; p < indptr[r + 1]; ++p) {
+      const int32_t c = indices[p];
+      if (c < 0 || c >= n_cols) return -2;
+      row[c] = static_cast<float>(values[p]);
+    }
+  }
+  return 0;
+}
+
+// Center + scale a dense fp64 block into fp32 output: out = (x - mean) * scale
+// — the per-row JVM loop of RapidsRowMatrix.scala:176-182, vectorized, with
+// the fp64->fp32 narrowing done last (preserves fp64 centering accuracy).
+int32_t tpuml_center_scale_f32(const double* x, const double* mean,
+                               double scale, int64_t rows, int64_t cols,
+                               float* out) {
+  if (!x || !mean || !out) return -1;
+  for (int64_t r = 0; r < rows; ++r) {
+    const double* xr = x + r * cols;
+    float* orow = out + r * cols;
+    for (int64_t c = 0; c < cols; ++c) {
+      orow[c] = static_cast<float>((xr[c] - mean[c]) * scale);
+    }
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Trace ranges (NVTX push/pop parity, rapidsml_jni.cu:69-92)
+// ---------------------------------------------------------------------------
+
+struct TraceEvent {
+  char name[64];
+  double start_s;
+  double end_s;
+};
+
+namespace {
+std::mutex g_trace_mu;
+std::vector<std::pair<std::string, double>> g_trace_stack;
+std::vector<TraceEvent> g_trace_ring;
+constexpr size_t kRingCap = 4096;
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
+void tpuml_trace_push(const char* name) {
+  std::lock_guard<std::mutex> lock(g_trace_mu);
+  g_trace_stack.emplace_back(name ? name : "", now_s());
+}
+
+void tpuml_trace_pop() {
+  std::lock_guard<std::mutex> lock(g_trace_mu);
+  if (g_trace_stack.empty()) return;
+  auto [name, start] = g_trace_stack.back();
+  g_trace_stack.pop_back();
+  TraceEvent ev{};
+  std::snprintf(ev.name, sizeof(ev.name), "%s", name.c_str());
+  ev.start_s = start;
+  ev.end_s = now_s();
+  if (g_trace_ring.size() >= kRingCap) g_trace_ring.erase(g_trace_ring.begin());
+  g_trace_ring.push_back(ev);
+}
+
+int64_t tpuml_trace_drain(TraceEvent* out, int64_t cap) {
+  std::lock_guard<std::mutex> lock(g_trace_mu);
+  const int64_t n =
+      std::min<int64_t>(cap, static_cast<int64_t>(g_trace_ring.size()));
+  for (int64_t i = 0; i < n; ++i) out[i] = g_trace_ring[i];
+  g_trace_ring.clear();
+  return n;
+}
+
+}  // extern "C"

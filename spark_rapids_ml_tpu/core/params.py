@@ -55,7 +55,7 @@ class Param:
 def toInt(value: Any) -> int:
     """Accepts any Integral (incl. numpy ints) and integral floats, like
     pyspark's TypeConverters.toInt."""
-    if isinstance(value, bool) or not isinstance(value, (numbers.Integral, numbers.Real)):
+    if isinstance(value, bool) or not isinstance(value, numbers.Real):
         raise TypeError(f"Could not convert {value!r} to int")
     if not isinstance(value, numbers.Integral) and not float(value).is_integer():
         raise TypeError(f"Could not convert non-integral {value!r} to int")

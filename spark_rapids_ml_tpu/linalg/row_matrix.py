@@ -108,14 +108,14 @@ class RowMatrix:
         with TraceRange("compute cov", TraceColor.RED):
             if self.mesh is not None:
                 return self._covariance_mesh()[1]  # honors mean_centering
-            mean = (
-                self.column_means()
-                if self.mean_centering
-                else jnp.zeros(self.num_cols, dtype=self.dtype)
-            )
             if self.use_gemm:
+                mean = (
+                    self.column_means()
+                    if self.mean_centering
+                    else jnp.zeros(self.num_cols, dtype=self.dtype)
+                )
                 return self._covariance_gemm(mean)
-            return self._covariance_packed(mean)
+            return self._covariance_packed()
 
     def _covariance_gemm(self, mean: jnp.ndarray) -> jnp.ndarray:
         """Per-partition fused centered Gram + host partial sum (:168-201)."""
@@ -128,14 +128,34 @@ class RowMatrix:
             acc = gram if acc is None else acc + gram
         return acc / (self.num_rows - 1)
 
-    def _covariance_packed(self, mean: jnp.ndarray) -> jnp.ndarray:
+    def _covariance_packed(self) -> jnp.ndarray:
         """Packed-upper aggregation path (spr/treeAggregate, :202-251).
 
         Keeps the reference's n ≤ 65535 wire-format constraint (:66-68).
+        When the native host runtime is present, this runs as a true-fp64
+        Kahan-compensated streaming accumulation in C++ (the reference's
+        all-``double[]`` numerics bar, independent of jax_enable_x64);
+        otherwise it falls back to jitted packed Gram accumulation. Both
+        compute their own column means in a single pass — no separate
+        Welford sweep.
         """
         n_cols = self.num_cols
         if n_cols > 65535:
             raise ValueError(f"packed path caps features at 65535, got {n_cols}")
+        from spark_rapids_ml_tpu import native
+
+        if native.available():
+            acc = native.SprAccumulator(n_cols)
+            for part in self.partitions:
+                if part.shape[0]:
+                    acc.add_block(part)
+            cov, _ = acc.finalize(center=self.mean_centering)
+            return jnp.asarray(cov, dtype=self.dtype)
+        mean = (
+            self.column_means()
+            if self.mean_centering
+            else jnp.zeros(n_cols, dtype=self.dtype)
+        )
         acc = None
         for part in self.partitions:
             blk = jnp.asarray(part, dtype=self.dtype)

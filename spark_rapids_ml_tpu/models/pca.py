@@ -115,15 +115,6 @@ class PCA(_PCAParams, Estimator, MLReadable):
         model = PCAModel(self.uid, np.asarray(pc), np.asarray(explained))
         return self._copyValues(model)
 
-    @classmethod
-    def _load_impl(cls, path: str) -> "PCA":
-        metadata = load_metadata(path, expected_class="PCA")
-        inst = cls()
-        inst.uid = metadata["uid"]
-        get_and_set_params(inst, metadata)
-        return inst
-
-
 class PCAModel(_PCAParams, Model):
     """Fitted PCA model: principal components (d, k) + explained variance (k,).
 
