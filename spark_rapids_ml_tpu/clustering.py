@@ -1,0 +1,5 @@
+"""Clustering namespace — parity with ``org.apache.spark.ml.clustering``."""
+
+from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel
+
+__all__ = ["KMeans", "KMeansModel"]

@@ -178,6 +178,65 @@ def save_data(path: str, columns: Dict[str, tuple]) -> None:
         )
 
 
+def save_rows(path: str, columns: Dict[str, tuple]) -> None:
+    """Write ``<path>/data`` as a multi-row parquet table.
+
+    ``columns`` maps name -> (kind, list_of_values) with kind in
+    "matrix" | "vector" | "scalar". Used for models whose Spark on-disk
+    layout is row-per-entity (e.g. KMeansModel: one row per cluster of
+    (clusterIdx: int, clusterCenter: VectorUDT))."""
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    if _HAS_ARROW:
+        fields, arrays = [], []
+        for name, (kind, values) in columns.items():
+            if kind == "matrix":
+                fields.append((name, _MATRIX_TYPE))
+                arrays.append(pa.array([_matrix_struct(v) for v in values], type=_MATRIX_TYPE))
+            elif kind == "vector":
+                fields.append((name, _VECTOR_TYPE))
+                arrays.append(pa.array([_vector_struct(v) for v in values], type=_VECTOR_TYPE))
+            else:
+                arr = pa.array(list(values))
+                fields.append((name, arr.type))
+                arrays.append(arr)
+        table = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+        pq.write_table(table, os.path.join(data_dir, "part-00000.parquet"))
+        open(os.path.join(data_dir, "_SUCCESS"), "w").close()
+    else:  # pragma: no cover
+        np.savez(
+            os.path.join(data_dir, "part-00000.npz"),
+            **{name: np.asarray(values) for name, (kind, values) in columns.items()},
+        )
+
+
+def load_rows(path: str) -> Dict[str, list]:
+    """Read a multi-row ``<path>/data`` table into {name: [decoded values]}."""
+    data_dir = os.path.join(path, "data")
+    parquets = [
+        p
+        for p in sorted(glob.glob(os.path.join(data_dir, "*.parquet")))
+        if not p.endswith("_SUCCESS")
+    ]
+    if parquets and _HAS_ARROW:
+        table = pq.read_table(parquets[0])
+        out: Dict[str, list] = {name: [] for name in table.column_names}
+        for row in table.to_pylist():
+            for name, value in row.items():
+                if isinstance(value, dict) and "numRows" in value:
+                    out[name].append(matrix_from_struct(value))
+                elif isinstance(value, dict) and "size" in value:
+                    out[name].append(vector_from_struct(value))
+                else:
+                    out[name].append(value)
+        return out
+    npzs = sorted(glob.glob(os.path.join(data_dir, "*.npz")))  # pragma: no cover
+    if npzs:  # pragma: no cover
+        with np.load(npzs[0]) as z:
+            return {k: list(z[k]) for k in z.files}
+    raise FileNotFoundError(f"no data files under {data_dir}")
+
+
 def load_data(path: str) -> Dict[str, Any]:
     """Read ``<path>/data`` back into {name: decoded value}."""
     data_dir = os.path.join(path, "data")

@@ -1,0 +1,279 @@
+"""LinearRegression estimator/model — Spark ML surface, normal-equation solver.
+
+Param surface mirrors ``org.apache.spark.ml.regression.LinearRegression``:
+``featuresCol``, ``labelCol``, ``predictionCol``, ``fitIntercept``,
+``regParam`` (L2 -> Ridge), ``elasticNetParam`` (must be 0 for the normal
+solver, as in Spark), ``standardization``, ``solver`` ("normal" | "auto").
+Beyond-the-reference capability (BASELINE.md config 4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix
+from spark_rapids_ml_tpu.core.estimator import Estimator, Model
+from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toString
+from spark_rapids_ml_tpu.core.persistence import (
+    MLReadable,
+    get_and_set_params,
+    load_data,
+    load_metadata,
+    save_data,
+    save_metadata,
+)
+from spark_rapids_ml_tpu.ops.linear import (
+    normal_eq_stats,
+    predict_linear,
+    regression_metrics,
+    solve_normal,
+)
+from spark_rapids_ml_tpu.parallel.mesh import shard_rows
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+class _LinearRegressionParams(Params):
+    featuresCol = Param("_", "featuresCol", "features column name", toString)
+    labelCol = Param("_", "labelCol", "label column name", toString)
+    predictionCol = Param("_", "predictionCol", "prediction column name", toString)
+    fitIntercept = Param("_", "fitIntercept", "whether to fit an intercept", toBoolean)
+    regParam = Param("_", "regParam", "L2 regularization strength", toFloat)
+    elasticNetParam = Param("_", "elasticNetParam", "L1/L2 mixing (0 = pure L2)", toFloat)
+    standardization = Param(
+        "_", "standardization", "penalize standardized coefficients", toBoolean
+    )
+    solver = Param("_", "solver", "normal or auto", toString)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(
+            featuresCol="features",
+            labelCol="label",
+            predictionCol="prediction",
+            fitIntercept=True,
+            regParam=0.0,
+            elasticNetParam=0.0,
+            standardization=True,
+            solver="auto",
+        )
+
+    def getFeaturesCol(self) -> str:
+        return self.getOrDefault(self.featuresCol)
+
+    def getLabelCol(self) -> str:
+        return self.getOrDefault(self.labelCol)
+
+    def getPredictionCol(self) -> str:
+        return self.getOrDefault(self.predictionCol)
+
+    def getFitIntercept(self) -> bool:
+        return self.getOrDefault(self.fitIntercept)
+
+    def getRegParam(self) -> float:
+        return self.getOrDefault(self.regParam)
+
+    def getElasticNetParam(self) -> float:
+        return self.getOrDefault(self.elasticNetParam)
+
+    def getStandardization(self) -> bool:
+        return self.getOrDefault(self.standardization)
+
+    def getSolver(self) -> str:
+        return self.getOrDefault(self.solver)
+
+
+class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
+    """OLS / Ridge via the normal-equation GEMM path.
+
+    ``LinearRegression().setRegParam(0.1).fit((X, y))`` — input is
+    ``(X, y)``, a DataFrame shim / pandas frame with features+label columns.
+    """
+
+    def __init__(self, uid: Optional[str] = None, mesh=None):
+        super().__init__(uid)
+        self.mesh = mesh
+
+    def setFeaturesCol(self, value: str) -> "LinearRegression":
+        self.set(self.featuresCol, value)
+        return self
+
+    def setLabelCol(self, value: str) -> "LinearRegression":
+        self.set(self.labelCol, value)
+        return self
+
+    def setPredictionCol(self, value: str) -> "LinearRegression":
+        self.set(self.predictionCol, value)
+        return self
+
+    def setFitIntercept(self, value: bool) -> "LinearRegression":
+        self.set(self.fitIntercept, value)
+        return self
+
+    def setRegParam(self, value: float) -> "LinearRegression":
+        if value < 0:
+            raise ValueError(f"regParam must be >= 0, got {value}")
+        self.set(self.regParam, value)
+        return self
+
+    def setElasticNetParam(self, value: float) -> "LinearRegression":
+        self.set(self.elasticNetParam, value)
+        return self
+
+    def setStandardization(self, value: bool) -> "LinearRegression":
+        self.set(self.standardization, value)
+        return self
+
+    def setSolver(self, value: str) -> "LinearRegression":
+        if value not in ("normal", "auto"):
+            raise ValueError(f"solver must be 'normal' or 'auto', got {value!r}")
+        self.set(self.solver, value)
+        return self
+
+    def setMesh(self, mesh) -> "LinearRegression":
+        self.mesh = mesh
+        return self
+
+    def fit(self, dataset: Any) -> "LinearRegressionModel":
+        if self.getElasticNetParam() != 0.0:
+            # Same restriction as Spark's normal solver (L1 needs OWL-QN).
+            raise ValueError("normal solver supports only L2 (elasticNetParam must be 0)")
+        x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+        with TraceRange("linreg fit", TraceColor.DARK_GREEN):
+            if self.mesh is not None:
+                xs, mask, n = shard_rows(x_host.astype(np.dtype(dtype)), self.mesh)
+                y_pad = np.zeros(xs.shape[0], dtype=np.dtype(dtype))
+                y_pad[: len(y_host)] = y_host
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+                ys = jax.device_put(y_pad, NamedSharding(self.mesh, P(DATA_AXIS)))
+            else:
+                xs = jnp.asarray(x_host, dtype=dtype)
+                ys = jnp.asarray(y_host, dtype=dtype)
+                mask = jnp.ones(xs.shape[0], dtype=dtype)
+            xtx, xty, x_sum, y_sum, yty, count = normal_eq_stats(xs, ys, mask)
+            d = x_host.shape[1]
+            coef, intercept = solve_normal(
+                xtx[:d, :d],
+                xty[:d],
+                x_sum[:d],
+                y_sum,
+                count,
+                reg_param=self.getRegParam(),
+                fit_intercept=self.getFitIntercept(),
+                standardization=self.getStandardization(),
+            )
+
+        model = LinearRegressionModel(
+            self.uid, np.asarray(coef, dtype=np.float64), float(intercept)
+        )
+        return self._copyValues(model)
+
+
+def _extract_xy(dataset: Any, features_col: str, label_col: str):
+    """Accepts (X, y) tuples, DataFrame shim, or pandas with named columns."""
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        x, y = dataset
+        return as_matrix(x), np.asarray(y, dtype=np.float64).ravel()
+    if isinstance(dataset, DataFrame):
+        x = as_matrix(dataset.select(features_col))
+        y = np.asarray(dataset.select(label_col), dtype=np.float64).ravel()
+        return x, y
+    try:
+        import pandas as pd
+
+        if isinstance(dataset, pd.DataFrame):
+            if features_col in dataset.columns:
+                x = as_matrix(dataset[features_col].tolist())
+            else:
+                x = dataset.drop(columns=[label_col]).to_numpy(dtype=np.float64)
+            y = dataset[label_col].to_numpy(dtype=np.float64)
+            return x, y
+    except ImportError:  # pragma: no cover
+        pass
+    raise TypeError(
+        "dataset must be (X, y), a DataFrame with features/label columns, or a pandas DataFrame"
+    )
+
+
+class LinearRegressionModel(_LinearRegressionParams, Model):
+    """Fitted model: ``coefficients`` (d,), ``intercept``."""
+
+    def __init__(
+        self,
+        uid: Optional[str] = None,
+        coefficients: Optional[np.ndarray] = None,
+        intercept: float = 0.0,
+    ):
+        super().__init__(uid)
+        self.coefficients = None if coefficients is None else np.asarray(coefficients)
+        self.intercept = intercept
+
+    def predict(self, x) -> np.ndarray:
+        if self.coefficients is None:
+            raise RuntimeError("model has no coefficients")
+        x = as_matrix(x)
+        return np.asarray(predict_linear(jnp.asarray(x), jnp.asarray(self.coefficients), self.intercept))
+
+    def transform(self, dataset: Any) -> Any:
+        if isinstance(dataset, tuple):
+            x = dataset[0]
+        else:
+            x = dataset
+        if isinstance(dataset, DataFrame):
+            pred = self.predict(dataset.select(self.getFeaturesCol()))
+            return dataset.withColumn(self.getPredictionCol(), list(pred))
+        try:
+            import pandas as pd
+
+            if isinstance(dataset, pd.DataFrame):
+                if self.getFeaturesCol() in dataset.columns:
+                    pred = self.predict(dataset[self.getFeaturesCol()].tolist())
+                else:
+                    cols = [c for c in dataset.columns if c != self.getLabelCol()]
+                    pred = self.predict(dataset[cols].to_numpy(dtype=np.float64))
+                out = dataset.copy()
+                out[self.getPredictionCol()] = pred
+                return out
+        except ImportError:  # pragma: no cover
+            pass
+        return self.predict(x)
+
+    def evaluate(self, dataset: Any) -> dict:
+        """RegressionSummary analogue: mse/rmse/mae/r2 on a labeled dataset."""
+        x, y = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
+        pred = self.predict(x)
+        mask = jnp.ones(len(y), dtype=pred.dtype)
+        mse, rmse, mae, r2 = regression_metrics(jnp.asarray(y, dtype=pred.dtype), jnp.asarray(pred), mask)
+        return {
+            "meanSquaredError": float(mse),
+            "rootMeanSquaredError": float(rmse),
+            "meanAbsoluteError": float(mae),
+            "r2": float(r2),
+        }
+
+    def _save_impl(self, path: str) -> None:
+        save_metadata(
+            self, path, class_name="org.apache.spark.ml.regression.LinearRegressionModel"
+        )
+        save_data(
+            path,
+            {
+                "coefficients": ("vector", self.coefficients),
+                "intercept": ("scalar", float(self.intercept)),
+            },
+        )
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "LinearRegressionModel":
+        metadata = load_metadata(path, expected_class="LinearRegressionModel")
+        data = load_data(path)
+        model = cls(metadata["uid"], data["coefficients"], float(data["intercept"]))
+        get_and_set_params(model, metadata)
+        return model

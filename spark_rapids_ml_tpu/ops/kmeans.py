@@ -1,0 +1,189 @@
+"""KMeans kernels — Lloyd iterations as MXU matmuls.
+
+Beyond-PCA capability (BASELINE.md config 3: "KMeans k=100 on NYC-Taxi 20M
+rows — RAFT kmeans -> XLA"). The reference repo itself has no kmeans; the
+RAPIDS family's implementation is RAFT's fused distance kernel + cuBLAS. The
+TPU formulation keeps everything on the MXU:
+
+  - assignment: pairwise squared distances via the expansion
+    ||x||^2 - 2 x C^T + ||c||^2 — one (n,d)x(d,k) matmul, no materialized
+    (n,k,d) intermediate;
+  - update: cluster sums as one_hot(labels)^T X — a (k,n)x(n,d) matmul —
+    so the "scatter-add" is also a systolic-array op;
+  - the whole fit is ONE jitted lax.while_loop (movement tolerance + max
+    iterations), compiler-friendly static shapes throughout;
+  - empty clusters keep their previous center (Spark/RAFT behavior);
+  - masked rows (mask=0) support padding for sharded execution: a padded
+    row contributes to no cluster and no cost.
+
+Distributed: row-shard x/mask over a mesh data axis and jit with replicated
+out-shardings — XLA inserts psum for the segment sums/counts/cost (see
+tests/test_kmeans.py::TestDistributed).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.ops.linalg import _dot_precision
+
+
+def _sq_dists(x, centers, x2, prec):
+    """(n, k) squared euclidean distances via the Gram expansion."""
+    c2 = jnp.sum(centers * centers, axis=1)
+    xc = jnp.matmul(x, centers.T, precision=prec)
+    return jnp.maximum(x2[:, None] - 2.0 * xc + c2[None, :], 0.0)
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def assign_clusters(x, centers, precision: str = "highest"):
+    """Labels + per-row squared distance to the nearest center."""
+    prec = _dot_precision(precision)
+    x2 = jnp.sum(x * x, axis=1)
+    d2 = _sq_dists(x, centers, x2, prec)
+    labels = jnp.argmin(d2, axis=1)
+    return labels, jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
+
+
+def lloyd_step(x, mask, centers, x2, prec, cosine: bool = False):
+    """One Lloyd iteration. Returns (new_centers, cost).
+
+    ``cosine``: renormalize updated centers to unit norm (Spark's
+    CosineDistanceMeasure.updateClusterCenter) so assignments stay true
+    cosine argmins given unit-normalized input rows.
+    """
+    k = centers.shape[0]
+    d2 = _sq_dists(x, centers, x2, prec)
+    labels = jnp.argmin(d2, axis=1)
+    min_d2 = jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
+    one_hot = jax.nn.one_hot(labels, k, dtype=x.dtype) * mask[:, None]
+    sums = jnp.matmul(one_hot.T, x, precision=prec)          # (k, d) on MXU
+    counts = jnp.sum(one_hot, axis=0)                         # (k,)
+    new_centers = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
+    )
+    if cosine:
+        new_centers = normalize_rows(new_centers)
+    cost = jnp.sum(min_d2 * mask)
+    return new_centers, cost
+
+
+@partial(jax.jit, static_argnames=("max_iter", "precision", "cosine"))
+def lloyd(
+    x: jax.Array,
+    mask: jax.Array,
+    init_centers: jax.Array,
+    max_iter: int = 20,
+    tol: float = 1e-4,
+    precision: str = "highest",
+    cosine: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full Lloyd fit: returns (centers, cost, n_iters).
+
+    Convergence criterion matches Spark ML KMeans: stop when no center moves
+    more than ``tol`` (euclidean), or at ``max_iter``. With ``cosine``,
+    centers stay unit-normalized every iteration (input rows must already be
+    unit-normalized), so the returned cost is the cosine-distance potential.
+    """
+    prec = _dot_precision(precision)
+    x2 = jnp.sum(x * x, axis=1)
+
+    def cond(state):
+        _, moved, it, _ = state
+        return jnp.logical_and(moved > tol * tol, it < max_iter)
+
+    def body(state):
+        centers, _, it, _ = state
+        new_centers, cost = lloyd_step(x, mask, centers, x2, prec, cosine=cosine)
+        moved = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
+        return new_centers, moved, it + 1, cost
+
+    init_state = (init_centers, jnp.asarray(jnp.inf, x.dtype), 0, jnp.asarray(0.0, x.dtype))
+    centers, _, n_iter, cost = jax.lax.while_loop(cond, body, init_state)
+    # One final cost evaluation against the converged centers.
+    _, final_cost = lloyd_step(x, mask, centers, x2, prec, cosine=cosine)
+    return centers, final_cost, n_iter
+
+
+@partial(jax.jit, static_argnames=("k", "precision"))
+def kmeans_plusplus_init(
+    x: jax.Array,
+    mask: jax.Array,
+    key: jax.Array,
+    k: int,
+    precision: str = "highest",
+) -> jax.Array:
+    """Greedy k-means++ seeding, fully on device via lax.fori_loop.
+
+    D^2 sampling (Arthur & Vassilvitskii) with the greedy refinement sklearn
+    uses: at each step, draw ``2 + ceil(log2 k)`` candidate rows with
+    probability proportional to their squared distance to the nearest chosen
+    center (Gumbel-top-t trick — no host sync), then keep the candidate that
+    minimizes the resulting total potential. Single-candidate sequential
+    k-means++ misses well-separated clusters often enough to matter at
+    k >= 20; the greedy variant is the industrial default. Each step is two
+    MXU matmuls — (n,d)x(d,k) for current distances and (t,d)x(d,n) for the
+    candidate evaluation. Masked (padded) rows are never selected and never
+    contribute to the potential.
+    """
+    prec = _dot_precision(precision)
+    n, d = x.shape
+    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
+    t = 2 + max(int(np.ceil(np.log2(k))), 0)
+
+    x2 = jnp.sum(x * x, axis=1)
+    key0, key_loop = jax.random.split(key)
+    # First center: uniform over unmasked rows (Gumbel-max over the mask).
+    g0 = jax.random.gumbel(key0, (n,), dtype=x.dtype)
+    first = jnp.argmax(jnp.where(mask > 0, g0, neg_inf))
+    centers = jnp.zeros((k, d), x.dtype).at[0].set(x[first])
+    # min_d2: distance to nearest chosen center, maintained incrementally.
+    min_d2 = jnp.maximum(x2 - 2.0 * jnp.matmul(x, x[first], precision=prec) + x2[first], 0.0)
+    min_d2 = min_d2 * mask
+
+    def body(i, carry):
+        centers, min_d2, key = carry
+        key, sub = jax.random.split(key)
+        # Gumbel-top-t draw of candidates ∝ min_d2 over unmasked rows.
+        logw = jnp.where((mask > 0) & (min_d2 > 0), jnp.log(min_d2), neg_inf)
+        g = jax.random.gumbel(sub, (n,), dtype=x.dtype)
+        _, cand = jax.lax.top_k(logw + g, t)
+        # all-zero residual (duplicate data): fall back to the first row
+        degenerate = jnp.logical_not(jnp.isfinite(jnp.max(logw)))
+        cand = jnp.where(degenerate, first, cand)
+        # Evaluate each candidate: potential = sum_j min(min_d2, d2(x_j, c)).
+        xc = x[cand]                                            # (t, d)
+        d2c = jnp.maximum(
+            x2[None, :] - 2.0 * jnp.matmul(xc, x.T, precision=prec)
+            + jnp.sum(xc * xc, axis=1)[:, None],
+            0.0,
+        )                                                       # (t, n)
+        pot = jnp.sum(jnp.minimum(min_d2[None, :], d2c) * mask[None, :], axis=1)
+        best = jnp.argmin(pot)
+        idx = cand[best]
+        new_min_d2 = jnp.minimum(min_d2, d2c[best]) * mask
+        return centers.at[i].set(x[idx]), new_min_d2, key
+
+    centers, _, _ = jax.lax.fori_loop(1, k, body, (centers, min_d2, key_loop))
+    return centers
+
+
+@partial(jax.jit, static_argnames=("k",))
+def random_init(x: jax.Array, mask: jax.Array, key: jax.Array, k: int) -> jax.Array:
+    """Random seeding: k distinct unmasked rows (Gumbel top-k)."""
+    n = x.shape[0]
+    g = jax.random.gumbel(key, (n,), dtype=x.dtype)
+    scores = jnp.where(mask > 0, g, -jnp.inf)
+    _, idx = jax.lax.top_k(scores, k)
+    return x[idx]
+
+
+def normalize_rows(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """Unit-normalize rows — cosine distance == euclidean on normalized data."""
+    norms = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+    return x / jnp.maximum(norms, eps)

@@ -1,0 +1,125 @@
+"""Linear model kernels — normal-equation sufficient statistics on the MXU.
+
+Beyond-PCA capability (BASELINE.md config 4: "LinearRegression / Ridge on
+HIGGS 11M x 28 — normal-equation GEMM path"). The sufficient statistics
+(X^T X, X^T y, column sums) are one fused jitted computation — the same
+masked/shardable shape as the covariance kernel, so the distributed story is
+identical: row-shard x/y over the mesh data axis and XLA inserts the psum.
+
+Solve semantics follow Spark ML's "normal" solver (WeightedLeastSquares):
+    minimize 1/(2n) ||y - X b - b0||^2 + regParam * penalty(b)
+with L2 penalty applied to coefficients of STANDARDIZED features when
+``standardization`` is on, i.e. in original space
+    (Xc^T Xc + n * regParam * diag(sigma^2)) b = Xc^T yc
+(sigma = per-feature stddev; identity instead of diag(sigma^2) when
+standardization is off), intercept b0 = mean(y) - mean(x)^T b.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.ops.linalg import _dot_precision
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def normal_eq_stats(
+    x: jax.Array, y: jax.Array, mask: jax.Array, precision: str = "highest"
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Masked sufficient statistics in one pass.
+
+    Returns (xtx, xty, x_sum, y_sum, yty, count): raw (uncentered) moments;
+    centering happens in the solver where it is O(d^2), not O(n d).
+    """
+    prec = _dot_precision(precision)
+    xm = x * mask[:, None]
+    ym = y * mask
+    xtx = jnp.matmul(xm.T, x, precision=prec)
+    xty = jnp.matmul(xm.T, y, precision=prec)
+    return (
+        xtx,
+        xty,
+        jnp.sum(xm, axis=0),
+        jnp.sum(ym),
+        jnp.sum(ym * y),
+        jnp.sum(mask),
+    )
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "standardization"))
+def solve_normal(
+    xtx: jax.Array,
+    xty: jax.Array,
+    x_sum: jax.Array,
+    y_sum: jax.Array,
+    count: jax.Array,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+):
+    """Solve the (regularized) normal equations from raw moments.
+
+    Returns (coefficients (d,), intercept scalar). Cholesky with a
+    singularity fallback to eigh-based pseudo-solve (minimum-norm), which
+    handles rank-deficient designs the way LAPACK-backed Spark does via
+    quasi-Newton fallback.
+    """
+    n = count
+    x_mean = x_sum / n
+    y_mean = y_sum / n
+    if fit_intercept:
+        # centered moments: Xc^T Xc = X^T X - n * mean mean^T
+        a = xtx - n * jnp.outer(x_mean, x_mean)
+        b = xty - n * x_mean * y_mean
+    else:
+        a = xtx
+        b = xty
+    d = a.shape[0]
+    if standardization:
+        # sigma^2 is the TRUE feature variance (centered second moment) in
+        # both intercept modes — Spark standardizes by the feature stddev
+        # regardless of fitIntercept.
+        var = jnp.maximum(
+            (jnp.diag(xtx) - n * x_mean * x_mean) / jnp.maximum(n - 1, 1), 0.0
+        )
+        penalty = var
+    else:
+        penalty = jnp.ones(d, dtype=a.dtype)
+    a_reg = a + (n * reg_param) * jnp.diag(penalty)
+
+    chol, low = jax.scipy.linalg.cho_factor(a_reg, lower=True)
+    coef_chol = jax.scipy.linalg.cho_solve((chol, low), b)
+    ok = jnp.all(jnp.isfinite(coef_chol))
+
+    # minimum-norm pseudo-solve fallback for singular/indefinite systems
+    w, v = jnp.linalg.eigh(a_reg)
+    tol = jnp.max(jnp.abs(w)) * d * jnp.finfo(a.dtype).eps
+    w_inv = jnp.where(w > tol, 1.0 / w, 0.0)
+    coef_pinv = v @ (w_inv * (v.T @ b))
+
+    coef = jnp.where(ok, coef_chol, coef_pinv)
+    intercept = jnp.where(fit_intercept, y_mean - jnp.dot(x_mean, coef), 0.0)
+    return coef, intercept
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def predict_linear(x: jax.Array, coef: jax.Array, intercept, precision: str = "highest"):
+    return jnp.matmul(x, coef, precision=_dot_precision(precision)) + intercept
+
+
+@jax.jit
+def regression_metrics(y: jax.Array, pred: jax.Array, mask: jax.Array):
+    """(mse, rmse, mae, r2) over unmasked rows."""
+    n = jnp.sum(mask)
+    resid = (y - pred) * mask
+    sse = jnp.sum(resid * resid)
+    mse = sse / n
+    mae = jnp.sum(jnp.abs(resid)) / n
+    y_mean = jnp.sum(y * mask) / n
+    sst = jnp.sum(((y - y_mean) * mask) ** 2)
+    r2 = 1.0 - sse / jnp.where(sst > 0, sst, 1.0)
+    return mse, jnp.sqrt(mse), mae, r2

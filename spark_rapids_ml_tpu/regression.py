@@ -1,0 +1,8 @@
+"""Regression namespace — parity with ``org.apache.spark.ml.regression``."""
+
+from spark_rapids_ml_tpu.models.linear_regression import (
+    LinearRegression,
+    LinearRegressionModel,
+)
+
+__all__ = ["LinearRegression", "LinearRegressionModel"]

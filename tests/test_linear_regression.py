@@ -1,0 +1,171 @@
+"""LinearRegression/Ridge suite. Oracle: closed-form numpy OLS/ridge with
+Spark's standardization semantics, plus recovery of known ground-truth
+coefficients from noiseless synthetic data."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.core.data import DataFrame
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+from spark_rapids_ml_tpu.regression import LinearRegression, LinearRegressionModel
+
+
+def make_regression(rng, n=200, d=6, noise=0.0, intercept=2.5):
+    x = rng.normal(size=(n, d))
+    beta = rng.normal(size=d)
+    y = x @ beta + intercept + noise * rng.normal(size=n)
+    return x, y, beta, intercept
+
+
+def numpy_ridge(x, y, reg, fit_intercept=True, standardization=True):
+    """Spark WeightedLeastSquares semantics (see ops/linear.py docstring)."""
+    n = len(y)
+    if fit_intercept:
+        xm, ym = x.mean(0), y.mean()
+        xc, yc = x - xm, y - ym
+    else:
+        xm, ym = np.zeros(x.shape[1]), 0.0
+        xc, yc = x, y
+    a = xc.T @ xc
+    if standardization:
+        pen = np.diag(np.maximum(np.diag(a) / max(n - 1, 1), 0))
+    else:
+        pen = np.eye(x.shape[1])
+    coef = np.linalg.solve(a + n * reg * pen, xc.T @ yc)
+    b0 = ym - xm @ coef if fit_intercept else 0.0
+    return coef, b0
+
+
+class TestOLS:
+    def test_exact_recovery_noiseless(self, rng):
+        x, y, beta, b0 = make_regression(rng)
+        model = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(model.coefficients, beta, atol=1e-8)
+        assert model.intercept == pytest.approx(b0, abs=1e-8)
+
+    def test_no_intercept(self, rng):
+        x, y, beta, _ = make_regression(rng, intercept=0.0)
+        model = LinearRegression().setFitIntercept(False).fit((x, y))
+        np.testing.assert_allclose(model.coefficients, beta, atol=1e-8)
+        assert model.intercept == 0.0
+
+    def test_noisy_matches_numpy_lstsq(self, rng):
+        x, y, _, _ = make_regression(rng, noise=0.5)
+        model = LinearRegression().fit((x, y))
+        a = np.column_stack([x, np.ones(len(y))])
+        ref = np.linalg.lstsq(a, y, rcond=None)[0]
+        np.testing.assert_allclose(model.coefficients, ref[:-1], atol=1e-6)
+        assert model.intercept == pytest.approx(ref[-1], abs=1e-6)
+
+    def test_rank_deficient_falls_back(self, rng):
+        x = rng.normal(size=(50, 4))
+        x = np.column_stack([x, x[:, 0]])  # duplicated column -> singular
+        y = x[:, 0] * 2.0
+        model = LinearRegression().fit((x, y))
+        pred = model.predict(x)
+        np.testing.assert_allclose(pred, y, atol=1e-6)  # fits despite singularity
+
+
+class TestRidge:
+    @pytest.mark.parametrize("standardization", [True, False])
+    def test_matches_spark_semantics(self, rng, standardization):
+        x, y, _, _ = make_regression(rng, noise=1.0)
+        x = x * rng.uniform(0.1, 10.0, size=x.shape[1])  # heteroscale features
+        reg = 0.3
+        model = (
+            LinearRegression()
+            .setRegParam(reg)
+            .setStandardization(standardization)
+            .fit((x, y))
+        )
+        ref_coef, ref_b0 = numpy_ridge(x, y, reg, standardization=standardization)
+        np.testing.assert_allclose(model.coefficients, ref_coef, rtol=1e-6)
+        assert model.intercept == pytest.approx(ref_b0, rel=1e-6)
+
+    def test_regularization_shrinks(self, rng):
+        x, y, _, _ = make_regression(rng, noise=1.0)
+        m0 = LinearRegression().fit((x, y))
+        m1 = LinearRegression().setRegParam(10.0).fit((x, y))
+        assert np.linalg.norm(m1.coefficients) < np.linalg.norm(m0.coefficients)
+
+    def test_elasticnet_rejected(self, rng):
+        x, y, _, _ = make_regression(rng)
+        with pytest.raises(ValueError):
+            LinearRegression().setElasticNetParam(0.5).fit((x, y))
+
+    def test_negative_regparam_rejected(self):
+        with pytest.raises(ValueError):
+            LinearRegression().setRegParam(-1.0)
+
+
+class TestModelSurface:
+    def test_transform_dataframe(self, rng):
+        x, y, _, _ = make_regression(rng, n=50)
+        df = DataFrame({"features": list(x), "label": list(y)})
+        model = LinearRegression().fit(df)
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        np.testing.assert_allclose(np.asarray(out.select("prediction")), y, atol=1e-6)
+
+    def test_transform_pandas(self, rng):
+        import pandas as pd
+
+        x, y, _, _ = make_regression(rng, n=50, d=3)
+        df = pd.DataFrame(x, columns=["a", "b", "c"])
+        df["label"] = y
+        model = LinearRegression().fit(df)
+        out = model.transform(df)
+        np.testing.assert_allclose(out["prediction"], y, atol=1e-6)
+
+    def test_evaluate_metrics(self, rng):
+        x, y, _, _ = make_regression(rng, noise=0.5)
+        model = LinearRegression().fit((x, y))
+        m = model.evaluate((x, y))
+        pred = model.predict(x)
+        np.testing.assert_allclose(m["meanSquaredError"], ((y - pred) ** 2).mean(), rtol=1e-6)
+        assert 0.8 < m["r2"] <= 1.0
+        assert m["rootMeanSquaredError"] == pytest.approx(np.sqrt(m["meanSquaredError"]))
+
+    def test_read_write(self, tmp_path, rng):
+        x, y, _, _ = make_regression(rng)
+        model = LinearRegression().setRegParam(0.1).fit((x, y))
+        path = str(tmp_path / "lr")
+        model.save(path)
+        loaded = LinearRegressionModel.load(path)
+        np.testing.assert_allclose(loaded.coefficients, model.coefficients)
+        assert loaded.intercept == pytest.approx(model.intercept)
+        assert loaded.getRegParam() == pytest.approx(0.1)
+        np.testing.assert_allclose(loaded.predict(x), model.predict(x))
+
+
+class TestDistributed:
+    def test_mesh_fit_matches_local(self, rng):
+        mesh = make_mesh((8, 1))
+        x, y, beta, b0 = make_regression(rng, n=203)  # not divisible by 8
+        m_mesh = LinearRegression(mesh=mesh).fit((x, y))
+        np.testing.assert_allclose(m_mesh.coefficients, beta, atol=1e-7)
+        assert m_mesh.intercept == pytest.approx(b0, abs=1e-7)
+
+    def test_mesh_2d(self, rng):
+        mesh = make_mesh((4, 2))
+        x, y, beta, b0 = make_regression(rng, n=100, d=7)  # d=7 pads to 8
+        m = LinearRegression(mesh=mesh).fit((x, y))
+        np.testing.assert_allclose(m.coefficients, beta, atol=1e-7)
+
+
+class TestReviewRegressions:
+    def test_standardization_penalty_without_intercept(self, rng):
+        """fitIntercept=False must still penalize by TRUE feature variance,
+        not the raw second moment (features with mean >> std would otherwise
+        be shrunk ~(mean/std)^2 too hard)."""
+        x = rng.normal(size=(300, 4)) + 10.0  # mean 10, std 1
+        beta = rng.normal(size=4)
+        y = x @ beta
+        reg = 0.3
+        model = (
+            LinearRegression().setFitIntercept(False).setRegParam(reg).fit((x, y))
+        )
+        n = len(y)
+        var = x.var(axis=0, ddof=1)
+        ref = np.linalg.solve(x.T @ x + n * reg * np.diag(var), x.T @ y)
+        np.testing.assert_allclose(model.coefficients, ref, rtol=1e-6)
