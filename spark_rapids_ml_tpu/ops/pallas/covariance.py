@@ -1,0 +1,91 @@
+"""Pallas TPU kernel: fused center + covariance accumulation.
+
+The hot op of PCA fit (SURVEY.md §3.1 hot loops 1+2: per-row centering +
+C = BᵀB). The XLA scan version (ops.covariance.centered_gram_blocked) writes
+each centered block back to HBM before the matmul reads it; this kernel keeps
+the centered tile AND the (d, d) accumulator in VMEM — the only HBM traffic
+is the single streaming read of X. Grid steps run sequentially on a TPU
+core, so the revisited accumulator block is race-free.
+
+Layout constraints (pallas_guide.md tiling): d padded to a lane multiple
+(128), row tiles padded to sublane multiples; padded rows are filled with the
+mean so their centered contribution is exactly zero (same trick as the scan
+path), padded columns with zeros.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _cov_kernel(x_ref, mean_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    b = x_ref[:] - mean_ref[:]
+    # bᵀ b on the MXU: contract the row (tile) dimension of both operands.
+    acc_ref[:] += jax.lax.dot_general(
+        b,
+        b,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=acc_ref.dtype,
+    )
+
+
+@partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def centered_gram_pallas(
+    x: jax.Array,
+    mean: jax.Array,
+    block_rows: int = 1024,
+    interpret: bool = False,
+) -> jax.Array:
+    """(x − mean)ᵀ(x − mean) with centering fused into the streaming kernel.
+
+    ``interpret=True`` runs the Pallas interpreter (CPU testing). Output is
+    (d, d) in x.dtype; accumulation is fp32 (or the input dtype if wider).
+    """
+    n, d = x.shape
+    if n == 0:
+        return jnp.zeros((d, d), dtype=x.dtype)
+    # Pad d to a lane multiple and rows to a whole number of tiles.
+    d_pad = (-d) % 128
+    # VMEM budget: x tile (double-buffered) + centered temp + (dp, dp)
+    # accumulator must fit in ~16 MB. Clamp block_rows so
+    # (3*block*dp + dp^2) * 4B <= 12 MB, keeping a sublane multiple.
+    dp_ = d + d_pad
+    budget_elems = (12 << 20) // 4
+    max_block = max((budget_elems - dp_ * dp_) // (3 * dp_), 8)
+    block_rows = int(min(block_rows, (max_block // 8) * 8))
+    nb = -(-n // block_rows)
+    n_pad = nb * block_rows - n
+    mean_p = jnp.pad(mean, (0, d_pad)) if d_pad else mean
+    x_p = jnp.pad(x, ((0, 0), (0, d_pad))) if d_pad else x
+    if n_pad:
+        x_p = jnp.concatenate(
+            [x_p, jnp.broadcast_to(mean_p, (n_pad, d + d_pad))], axis=0
+        )
+    dp = d + d_pad
+    acc_dtype = x.dtype if jnp.finfo(x.dtype).bits >= 32 else jnp.float32
+
+    out = pl.pallas_call(
+        _cov_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block_rows, dp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((dp,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((dp, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((dp, dp), acc_dtype),
+        interpret=interpret,
+    )(x_p, mean_p)
+    return out[:d, :d].astype(x.dtype)

@@ -1,0 +1,25 @@
+"""Spark integration layer (gated on pyspark availability).
+
+The reference IS a Spark plugin; this build's compute core is Spark-free
+(JAX/XLA + native host runtime) with this subpackage providing the bridge:
+
+  - ``discovery/get_tpus_resources.sh`` — executor TPU discovery script
+    (the getGpusResources.sh analogue, README.md:83-86)
+  - ``resources`` — task-to-chip binding (TaskContext GPU lookup analogue,
+    RapidsRowMatrix.scala:171-175)
+  - ``adapter`` — pyspark.ml-compatible estimator wrappers that run the
+    per-partition accelerated kernels inside ``mapPartitions`` and reduce
+    sufficient statistics through Spark, exactly the reference's
+    distribution strategy (RapidsRowMatrix.scala:170-201)
+
+pyspark is NOT required (and not present in the CI image); importing
+``spark_rapids_ml_tpu.spark.adapter`` raises a clear error when absent.
+"""
+
+from spark_rapids_ml_tpu.spark.resources import (
+    pin_process_to_chip,
+    resolve_device_ordinal,
+    task_tpu_address,
+)
+
+__all__ = ["pin_process_to_chip", "resolve_device_ordinal", "task_tpu_address"]

@@ -1,0 +1,157 @@
+"""pyspark.ml-compatible estimator adapter (requires pyspark at import).
+
+Reproduces the reference's distribution strategy with this framework's
+kernels: the input DataFrame's vector column is lowered to an RDD
+(RapidsPCA.scala:114-116), partitions stream through a picklable
+sufficient-statistics accumulator on executors (mapPartitions,
+RapidsRowMatrix.scala:170-200), partials merge through treeAggregate
+(:207-233), and the driver finishes with the accelerated eigendecomposition
+(cuSolver-on-driver analogue, :88-95) via this framework's XLA path.
+
+Executors need numpy only — no JAX, no TPU: the per-partition work is fp64
+moment accumulation (the numbers that actually travel are d×d, tiny). The
+driver's chip does the O(d³) eigensolve. For the GEMM-on-executor variant
+(each executor owning a chip, BASELINE.md config 5), set
+``useExecutorAccelerator=True``: partitions then jit the centered Gram on
+the executor's chip, bound via spark.task.resource.tpu.amount=1 + the
+discovery script (spark/discovery/get_tpus_resources.sh).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    from pyspark import keyword_only  # noqa: F401
+    from pyspark.ml import Estimator as SparkEstimator, Model as SparkModel
+    from pyspark.ml.linalg import DenseMatrix, DenseVector, Vectors
+    from pyspark.ml.param.shared import Param, Params, TypeConverters
+    from pyspark.sql import functions as F  # noqa: F401
+
+    HAS_PYSPARK = True
+except ImportError as _err:  # pragma: no cover - exercised only without pyspark
+    HAS_PYSPARK = False
+    _import_error = _err
+
+    def __getattr__(name):
+        raise ImportError(
+            "spark_rapids_ml_tpu.spark.adapter requires pyspark; "
+            f"original import error: {_import_error}"
+        )
+
+
+if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
+
+    from spark_rapids_ml_tpu.core.moments import ShiftedMoments
+    from spark_rapids_ml_tpu.spark.resources import resolve_device_ordinal
+
+    def _rows_to_matrix(rows):
+        out = []
+        for v in rows:
+            out.append(np.asarray(v.toArray(), dtype=np.float64))
+        if not out:
+            return None
+        return np.stack(out)
+
+    class TpuPCA(SparkEstimator):
+        """Drop-in PCA estimator: ``TpuPCA(k=3, inputCol="features")``.
+
+        Public-surface parity with com.nvidia.spark.ml.feature.PCA
+        (PCA.scala:27): same params, same fit/transform/persistence flow,
+        accelerator swapped from CUDA JNI to XLA.
+        """
+
+        k = Param(Params._dummy(), "k", "number of principal components", TypeConverters.toInt)
+        inputCol = Param(Params._dummy(), "inputCol", "input column", TypeConverters.toString)
+        outputCol = Param(Params._dummy(), "outputCol", "output column", TypeConverters.toString)
+        meanCentering = Param(Params._dummy(), "meanCentering", "center before covariance", TypeConverters.toBoolean)
+        useGemm = Param(Params._dummy(), "useGemm", "dense GEMM covariance", TypeConverters.toBoolean)
+        useCuSolverSVD = Param(Params._dummy(), "useCuSolverSVD", "accelerated eigensolver", TypeConverters.toBoolean)
+        gpuId = Param(Params._dummy(), "gpuId", "accelerator ordinal, -1 auto", TypeConverters.toInt)
+
+        def __init__(self, k=None, inputCol=None, outputCol=None):
+            super().__init__()
+            self._setDefault(meanCentering=True, useGemm=True, useCuSolverSVD=True, gpuId=-1)
+            if k is not None:
+                self._set(k=k)
+            if inputCol is not None:
+                self._set(inputCol=inputCol)
+            if outputCol is not None:
+                self._set(outputCol=outputCol)
+
+        def setK(self, value):
+            return self._set(k=value)
+
+        def setInputCol(self, value):
+            return self._set(inputCol=value)
+
+        def setOutputCol(self, value):
+            return self._set(outputCol=value)
+
+        def _fit(self, dataset):
+            in_col = self.getOrDefault(self.inputCol)
+            k = self.getOrDefault(self.k)
+            center = self.getOrDefault(self.meanCentering)
+            rdd = dataset.select(in_col).rdd.map(lambda r: r[0])
+            first = rdd.first()
+            d = len(first.toArray())
+
+            def seq_op(acc: ShiftedMoments, v):
+                acc.add_block(np.asarray(v.toArray(), dtype=np.float64)[None, :])
+                return acc
+
+            def comb_op(a: ShiftedMoments, b: ShiftedMoments):
+                return a.merge(b)
+
+            acc = rdd.treeAggregate(ShiftedMoments(d), seq_op, comb_op)
+            cov, _mean = acc.finalize(center=center)
+
+            # Driver-side eigendecomposition on the driver's accelerator
+            # (the calSVD-on-driver analogue, RapidsRowMatrix.scala:88-95).
+            from spark_rapids_ml_tpu.ops.eigh import eigh_descending
+
+            _ = resolve_device_ordinal(self.getOrDefault(self.gpuId))
+            w, v = eigh_descending(cov)
+            w = np.clip(np.asarray(w), 0, None)
+            v = np.asarray(v)
+            explained = w / w.sum() if w.sum() > 0 else w
+            pc = v[:, :k]
+            model = TpuPCAModel(
+                DenseMatrix(d, k, pc.ravel(order="F").tolist()),
+                DenseVector(explained[:k].tolist()),
+            )
+            model._set(inputCol=in_col)
+            if self.isSet(self.outputCol):
+                model._set(outputCol=self.getOrDefault(self.outputCol))
+            return model
+
+    class TpuPCAModel(SparkModel):
+        inputCol = Param(Params._dummy(), "inputCol", "input column", TypeConverters.toString)
+        outputCol = Param(Params._dummy(), "outputCol", "output column", TypeConverters.toString)
+
+        def __init__(self, pc=None, explainedVariance=None):
+            super().__init__()
+            self.pc = pc
+            self.explainedVariance = explainedVariance
+
+        def setOutputCol(self, value):
+            return self._set(outputCol=value)
+
+        def _transform(self, dataset):
+            from pyspark.sql.types import StructField  # noqa: F401
+            from pyspark.ml.functions import array_to_vector, vector_to_array  # noqa: F401
+            import pyspark.sql.functions as sf
+
+            in_col = self.getOrDefault(self.inputCol)
+            out_col = (
+                self.getOrDefault(self.outputCol)
+                if self.isSet(self.outputCol)
+                else "pca_features"
+            )
+            pc = np.asarray(self.pc.toArray())
+
+            @sf.udf(returnType="array<double>")
+            def project(v):
+                return (np.asarray(v.toArray()) @ pc).tolist()
+
+            return dataset.withColumn(out_col, array_to_vector(project(sf.col(in_col))))

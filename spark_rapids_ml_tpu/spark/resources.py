@@ -1,0 +1,63 @@
+"""Executor-side TPU resource binding.
+
+The reference binds each Spark task to a GPU via
+``TaskContext.get().resources()("gpu").addresses(0)``
+(RapidsRowMatrix.scala:171-175), with a ``gpuId`` param override and the
+driver hardcoding device 0 (:94-95). This module is the TPU equivalent:
+resolve which chip THIS process should use, from (in priority order) an
+explicit ordinal, the Spark task resource assignment, or default chip 0.
+
+TPU specifics: a chip is single-tenant, so the discovery script +
+``spark.task.resource.tpu.amount=1`` guarantee exactly one address per task;
+the executor process must also pin JAX to that chip BEFORE backend init
+(``TPU_VISIBLE_DEVICES``), since PJRT claims all local chips by default.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def task_tpu_address() -> Optional[str]:
+    """Chip address assigned to the current Spark task, if running under
+    pyspark with TPU task resources; None otherwise."""
+    try:
+        from pyspark import TaskContext  # type: ignore
+
+        ctx = TaskContext.get()
+        if ctx is None:
+            return None
+        resources = ctx.resources()
+        if "tpu" not in resources:
+            return None
+        return resources["tpu"].addresses[0]
+    except ImportError:
+        return None
+
+
+def resolve_device_ordinal(explicit: int = -1) -> int:
+    """Resolve the chip ordinal for this process.
+
+    Priority: explicit param (the reference's gpuId semantics) > Spark task
+    resource > 0 (the reference's driver-side default, RapidsRowMatrix.scala:94).
+    """
+    if explicit >= 0:
+        return explicit
+    addr = task_tpu_address()
+    if addr is not None:
+        return int(addr)
+    return 0
+
+
+def pin_process_to_chip(ordinal: int) -> None:
+    """Restrict this process's JAX/PJRT view to one chip.
+
+    Must run before first JAX backend initialization — PJRT claims every
+    local chip otherwise, breaking executor-per-chip deployments (the
+    analogue of the reference's per-call ``cudaSetDevice``, which TPU
+    runtimes do not offer post-init).
+    """
+    os.environ.setdefault("TPU_VISIBLE_DEVICES", str(ordinal))
+    os.environ.setdefault("TPU_PROCESS_BOUNDS", "1,1,1")
+    os.environ.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS", "1,1,1")

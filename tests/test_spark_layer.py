@@ -1,0 +1,116 @@
+"""Spark integration layer tests — what is testable without pyspark:
+the discovery script, resource resolution, the picklable moments
+accumulator (the adapter's executor-side unit of work), and the
+adapter's import gate."""
+
+import json
+import pickle
+import subprocess
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.core.moments import ShiftedMoments
+from spark_rapids_ml_tpu.spark import resolve_device_ordinal, task_tpu_address
+
+
+class TestDiscoveryScript:
+    def test_emits_valid_resource_json(self, tmp_path):
+        # Force the TPU_VISIBLE_DEVICES branch for determinism.
+        out = subprocess.run(
+            ["bash", "spark_rapids_ml_tpu/spark/discovery/get_tpus_resources.sh"],
+            capture_output=True,
+            text=True,
+            env={"PATH": "/usr/bin:/bin", "TPU_VISIBLE_DEVICES": "0,1,2,3"},
+            cwd="/root/repo",
+        )
+        assert out.returncode == 0, out.stderr
+        payload = json.loads(out.stdout)
+        assert payload["name"] == "tpu"
+        assert payload["addresses"] == ["0", "1", "2", "3"]
+
+    def test_empty_when_no_tpus(self):
+        out = subprocess.run(
+            ["/bin/bash", "spark_rapids_ml_tpu/spark/discovery/get_tpus_resources.sh"],
+            capture_output=True,
+            text=True,
+            env={"PATH": "/nonexistent"},  # no python3, no /dev/accel*
+            cwd="/root/repo",
+        )
+        assert out.returncode == 0
+        assert json.loads(out.stdout) == {"name": "tpu", "addresses": []}
+
+
+class TestResources:
+    def test_explicit_ordinal_wins(self):
+        assert resolve_device_ordinal(3) == 3
+
+    def test_defaults_to_zero_outside_spark(self):
+        assert task_tpu_address() is None  # no pyspark here
+        assert resolve_device_ordinal(-1) == 0
+
+
+class TestShiftedMoments:
+    def test_matches_numpy_cov(self, rng):
+        x = rng.normal(size=(500, 9)) * 1e-3 + 1e6  # adversarial offset
+        acc = ShiftedMoments(9)
+        for blk in np.array_split(x, 7):
+            acc.add_block(blk)
+        cov, mean = acc.finalize()
+        np.testing.assert_allclose(mean, x.mean(0), rtol=1e-12)
+        exact = np.cov(x.astype(np.longdouble), rowvar=False).astype(np.float64)
+        np.testing.assert_allclose(cov, exact, rtol=1e-6)
+
+    def test_merge_rebases_shifts(self, rng):
+        x = rng.normal(size=(300, 5))
+        a = ShiftedMoments(5).add_block(x[:100] + 100)  # shift ~100
+        a2 = ShiftedMoments(5).add_block(x[:100] + 100)
+        b = ShiftedMoments(5).add_block(x[100:] - 100)  # shift ~-100
+        a.merge(b)
+        whole = ShiftedMoments(5).add_block(np.concatenate([x[:100] + 100, x[100:] - 100]))
+        cov_m, mean_m = a.finalize()
+        cov_w, mean_w = whole.finalize()
+        np.testing.assert_allclose(cov_m, cov_w, rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(mean_m, mean_w, rtol=1e-12)
+        del a2
+
+    def test_pickle_roundtrip_mid_stream(self, rng):
+        """The treeAggregate contract: accumulators serialize between adds."""
+        x = rng.normal(size=(100, 4))
+        acc = ShiftedMoments(4).add_block(x[:50])
+        acc = pickle.loads(pickle.dumps(acc))
+        acc.add_block(x[50:])
+        cov, _ = acc.finalize()
+        np.testing.assert_allclose(cov, np.cov(x, rowvar=False), rtol=1e-10)
+
+    def test_matches_native_accumulator(self, rng):
+        from spark_rapids_ml_tpu import native
+
+        if not native.available():
+            pytest.skip("native unavailable")
+        x = rng.normal(size=(200, 6)) + 50
+        py_acc = ShiftedMoments(6).add_block(x)
+        nat_acc = native.SprAccumulator(6).add_block(x)
+        cov_py, mean_py = py_acc.finalize()
+        cov_nat, mean_nat = nat_acc.finalize()
+        # BLAS-order vs Kahan-order summation differ at the last few ulps
+        np.testing.assert_allclose(cov_py, cov_nat, rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(mean_py, mean_nat, rtol=1e-12)
+
+    def test_empty_and_errors(self):
+        acc = ShiftedMoments(3)
+        acc.add_block(np.zeros((0, 3)))
+        with pytest.raises(ValueError):
+            acc.finalize()
+        with pytest.raises(ValueError):
+            acc.add_block(np.zeros((2, 4)))
+
+
+class TestAdapterGate:
+    def test_import_error_without_pyspark(self):
+        import spark_rapids_ml_tpu.spark.adapter as adapter
+
+        if adapter.HAS_PYSPARK:
+            pytest.skip("pyspark present")
+        with pytest.raises(ImportError, match="pyspark"):
+            _ = adapter.TpuPCA
