@@ -135,6 +135,8 @@ def covariance_dd(x: np.ndarray, chunk: int = 2048) -> Tuple[np.ndarray, np.ndar
     route here via ops selection when x64 inputs demand it).
     """
     x = np.asarray(x, dtype=np.float64)
+    if x.shape[0] < 2:
+        raise ValueError("need at least 2 rows to compute a covariance")
     mean = x.mean(axis=0)
     gram = centered_gram_dd(x, mean, chunk=chunk)
     return mean, gram / (x.shape[0] - 1)

@@ -46,6 +46,22 @@ def eigh_descending(a: jax.Array):
     return w, sign_flip(v)
 
 
+def eigh_descending_host(a):
+    """Host (NumPy/LAPACK) fallback with the same contract as
+    :func:`eigh_descending` — the reference's driver-CPU breeze-SVD branch
+    (RapidsRowMatrix.scala:110-123), for callers that opt out of the
+    accelerator (``useCuSolverSVD=False``)."""
+    import numpy as np
+
+    w, v = np.linalg.eigh(np.asarray(a, dtype=np.float64))
+    w = w[::-1]
+    v = v[:, ::-1]
+    idx = np.argmax(np.abs(v), axis=0)
+    pivot = v[idx, np.arange(v.shape[1])]
+    v = v * np.where(pivot < 0, -1.0, 1.0)[None, :]
+    return w, v
+
+
 @jax.jit
 def cal_svd(a: jax.Array):
     """SVD of a symmetric PSD matrix via eigendecomposition.

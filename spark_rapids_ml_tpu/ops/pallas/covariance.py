@@ -34,11 +34,15 @@ def _cov_kernel(x_ref, mean_ref, acc_ref):
 
     b = x_ref[:] - mean_ref[:]
     # bᵀ b on the MXU: contract the row (tile) dimension of both operands.
+    # precision=HIGHEST: without it f32 operands take the single-pass bf16
+    # MXU route on real hardware (~1e-3 relative error), far below the
+    # 1e-5 oracle bar — and invisible to interpret-mode tests.
     acc_ref[:] += jax.lax.dot_general(
         b,
         b,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=acc_ref.dtype,
+        precision=jax.lax.Precision.HIGHEST,
     )
 
 
@@ -64,8 +68,16 @@ def centered_gram_pallas(
     # (3*block*dp + dp^2) * 4B <= 12 MB, keeping a sublane multiple.
     dp_ = d + d_pad
     budget_elems = (12 << 20) // 4
-    max_block = max((budget_elems - dp_ * dp_) // (3 * dp_), 8)
-    block_rows = int(min(block_rows, (max_block // 8) * 8))
+    max_block = (budget_elems - dp_ * dp_) // (3 * dp_)
+    if max_block < 8:
+        raise ValueError(
+            f"d={d} needs a ({dp_}, {dp_}) VMEM accumulator that exceeds the "
+            "~16 MB VMEM budget; use ops.covariance.centered_gram_blocked"
+        )
+    # Sublane alignment applies to the user-passed tile size too, not just
+    # the VMEM clamp — Mosaic rejects non-final block tiles that are not a
+    # multiple of 8 rows.
+    block_rows = max(8, (int(min(block_rows, max_block)) // 8) * 8)
     nb = -(-n // block_rows)
     n_pad = nb * block_rows - n
     mean_p = jnp.pad(mean, (0, d_pad)) if d_pad else mean

@@ -9,12 +9,15 @@ RapidsRowMatrix.scala:170-200), partials merge through treeAggregate
 (cuSolver-on-driver analogue, :88-95) via this framework's XLA path.
 
 Executors need numpy only — no JAX, no TPU: the per-partition work is fp64
-moment accumulation (the numbers that actually travel are d×d, tiny). The
-driver's chip does the O(d³) eigensolve. For the GEMM-on-executor variant
-(each executor owning a chip, BASELINE.md config 5), set
-``useExecutorAccelerator=True``: partitions then jit the centered Gram on
-the executor's chip, bound via spark.task.resource.tpu.amount=1 + the
-discovery script (spark/discovery/get_tpus_resources.sh).
+moment accumulation in row batches (the numbers that actually travel are
+d×d, tiny). The driver finishes with the eigendecomposition: on the chip
+resolved from ``gpuId``/task resources when ``useCuSolverSVD=True`` (the
+calSVD-on-driver analogue), or NumPy on the driver CPU when False (the
+reference's breeze-SVD fallback, RapidsRowMatrix.scala:110-123).
+``useGemm`` is accepted for parity and recorded in params; both covariance
+routes share the one streaming accumulator here (the reference's spr/gemm
+split reflected a cuBLAS API choice with no TPU analogue — both its paths
+produce the same covariance, RapidsRowMatrix.scala:149-257).
 """
 
 from __future__ import annotations
@@ -43,17 +46,10 @@ except ImportError as _err:  # pragma: no cover - exercised only without pyspark
 if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
 
     from spark_rapids_ml_tpu.core.moments import ShiftedMoments
+    from spark_rapids_ml_tpu.core.persistence import MLReadable
     from spark_rapids_ml_tpu.spark.resources import resolve_device_ordinal
 
-    def _rows_to_matrix(rows):
-        out = []
-        for v in rows:
-            out.append(np.asarray(v.toArray(), dtype=np.float64))
-        if not out:
-            return None
-        return np.stack(out)
-
-    class TpuPCA(SparkEstimator):
+    class TpuPCA(SparkEstimator, MLReadable):
         """Drop-in PCA estimator: ``TpuPCA(k=3, inputCol="features")``.
 
         Public-surface parity with com.nvidia.spark.ml.feature.PCA
@@ -88,6 +84,32 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
         def setOutputCol(self, value):
             return self._set(outputCol=value)
 
+        def setMeanCentering(self, value):
+            return self._set(meanCentering=value)
+
+        def setUseGemm(self, value):
+            return self._set(useGemm=value)
+
+        def setUseCuSolverSVD(self, value):
+            return self._set(useCuSolverSVD=value)
+
+        def setGpuId(self, value):
+            return self._set(gpuId=value)
+
+        @classmethod
+        def load(cls, path):
+            # Overrides MLReadable.load: pyspark's Param typeConverter API
+            # differs from the core Params', so values are set by name.
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            metadata = P.load_metadata(path, expected_class="TpuPCA")
+            est = cls()
+            for source in (metadata.get("defaultParamMap", {}), metadata.get("paramMap", {})):
+                for name, value in source.items():
+                    if est.hasParam(name):
+                        est._set(**{name: value})
+            return est
+
         def _fit(self, dataset):
             in_col = self.getOrDefault(self.inputCol)
             k = self.getOrDefault(self.k)
@@ -96,22 +118,49 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             first = rdd.first()
             d = len(first.toArray())
 
-            def seq_op(acc: ShiftedMoments, v):
-                acc.add_block(np.asarray(v.toArray(), dtype=np.float64)[None, :])
-                return acc
+            def part_op(rows):
+                # Batch rows before the rank-b update: one numpy GEMM per
+                # batch instead of a Python call + (1,d) outer product per
+                # row (the mapPartitions block streaming of
+                # RapidsRowMatrix.scala:170-200).
+                acc = ShiftedMoments(d)
+                batch = []
+                for v in rows:
+                    batch.append(np.asarray(v.toArray(), dtype=np.float64))
+                    if len(batch) >= 4096:
+                        acc.add_block(np.stack(batch))
+                        batch = []
+                if batch:
+                    acc.add_block(np.stack(batch))
+                return [acc]
 
-            def comb_op(a: ShiftedMoments, b: ShiftedMoments):
-                return a.merge(b)
-
-            acc = rdd.treeAggregate(ShiftedMoments(d), seq_op, comb_op)
+            acc = rdd.mapPartitions(part_op).treeReduce(lambda a, b: a.merge(b))
             cov, _mean = acc.finalize(center=center)
 
-            # Driver-side eigendecomposition on the driver's accelerator
-            # (the calSVD-on-driver analogue, RapidsRowMatrix.scala:88-95).
-            from spark_rapids_ml_tpu.ops.eigh import eigh_descending
+            # Driver-side eigendecomposition (the calSVD-on-driver analogue,
+            # RapidsRowMatrix.scala:88-95) on the chip gpuId/task resources
+            # resolve to, or the NumPy fallback path when useCuSolverSVD is
+            # off (the breeze-SVD branch, RapidsRowMatrix.scala:110-123).
+            # Without x64, jit would silently truncate the carefully
+            # accumulated fp64 covariance to f32 — use the host path then.
+            import jax
 
-            _ = resolve_device_ordinal(self.getOrDefault(self.gpuId))
-            w, v = eigh_descending(cov)
+            if self.getOrDefault(self.useCuSolverSVD) and jax.config.jax_enable_x64:
+                from spark_rapids_ml_tpu.ops.eigh import eigh_descending
+
+                ordinal = resolve_device_ordinal(self.getOrDefault(self.gpuId))
+                devices = jax.devices()
+                if ordinal >= len(devices):
+                    raise ValueError(
+                        f"gpuId/task resource resolved to chip {ordinal}, but only "
+                        f"{len(devices)} device(s) are visible"
+                    )
+                with jax.default_device(devices[ordinal]):
+                    w, v = eigh_descending(cov)
+            else:
+                from spark_rapids_ml_tpu.ops.eigh import eigh_descending_host
+
+                w, v = eigh_descending_host(cov)
             w = np.clip(np.asarray(w), 0, None)
             v = np.asarray(v)
             explained = w / w.sum() if w.sum() > 0 else w
@@ -125,7 +174,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 model._set(outputCol=self.getOrDefault(self.outputCol))
             return model
 
-    class TpuPCAModel(SparkModel):
+    class TpuPCAModel(SparkModel, MLReadable):
         inputCol = Param(Params._dummy(), "inputCol", "input column", TypeConverters.toString)
         outputCol = Param(Params._dummy(), "outputCol", "output column", TypeConverters.toString)
 
@@ -138,9 +187,8 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             return self._set(outputCol=value)
 
         def _transform(self, dataset):
-            from pyspark.sql.types import StructField  # noqa: F401
-            from pyspark.ml.functions import array_to_vector, vector_to_array  # noqa: F401
-            import pyspark.sql.functions as sf
+            from pyspark.ml.functions import array_to_vector, vector_to_array
+            from pyspark.sql.functions import col, pandas_udf
 
             in_col = self.getOrDefault(self.inputCol)
             out_col = (
@@ -150,8 +198,56 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             )
             pc = np.asarray(self.pc.toArray())
 
-            @sf.udf(returnType="array<double>")
-            def project(v):
-                return (np.asarray(v.toArray()) @ pc).tolist()
+            # Vectorized batch projection (one NumPy GEMM per Arrow batch) —
+            # the working version of the reference's disabled GPU batch
+            # transform (RapidsPCA.scala:172-185); a per-row scalar UDF would
+            # pay a pickle round-trip + Python call per row.
+            @pandas_udf("array<double>")
+            def project(series):
+                import pandas as pd
 
-            return dataset.withColumn(out_col, array_to_vector(project(sf.col(in_col))))
+                block = np.stack([np.asarray(v, dtype=np.float64) for v in series])
+                return pd.Series(list(block @ pc))
+
+            return dataset.withColumn(
+                out_col, array_to_vector(project(vector_to_array(col(in_col))))
+            )
+
+        def _save_impl(self, path):
+            # Reference on-disk layout (RapidsPCA.scala:207-255): params JSON
+            # under metadata/, single-row parquet of (pc, explainedVariance)
+            # under data/ — via the same writers the core models use.
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name="TpuPCAModel")
+            P.save_data(
+                path,
+                {
+                    "pc": ("matrix", np.asarray(self.pc.toArray())),
+                    "explainedVariance": (
+                        "vector",
+                        np.asarray(self.explainedVariance.toArray()),
+                    ),
+                },
+            )
+
+        @classmethod
+        def load(cls, path):
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            metadata = P.load_metadata(path, expected_class="TpuPCAModel")
+            data = P.load_data(path)
+            pc = np.asarray(data["pc"])
+            ev = np.asarray(data["explainedVariance"])
+            model = cls(
+                DenseMatrix(pc.shape[0], pc.shape[1], pc.ravel(order="F").tolist()),
+                DenseVector(ev.tolist()),
+            )
+            # pyspark Param values set by name (pyspark's typeConverter API
+            # differs from the core Params', so core get_and_set_params does
+            # not apply here).
+            for source in (metadata.get("defaultParamMap", {}), metadata.get("paramMap", {})):
+                for name, value in source.items():
+                    if model.hasParam(name):
+                        model._set(**{name: value})
+            return model

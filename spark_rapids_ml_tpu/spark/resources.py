@@ -58,6 +58,10 @@ def pin_process_to_chip(ordinal: int) -> None:
     analogue of the reference's per-call ``cudaSetDevice``, which TPU
     runtimes do not offer post-init).
     """
-    os.environ.setdefault("TPU_VISIBLE_DEVICES", str(ordinal))
-    os.environ.setdefault("TPU_PROCESS_BOUNDS", "1,1,1")
-    os.environ.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS", "1,1,1")
+    # Unconditional assignment: the platform often pre-exports
+    # TPU_VISIBLE_DEVICES with ALL local chips (that very value is what the
+    # discovery script enumerates) — setdefault would keep it and this
+    # process would claim every single-tenant chip on the host.
+    os.environ["TPU_VISIBLE_DEVICES"] = str(ordinal)
+    os.environ["TPU_PROCESS_BOUNDS"] = "1,1,1"
+    os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"

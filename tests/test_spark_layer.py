@@ -4,25 +4,33 @@ accumulator (the adapter's executor-side unit of work), and the
 adapter's import gate."""
 
 import json
+import os
 import pickle
 import subprocess
 
 import numpy as np
 import pytest
 
+import spark_rapids_ml_tpu
 from spark_rapids_ml_tpu.core.moments import ShiftedMoments
 from spark_rapids_ml_tpu.spark import resolve_device_ordinal, task_tpu_address
+
+_DISCOVERY_SCRIPT = os.path.join(
+    os.path.dirname(spark_rapids_ml_tpu.__file__),
+    "spark",
+    "discovery",
+    "get_tpus_resources.sh",
+)
 
 
 class TestDiscoveryScript:
     def test_emits_valid_resource_json(self, tmp_path):
         # Force the TPU_VISIBLE_DEVICES branch for determinism.
         out = subprocess.run(
-            ["bash", "spark_rapids_ml_tpu/spark/discovery/get_tpus_resources.sh"],
+            ["bash", _DISCOVERY_SCRIPT],
             capture_output=True,
             text=True,
             env={"PATH": "/usr/bin:/bin", "TPU_VISIBLE_DEVICES": "0,1,2,3"},
-            cwd="/root/repo",
         )
         assert out.returncode == 0, out.stderr
         payload = json.loads(out.stdout)
@@ -31,11 +39,10 @@ class TestDiscoveryScript:
 
     def test_empty_when_no_tpus(self):
         out = subprocess.run(
-            ["/bin/bash", "spark_rapids_ml_tpu/spark/discovery/get_tpus_resources.sh"],
+            ["/bin/bash", _DISCOVERY_SCRIPT],
             capture_output=True,
             text=True,
             env={"PATH": "/nonexistent"},  # no python3, no /dev/accel*
-            cwd="/root/repo",
         )
         assert out.returncode == 0
         assert json.loads(out.stdout) == {"name": "tpu", "addresses": []}
@@ -64,7 +71,6 @@ class TestShiftedMoments:
     def test_merge_rebases_shifts(self, rng):
         x = rng.normal(size=(300, 5))
         a = ShiftedMoments(5).add_block(x[:100] + 100)  # shift ~100
-        a2 = ShiftedMoments(5).add_block(x[:100] + 100)
         b = ShiftedMoments(5).add_block(x[100:] - 100)  # shift ~-100
         a.merge(b)
         whole = ShiftedMoments(5).add_block(np.concatenate([x[:100] + 100, x[100:] - 100]))
@@ -72,7 +78,6 @@ class TestShiftedMoments:
         cov_w, mean_w = whole.finalize()
         np.testing.assert_allclose(cov_m, cov_w, rtol=1e-9, atol=1e-12)
         np.testing.assert_allclose(mean_m, mean_w, rtol=1e-12)
-        del a2
 
     def test_pickle_roundtrip_mid_stream(self, rng):
         """The treeAggregate contract: accumulators serialize between adds."""
