@@ -20,9 +20,12 @@ GEMM plus ~0.1 s for syevd at d=1024.
 from __future__ import annotations
 
 import json
-import time
+import os
+import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 N_ROWS = 1_000_000
 N_COLS = 1024
@@ -53,15 +56,13 @@ def main() -> None:
     x = jax.random.normal(jax.random.key(7), (N_ROWS, N_COLS), dtype=jnp.float32)
     float(jnp.sum(x[0]))  # materialize input before timing
 
-    def run_once() -> float:
-        t0 = time.perf_counter()
+    from benchmarks.common import time_median
+
+    def run() -> None:
         pc, ev = fit(x)
         float(ev[0])  # sync: force the computation to complete
-        return time.perf_counter() - t0
 
-    run_once()  # warmup: compile
-    times = sorted(run_once() for _ in range(3))
-    elapsed = times[len(times) // 2]
+    elapsed = time_median(run)
     rows_per_sec = N_ROWS / elapsed
 
     print(

@@ -1,0 +1,34 @@
+"""Shared benchmark harness.
+
+Each config script prints ONE JSON line (same shape as bench.py). Data is
+generated on-device: this environment reaches the TPU through a slow relay
+tunnel, so host->device transfer would measure the tunnel, not the framework
+(bench.py docstring). Timing is median-of-3 after a compile warmup.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+
+def time_median(fn: Callable[[], None], repeats: int = 3) -> float:
+    """Median wall-clock of ``fn`` over ``repeats`` runs (after 1 warmup)."""
+    fn()  # warmup: compile
+    times = sorted(_timed(fn) for _ in range(repeats))
+    return times[len(times) // 2]
+
+
+def _timed(fn: Callable[[], None]) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float | None = None, **extra) -> None:
+    rec = {"metric": metric, "value": round(value, 3), "unit": unit}
+    if vs_baseline is not None:
+        rec["vs_baseline"] = round(vs_baseline, 3)
+    rec.update(extra)
+    print(json.dumps(rec))

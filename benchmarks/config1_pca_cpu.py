@@ -1,0 +1,32 @@
+"""BASELINE config 1: PCA k=3 on 10k x 50 synthetic vectors, CPU path.
+
+The correctness floor (no accelerator): the packed/spr-layout covariance with
+host SVD — the analogue of the reference's useGemm=false, useCuSolverSVD=false
+fallback (RapidsRowMatrix.scala:202-251, :110-123). Run with
+``JAX_PLATFORMS=cpu`` (run_all.py does).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from common import emit, time_median
+
+
+def main() -> None:
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(10_000, 50))
+
+    est = PCA().setK(3).setInputCol("features").setUseGemm(False).setUseCuSolverSVD(False)
+
+    def run() -> None:
+        est.fit(x)
+
+    elapsed = time_median(run)
+    emit("pca_fit_cpu_10kx50_k3", 10_000 / elapsed, "rows/s", wall_s=round(elapsed, 4))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""BASELINE config 4: LinearRegression/Ridge on HIGGS-shaped 11M x 28.
+
+Synthetic data at the HIGGS shape (zero-egress image: no dataset download).
+Measures the normal-equation path: XtX/Xty sufficient-statistics GEMM on
+the chip + tiny host solve.
+"""
+
+from __future__ import annotations
+
+from common import emit, time_median
+
+N, D = 11_000_000, 28
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.linear import normal_eq_stats, solve_normal
+
+    key = jax.random.key(4)
+    kx, kw, ke = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (N, D), dtype=jnp.float32)
+    w_true = jax.random.normal(kw, (D,), dtype=jnp.float32)
+    y = x @ w_true + 0.1 * jax.random.normal(ke, (N,), dtype=jnp.float32)
+    float(jnp.sum(x[0]))
+    mask = jnp.ones(N, dtype=jnp.float32)
+
+    def run() -> None:
+        xtx, xty, x_sum, y_sum, yty, count = normal_eq_stats(x, y, mask)
+        coef, intercept = solve_normal(
+            xtx, xty, x_sum, y_sum, count, reg_param=0.1, fit_intercept=True,
+            standardization=True,
+        )
+        float(coef[0])
+
+    elapsed = time_median(run)
+    emit("linreg_normal_11Mx28_ridge", N / elapsed, "rows/s", wall_s=round(elapsed, 4))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Run every BASELINE config script; collect the JSON lines.
+
+Config 1 runs on the CPU platform (it IS the no-accelerator floor); the rest
+run on whatever accelerator the environment provides. Each config runs in a
+fresh subprocess so platform selection and compile caches don't interact.
+
+Usage: python benchmarks/run_all.py [--only N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+CONFIGS = [
+    ("config1_pca_cpu.py", {"JAX_PLATFORMS": "cpu"}),
+    ("config2_pca_chip.py", {}),
+    ("config3_kmeans.py", {}),
+    ("config4_linreg.py", {}),
+    ("config5_pca_distributed.py", {}),
+]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--only", type=int, default=None, help="run a single config (1-5)")
+    args = parser.parse_args()
+
+    results_path = os.path.join(HERE, "results.json")
+    results: dict[str, dict] = {}
+    if os.path.exists(results_path):
+        with open(results_path) as f:
+            results = {rec["metric"]: rec for rec in json.load(f)}
+
+    failed = False
+    for i, (script, env_over) in enumerate(CONFIGS, start=1):
+        if args.only is not None and i != args.only:
+            continue
+        env = dict(os.environ)
+        env.update(env_over)
+        repo_root = os.path.dirname(HERE)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, script)],
+            env=env,
+            capture_output=True,
+            text=True,
+            cwd=HERE,
+        )
+        line = None
+        for out_line in proc.stdout.splitlines():
+            try:
+                line = json.loads(out_line)
+            except json.JSONDecodeError:
+                continue
+        if line is None:
+            print(f"config {i} FAILED:\n{proc.stdout}\n{proc.stderr}", file=sys.stderr)
+            failed = True
+        else:
+            print(json.dumps(line))
+            results[line["metric"]] = line
+
+    if results:
+        with open(results_path, "w") as f:
+            json.dump(list(results.values()), f, indent=2)
+    if failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
