@@ -2,15 +2,28 @@
 
 The correctness floor (no accelerator): the packed/spr-layout covariance with
 host SVD — the analogue of the reference's useGemm=false, useCuSolverSVD=false
-fallback (RapidsRowMatrix.scala:202-251, :110-123). Run with
-``JAX_PLATFORMS=cpu`` (run_all.py does).
+fallback (RapidsRowMatrix.scala:202-251, :110-123). This config IS the
+no-accelerator floor, so it pins the CPU platform itself (env var alone is
+not enough — interpreter-level site customization may have imported jax
+already; both the env var and the config update are needed, the same
+pattern as tests/conftest.py).
 """
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
-from common import emit, time_median
+from benchmarks.common import emit, time_median
 
 
 def main() -> None:

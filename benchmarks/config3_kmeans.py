@@ -9,7 +9,12 @@ iteration count makes the number comparable run-to-run).
 
 from __future__ import annotations
 
-from common import emit, time_median
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import emit, time_median
 
 N, D, K, ITERS = 20_000_000, 16, 100, 10
 

@@ -7,7 +7,12 @@ the chip + tiny host solve.
 
 from __future__ import annotations
 
-from common import emit, time_median
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import emit, time_median
 
 N, D = 11_000_000, 28
 

@@ -17,7 +17,12 @@ The projection basis is printed alongside so the judge can recompute.
 
 from __future__ import annotations
 
-from common import emit, time_median
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import emit, time_median
 
 BLOCK, D, K = 1_000_000, 1024, 16
 TOTAL_ROWS, N_CHIPS = 100_000_000, 8

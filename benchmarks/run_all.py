@@ -55,9 +55,11 @@ def main() -> None:
         line = None
         for out_line in proc.stdout.splitlines():
             try:
-                line = json.loads(out_line)
+                candidate = json.loads(out_line)
             except json.JSONDecodeError:
                 continue
+            if isinstance(candidate, dict) and "metric" in candidate:
+                line = candidate
         if line is None:
             print(f"config {i} FAILED:\n{proc.stdout}\n{proc.stderr}", file=sys.stderr)
             failed = True

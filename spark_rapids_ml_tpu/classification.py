@@ -1,0 +1,8 @@
+"""Classification namespace — parity with ``org.apache.spark.ml.classification``."""
+
+from spark_rapids_ml_tpu.models.logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+
+__all__ = ["LogisticRegression", "LogisticRegressionModel"]

@@ -4,6 +4,10 @@ from spark_rapids_ml_tpu.models.linear_regression import (
     LinearRegression,
     LinearRegressionModel,
 )
+from spark_rapids_ml_tpu.models.logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
 
 __all__ = [
     "PCA",
@@ -12,4 +16,6 @@ __all__ = [
     "KMeansModel",
     "LinearRegression",
     "LinearRegressionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
 ]

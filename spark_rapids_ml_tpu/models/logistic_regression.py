@@ -1,0 +1,401 @@
+"""LogisticRegression estimator/model — Spark ML surface, L-BFGS on the MXU.
+
+Param surface mirrors ``org.apache.spark.ml.classification.LogisticRegression``:
+``featuresCol``, ``labelCol``, ``predictionCol``, ``probabilityCol``,
+``rawPredictionCol``, ``maxIter``, ``regParam``, ``elasticNetParam`` (must be
+0 — L2 only, like this framework's normal-equation LinearRegression),
+``tol``, ``fitIntercept``, ``standardization``, ``family``
+("auto" | "binomial" | "multinomial"), ``threshold``. Beyond-the-reference
+capability (the reference ships only PCA — SURVEY.md §2); the whole
+optimization is one jitted L-BFGS program (ops.logistic), mesh-shardable.
+
+Model attributes follow Spark: binomial exposes ``coefficients`` (d,) and
+``intercept``; multinomial exposes ``coefficientMatrix`` (numClasses, d) and
+``interceptVector`` (numClasses,).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix
+from spark_rapids_ml_tpu.core.estimator import Estimator, Model
+from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toInt, toString
+from spark_rapids_ml_tpu.core.persistence import (
+    MLReadable,
+    get_and_set_params,
+    load_data,
+    load_metadata,
+    save_data,
+    save_metadata,
+)
+from spark_rapids_ml_tpu.models.linear_regression import _extract_xy
+from spark_rapids_ml_tpu.ops.logistic import (
+    classification_metrics,
+    fit_logistic,
+    predict_logistic,
+)
+from spark_rapids_ml_tpu.parallel.mesh import shard_rows
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+class _LogisticRegressionParams(Params):
+    featuresCol = Param("_", "featuresCol", "features column name", toString)
+    labelCol = Param("_", "labelCol", "label column name", toString)
+    predictionCol = Param("_", "predictionCol", "prediction column name", toString)
+    probabilityCol = Param("_", "probabilityCol", "class probabilities column", toString)
+    rawPredictionCol = Param("_", "rawPredictionCol", "raw logits column", toString)
+    maxIter = Param("_", "maxIter", "maximum L-BFGS iterations", toInt)
+    regParam = Param("_", "regParam", "L2 regularization strength", toFloat)
+    elasticNetParam = Param("_", "elasticNetParam", "L1/L2 mixing (0 = pure L2)", toFloat)
+    tol = Param("_", "tol", "gradient-norm convergence tolerance", toFloat)
+    fitIntercept = Param("_", "fitIntercept", "whether to fit an intercept", toBoolean)
+    standardization = Param(
+        "_", "standardization", "optimize in standardized feature space", toBoolean
+    )
+    family = Param("_", "family", "auto, binomial, or multinomial", toString)
+    threshold = Param("_", "threshold", "binary decision threshold", toFloat)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(
+            featuresCol="features",
+            labelCol="label",
+            predictionCol="prediction",
+            probabilityCol="probability",
+            rawPredictionCol="rawPrediction",
+            maxIter=100,
+            regParam=0.0,
+            elasticNetParam=0.0,
+            tol=1e-6,
+            fitIntercept=True,
+            standardization=True,
+            family="auto",
+            threshold=0.5,
+        )
+
+    def getFeaturesCol(self) -> str:
+        return self.getOrDefault(self.featuresCol)
+
+    def getLabelCol(self) -> str:
+        return self.getOrDefault(self.labelCol)
+
+    def getPredictionCol(self) -> str:
+        return self.getOrDefault(self.predictionCol)
+
+    def getProbabilityCol(self) -> str:
+        return self.getOrDefault(self.probabilityCol)
+
+    def getRawPredictionCol(self) -> str:
+        return self.getOrDefault(self.rawPredictionCol)
+
+    def getMaxIter(self) -> int:
+        return self.getOrDefault(self.maxIter)
+
+    def getRegParam(self) -> float:
+        return self.getOrDefault(self.regParam)
+
+    def getElasticNetParam(self) -> float:
+        return self.getOrDefault(self.elasticNetParam)
+
+    def getTol(self) -> float:
+        return self.getOrDefault(self.tol)
+
+    def getFitIntercept(self) -> bool:
+        return self.getOrDefault(self.fitIntercept)
+
+    def getStandardization(self) -> bool:
+        return self.getOrDefault(self.standardization)
+
+    def getFamily(self) -> str:
+        return self.getOrDefault(self.family)
+
+    def getThreshold(self) -> float:
+        return self.getOrDefault(self.threshold)
+
+
+class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
+    """``LogisticRegression().setRegParam(0.1).fit((X, y))``."""
+
+    def __init__(self, uid: Optional[str] = None, mesh=None):
+        super().__init__(uid)
+        self.mesh = mesh
+
+    def setFeaturesCol(self, value: str) -> "LogisticRegression":
+        self.set(self.featuresCol, value)
+        return self
+
+    def setLabelCol(self, value: str) -> "LogisticRegression":
+        self.set(self.labelCol, value)
+        return self
+
+    def setPredictionCol(self, value: str) -> "LogisticRegression":
+        self.set(self.predictionCol, value)
+        return self
+
+    def setProbabilityCol(self, value: str) -> "LogisticRegression":
+        self.set(self.probabilityCol, value)
+        return self
+
+    def setRawPredictionCol(self, value: str) -> "LogisticRegression":
+        self.set(self.rawPredictionCol, value)
+        return self
+
+    def setMaxIter(self, value: int) -> "LogisticRegression":
+        self.set(self.maxIter, value)
+        return self
+
+    def setRegParam(self, value: float) -> "LogisticRegression":
+        if value < 0:
+            raise ValueError(f"regParam must be >= 0, got {value}")
+        self.set(self.regParam, value)
+        return self
+
+    def setElasticNetParam(self, value: float) -> "LogisticRegression":
+        self.set(self.elasticNetParam, value)
+        return self
+
+    def setTol(self, value: float) -> "LogisticRegression":
+        self.set(self.tol, value)
+        return self
+
+    def setFitIntercept(self, value: bool) -> "LogisticRegression":
+        self.set(self.fitIntercept, value)
+        return self
+
+    def setStandardization(self, value: bool) -> "LogisticRegression":
+        self.set(self.standardization, value)
+        return self
+
+    def setFamily(self, value: str) -> "LogisticRegression":
+        if value not in ("auto", "binomial", "multinomial"):
+            raise ValueError(f"family must be auto/binomial/multinomial, got {value!r}")
+        self.set(self.family, value)
+        return self
+
+    def setThreshold(self, value: float) -> "LogisticRegression":
+        self.set(self.threshold, value)
+        return self
+
+    def setMesh(self, mesh) -> "LogisticRegression":
+        self.mesh = mesh
+        return self
+
+    def fit(self, dataset: Any) -> "LogisticRegressionModel":
+        if self.getElasticNetParam() != 0.0:
+            raise ValueError("only L2 supported (elasticNetParam must be 0)")
+        x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
+        y_int = y_host.astype(np.int64)
+        if not np.array_equal(y_int, y_host):
+            raise ValueError("labels must be integers in [0, numClasses)")
+        if np.any(y_int < 0):
+            raise ValueError("labels must be >= 0")
+        n_classes = int(y_int.max()) + 1
+        family = self.getFamily()
+        if family == "auto":
+            family = "binomial" if n_classes <= 2 else "multinomial"
+        if family == "binomial" and n_classes > 2:
+            raise ValueError(f"binomial family with {n_classes} labels")
+        n_classes = max(n_classes, 2)
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+        with TraceRange("logreg fit", TraceColor.YELLOW):
+            if self.mesh is not None:
+                xs, mask, _ = shard_rows(x_host.astype(np.dtype(dtype)), self.mesh)
+                y_pad = np.zeros(xs.shape[0], dtype=np.int32)
+                y_pad[: len(y_int)] = y_int
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+                ys = jax.device_put(y_pad, NamedSharding(self.mesh, P(DATA_AXIS)))
+            else:
+                xs = jnp.asarray(x_host, dtype=dtype)
+                ys = jnp.asarray(y_int, dtype=jnp.int32)
+                mask = jnp.ones(xs.shape[0], dtype=dtype)
+            use_multinomial = family == "multinomial"
+            result = fit_logistic(
+                xs,
+                ys,
+                mask,
+                n_classes=n_classes,
+                reg_param=self.getRegParam(),
+                fit_intercept=self.getFitIntercept(),
+                standardization=self.getStandardization(),
+                max_iter=self.getMaxIter(),
+                tol=self.getTol(),
+                multinomial=use_multinomial,
+            )
+            weights = np.asarray(result.weights)
+            intercepts = np.asarray(result.intercepts)
+
+        # Strip model-axis feature padding introduced by shard_rows.
+        d = x_host.shape[1]
+        model = LogisticRegressionModel(
+            self.uid,
+            weights[:d].astype(np.float64),
+            intercepts.astype(np.float64),
+            numClasses=n_classes,
+            numIter=int(result.n_iter),
+        )
+        return self._copyValues(model)
+
+
+class LogisticRegressionModel(_LogisticRegressionParams, Model):
+    """Fitted model. ``weights``: (d, 1) binomial sigmoid column or (d, c)
+    softmax matrix; ``intercepts``: (1,) or (c,)."""
+
+    def __init__(
+        self,
+        uid: Optional[str] = None,
+        weights: Optional[np.ndarray] = None,
+        intercepts: Optional[np.ndarray] = None,
+        numClasses: int = 2,
+        numIter: int = 0,
+    ):
+        super().__init__(uid)
+        self.weights = None if weights is None else np.asarray(weights)
+        self.intercepts = None if intercepts is None else np.asarray(intercepts)
+        self.numClasses = numClasses
+        self.numIter = numIter
+
+    def setFeaturesCol(self, value: str) -> "LogisticRegressionModel":
+        self.set(self.featuresCol, value)
+        return self
+
+    def setPredictionCol(self, value: str) -> "LogisticRegressionModel":
+        self.set(self.predictionCol, value)
+        return self
+
+    def setProbabilityCol(self, value: str) -> "LogisticRegressionModel":
+        self.set(self.probabilityCol, value)
+        return self
+
+    def setRawPredictionCol(self, value: str) -> "LogisticRegressionModel":
+        self.set(self.rawPredictionCol, value)
+        return self
+
+    def setThreshold(self, value: float) -> "LogisticRegressionModel":
+        self.set(self.threshold, value)
+        return self
+
+    def copy(self, extra=None) -> "LogisticRegressionModel":
+        that = LogisticRegressionModel(
+            self.uid, self.weights, self.intercepts, self.numClasses, self.numIter
+        )
+        return self._copyValues(that, extra)
+
+    # --- Spark-style accessors ---
+
+    @property
+    def coefficients(self) -> np.ndarray:
+        """Binomial coefficient vector (d,). Raises for multinomial (Spark
+        throws the same way)."""
+        if self.weights.shape[1] != 1:
+            raise AttributeError("multinomial model: use coefficientMatrix")
+        return self.weights[:, 0]
+
+    @property
+    def intercept(self) -> float:
+        if self.intercepts.shape[0] != 1:
+            raise AttributeError("multinomial model: use interceptVector")
+        return float(self.intercepts[0])
+
+    @property
+    def coefficientMatrix(self) -> np.ndarray:
+        """Spark's orientation: (1, d) for binomial, (numClasses, d) for
+        multinomial."""
+        return self.weights.T
+
+    @property
+    def interceptVector(self) -> np.ndarray:
+        return self.intercepts.copy()
+
+    def predict(self, x) -> np.ndarray:
+        labels, _, _ = self._predict_all(as_matrix(x))
+        return labels
+
+    def predictProbability(self, x) -> np.ndarray:
+        _, probs, _ = self._predict_all(as_matrix(x))
+        return probs
+
+    def _predict_all(self, x: np.ndarray):
+        """One forward pass; binomial labels honor the threshold param."""
+        labels, probs, raw = predict_logistic(
+            jnp.asarray(x, dtype=jnp.asarray(self.weights).dtype),
+            jnp.asarray(self.weights),
+            jnp.asarray(self.intercepts),
+            n_classes=self.numClasses,
+        )
+        labels, probs = np.asarray(labels), np.asarray(probs)
+        if self.weights.shape[1] == 1 and self.getThreshold() != 0.5:
+            labels = (probs[:, 1] > self.getThreshold()).astype(np.int32)
+        return labels, probs, np.asarray(raw)
+
+    def transform(self, dataset: Any) -> Any:
+        if isinstance(dataset, DataFrame):
+            x = as_matrix(dataset.select(self.getFeaturesCol()))
+            labels, probs, raw = self._predict_all(x)
+            out = dataset.withColumn(self.getRawPredictionCol(), list(raw))
+            out = out.withColumn(self.getProbabilityCol(), list(probs))
+            return out.withColumn(self.getPredictionCol(), list(labels))
+        try:
+            import pandas as pd
+
+            if isinstance(dataset, pd.DataFrame):
+                if self.getFeaturesCol() in dataset.columns:
+                    x = as_matrix(dataset[self.getFeaturesCol()].tolist())
+                else:
+                    cols = [c for c in dataset.columns if c != self.getLabelCol()]
+                    x = dataset[cols].to_numpy(dtype=np.float64)
+                labels, probs, raw = self._predict_all(x)
+                out = dataset.copy()
+                out[self.getRawPredictionCol()] = list(raw)
+                out[self.getProbabilityCol()] = list(probs)
+                out[self.getPredictionCol()] = labels
+                return out
+        except ImportError:  # pragma: no cover
+            pass
+        return self.predict(dataset)
+
+    def evaluate(self, dataset: Any) -> dict:
+        """Summary metrics: accuracy / error rate on a labeled dataset."""
+        x, y = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
+        pred = self.predict(x)
+        mask = jnp.ones(len(y))
+        acc, err = classification_metrics(
+            jnp.asarray(y.astype(np.int32)), jnp.asarray(pred.astype(np.int32)), mask
+        )
+        return {"accuracy": float(acc), "errorRate": float(err)}
+
+    def _save_impl(self, path: str) -> None:
+        save_metadata(
+            self,
+            path,
+            class_name="org.apache.spark.ml.classification.LogisticRegressionModel",
+            extra_metadata={"numClasses": self.numClasses, "numIter": self.numIter},
+        )
+        save_data(
+            path,
+            {
+                "weights": ("matrix", self.weights),
+                "intercepts": ("vector", self.intercepts),
+            },
+        )
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "LogisticRegressionModel":
+        metadata = load_metadata(path, expected_class="LogisticRegressionModel")
+        data = load_data(path)
+        model = cls(
+            metadata["uid"],
+            data["weights"],
+            data["intercepts"],
+            numClasses=metadata.get("numClasses", 2),
+            numIter=metadata.get("numIter", 0),
+        )
+        get_and_set_params(model, metadata)
+        return model

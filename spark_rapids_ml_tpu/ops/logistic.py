@@ -1,0 +1,197 @@
+"""Logistic regression kernels — masked softmax/sigmoid loss + jitted L-BFGS.
+
+Beyond-the-reference capability (the reference ships only PCA — SURVEY.md §2);
+the model surface mirrors ``org.apache.spark.ml.classification
+.LogisticRegression``, whose optimizer is breeze L-BFGS over a
+DiffFunction aggregated with treeAggregate. Here the entire optimization is
+ONE jitted program: loss+gradient are masked GEMMs on the MXU and the L-BFGS
+update (optax.lbfgs with zoom linesearch) runs inside ``lax.while_loop`` —
+no per-iteration host round-trip. Under a mesh, ``x``/``y``/``mask`` arrive
+row-sharded and XLA inserts the gradient psum over ICI (GSPMD), giving the
+treeAggregate analogue for free.
+
+Objective (Spark semantics, L2 only):
+    (1/n) sum_i logloss_i + regParam * (1/2) ||w||^2
+with the penalty on coefficients of STANDARDIZED features when
+``standardization=True`` (optimize in scaled space, map back), intercept
+never penalized. Multinomial uses the over-parameterized softmax; when
+regParam == 0 the class axis is mean-centered for identifiability (Spark
+does the same pivoting correction).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from spark_rapids_ml_tpu.ops.linalg import _dot_precision
+
+
+class LogisticFit(NamedTuple):
+    """Result of :func:`fit_logistic` (all device arrays)."""
+
+    weights: jax.Array  # (d, c) coefficients in ORIGINAL feature space
+    intercepts: jax.Array  # (c,)
+    n_iter: jax.Array  # scalar int
+    loss: jax.Array  # final objective value (standardized space)
+
+
+def _masked_feature_moments(x: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Masked per-feature mean and stddev (population, like Spark's scaler)."""
+    n = jnp.sum(mask)
+    mean = jnp.sum(x * mask[:, None], axis=0) / n
+    var = jnp.sum(((x - mean) * mask[:, None]) ** 2, axis=0) / n
+    return mean, jnp.sqrt(var)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_classes",
+        "fit_intercept",
+        "standardization",
+        "max_iter",
+        "precision",
+        "multinomial",
+    ),
+)
+def fit_logistic(
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    n_classes: int,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    precision: str = "highest",
+    multinomial: bool = False,
+) -> LogisticFit:
+    """Fit binomial or multinomial logistic regression.
+
+    ``x``: (n, d); ``y``: (n,) integer labels in [0, n_classes); ``mask``:
+    (n,) 1.0 for real rows, 0.0 for padding (mesh row-sharding pads).
+    Binomial (``n_classes == 2`` and not ``multinomial``) trains a single
+    sigmoid column (c = 1); ``multinomial=True`` trains the full
+    (d, n_classes) softmax matrix even at 2 classes — the two families'
+    optima differ under L2 (softmax splits the penalty across both class
+    columns), so the 2-class case must NOT be collapsed to sigmoid when
+    multinomial semantics are requested.
+    """
+    if n_classes < 2:
+        raise ValueError(f"need at least 2 classes, got {n_classes}")
+    c = n_classes if (multinomial or n_classes > 2) else 1
+    d = x.shape[1]
+    dtype = x.dtype
+    prec = _dot_precision(precision)
+    n = jnp.sum(mask)
+
+    mean, sigma = _masked_feature_moments(x, mask)
+    # Padded / constant features have sigma 0 — scale by 1 there (their
+    # coefficients stay 0: zero column => zero gradient under L2 from init 0).
+    safe_sigma = jnp.where(sigma > 0, sigma, 1.0)
+    if standardization:
+        # Center ONLY when an intercept exists to absorb the shift back in
+        # original space; without an intercept, scale-only (Spark does the
+        # same — otherwise the returned coefficients would describe a
+        # different function than the one optimized).
+        offset = mean if fit_intercept else jnp.zeros_like(mean)
+        scale = safe_sigma
+    else:
+        offset = jnp.zeros_like(mean)
+        scale = jnp.ones_like(safe_sigma)
+
+    if c == 1:
+        y_target = (y == 1).astype(dtype)
+    else:
+        y_target = jax.nn.one_hot(y, c, dtype=dtype)
+
+    def loss_fn(params):
+        w, b = params
+        xs = (x - offset) / scale
+        logits = jnp.matmul(xs, w, precision=prec)
+        if fit_intercept:
+            logits = logits + b
+        if c == 1:
+            z = logits[:, 0]
+            # log(1+e^z) - y z, numerically stable via softplus
+            per_row = jax.nn.softplus(z) - y_target * z
+        else:
+            per_row = -jnp.sum(y_target * jax.nn.log_softmax(logits, axis=1), axis=1)
+        data_loss = jnp.sum(per_row * mask) / n
+        return data_loss + 0.5 * reg_param * jnp.sum(w * w)
+
+    w0 = jnp.zeros((d, c), dtype=dtype)
+    b0 = jnp.zeros((c,), dtype=dtype)
+    params0 = (w0, b0)
+
+    solver = optax.lbfgs()
+    value_and_grad = optax.value_and_grad_from_state(loss_fn)
+    state0 = solver.init(params0)
+
+    def cond(carry):
+        _params, _state, it, gnorm = carry
+        return jnp.logical_and(it < max_iter, gnorm > tol)
+
+    def body(carry):
+        params, state, it, _ = carry
+        value, grad = value_and_grad(params, state=state)
+        updates, state = solver.update(
+            grad, state, params, value=value, grad=grad, value_fn=loss_fn
+        )
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grad)
+        return params, state, it + 1, gnorm
+
+    init = (params0, state0, jnp.asarray(0), jnp.asarray(jnp.inf, dtype=dtype))
+    (w, b), state, n_iter, _ = jax.lax.while_loop(cond, body, init)
+
+    # Identifiability pivot for unregularized softmax (Spark's centering).
+    if c > 1:
+        do_center = reg_param == 0.0
+        w = jnp.where(do_center, w - jnp.mean(w, axis=1, keepdims=True), w)
+        b = jnp.where(do_center, b - jnp.mean(b), b)
+
+    # Map standardized-space solution back to original feature space.
+    w_orig = w / scale[:, None]
+    b_orig = b - jnp.matmul(offset, w_orig, precision=prec) if fit_intercept else b
+    final_loss = loss_fn((w, b))
+    return LogisticFit(w_orig, b_orig, n_iter, final_loss)
+
+
+@partial(jax.jit, static_argnames=("n_classes", "precision"))
+def predict_logistic(
+    x: jax.Array,
+    weights: jax.Array,
+    intercepts: jax.Array,
+    n_classes: int,
+    precision: str = "highest",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(labels, probabilities (n, n_classes), raw logits (n, n_classes))."""
+    prec = _dot_precision(precision)
+    logits = jnp.matmul(x, weights, precision=prec) + intercepts
+    if weights.shape[1] == 1:
+        z = logits[:, 0]
+        p1 = jax.nn.sigmoid(z)
+        probs = jnp.stack([1.0 - p1, p1], axis=1)
+        raw = jnp.stack([-z, z], axis=1)
+        labels = (p1 > 0.5).astype(jnp.int32)
+    else:
+        probs = jax.nn.softmax(logits, axis=1)
+        raw = logits
+        labels = jnp.argmax(logits, axis=1).astype(jnp.int32)
+    return labels, probs, raw
+
+
+@jax.jit
+def classification_metrics(y: jax.Array, pred: jax.Array, mask: jax.Array):
+    """(accuracy, error_rate) over unmasked rows."""
+    n = jnp.sum(mask)
+    correct = jnp.sum((y == pred).astype(mask.dtype) * mask)
+    acc = correct / n
+    return acc, 1.0 - acc

@@ -1,0 +1,291 @@
+"""LogisticRegression suite. Oracle: scikit-learn's lbfgs solver — its
+objective sum_i logloss + 1/(2C) ||w||^2 equals this framework's
+(1/n) sum logloss + regParam/2 ||w||^2 at C = 1/(n*regParam) — plus
+optimality-condition (gradient ~ 0) checks that need no external solver."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import LogisticRegression, LogisticRegressionModel
+from spark_rapids_ml_tpu.core.data import DataFrame
+from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+
+def make_binary(rng, n=400, d=5, sep=1.5):
+    w = rng.normal(size=d)
+    x = rng.normal(size=(n, d))
+    logits = x @ w * sep
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int64)
+    # ensure both classes present
+    y[0], y[1] = 0, 1
+    return x, y
+
+
+def make_multiclass(rng, n=600, d=6, c=4):
+    centers = rng.normal(size=(c, d)) * 2.0
+    y = rng.integers(0, c, size=n)
+    x = centers[y] + rng.normal(size=(n, d))
+    for j in range(c):
+        y[j] = j
+    return x, y
+
+
+def sklearn_logreg(x, y, reg, fit_intercept=True, multi=False):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    n = len(y)
+    c_val = 1.0 / (n * reg) if reg > 0 else 1e12
+    clf = SkLR(
+        C=c_val,
+        fit_intercept=fit_intercept,
+        solver="lbfgs",
+        max_iter=5000,
+        tol=1e-10,
+    )
+    clf.fit(x, y)
+    return clf
+
+
+class TestBinomial:
+    def test_matches_sklearn_regularized(self, rng):
+        x, y = make_binary(rng)
+        reg = 0.1
+        # standardization off => plain L2 in original space == sklearn's
+        model = (
+            LogisticRegression()
+            .setRegParam(reg)
+            .setStandardization(False)
+            .setTol(1e-10)
+            .setMaxIter(500)
+            .fit((x, y))
+        )
+        clf = sklearn_logreg(x, y, reg)
+        np.testing.assert_allclose(model.coefficients, clf.coef_[0], atol=2e-4)
+        assert model.intercept == pytest.approx(clf.intercept_[0], abs=2e-4)
+
+    def test_gradient_zero_at_solution(self, rng):
+        """KKT check: gradient of the objective vanishes at the fit."""
+        x, y = make_binary(rng)
+        reg = 0.05
+        model = (
+            LogisticRegression()
+            .setRegParam(reg)
+            .setStandardization(False)
+            .setTol(1e-10)
+            .setMaxIter(500)
+            .fit((x, y))
+        )
+        w, b = model.coefficients, model.intercept
+        p = 1 / (1 + np.exp(-(x @ w + b)))
+        grad_w = x.T @ (p - y) / len(y) + reg * w
+        grad_b = np.mean(p - y)
+        assert np.abs(grad_w).max() < 1e-6
+        assert abs(grad_b) < 1e-6
+
+    def test_standardization_matches_sklearn_on_scaled(self, rng):
+        """standardization=True == sklearn trained on scaled features with
+        coefficients mapped back."""
+        x, y = make_binary(rng)
+        x = x * np.array([10.0, 0.1, 1.0, 5.0, 0.5])  # wild scales
+        reg = 0.1
+        model = (
+            LogisticRegression().setRegParam(reg).setTol(1e-10).setMaxIter(500).fit((x, y))
+        )
+        mu, sd = x.mean(0), x.std(0)
+        clf = sklearn_logreg((x - mu) / sd, y, reg)
+        coef_back = clf.coef_[0] / sd
+        b_back = clf.intercept_[0] - (clf.coef_[0] * mu / sd).sum()
+        np.testing.assert_allclose(model.coefficients, coef_back, atol=2e-4)
+        assert model.intercept == pytest.approx(b_back, abs=2e-4)
+
+    def test_no_intercept_standardized_matches_sklearn(self, rng):
+        """fitIntercept=False must scale but NOT center (no intercept to
+        absorb the shift): equals sklearn on x/sigma with coef mapped back."""
+        x, y = make_binary(rng)
+        x = x + 3.0  # nonzero means make centering bugs visible
+        reg = 0.1
+        model = (
+            LogisticRegression()
+            .setFitIntercept(False)
+            .setRegParam(reg)
+            .setTol(1e-10)
+            .setMaxIter(500)
+            .fit((x, y))
+        )
+        sd = x.std(0)
+        clf = sklearn_logreg(x / sd, y, reg, fit_intercept=False)
+        np.testing.assert_allclose(model.coefficients, clf.coef_[0] / sd, atol=2e-4)
+        assert model.intercept == 0.0
+
+    def test_separable_unregularized_predicts_perfectly(self, rng):
+        x = rng.normal(size=(100, 3))
+        y = (x[:, 0] > 0).astype(np.int64)
+        model = LogisticRegression().setMaxIter(200).fit((x, y))
+        assert (model.predict(x) == y).mean() == 1.0
+
+    def test_threshold(self, rng):
+        x, y = make_binary(rng)
+        model = LogisticRegression().setRegParam(0.1).fit((x, y))
+        p = model.predictProbability(x)
+        assert p.shape == (len(y), 2)
+        np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-6)
+        model.setThreshold(0.0)
+        assert (model.predict(x) == 1).all()
+        model.setThreshold(1.0)
+        assert (model.predict(x) == 0).all()
+
+    def test_probability_calibration_vs_sklearn(self, rng):
+        x, y = make_binary(rng)
+        model = (
+            LogisticRegression().setRegParam(0.2).setStandardization(False).fit((x, y))
+        )
+        clf = sklearn_logreg(x, y, 0.2)
+        np.testing.assert_allclose(
+            model.predictProbability(x), clf.predict_proba(x), atol=1e-3
+        )
+
+
+class TestMultinomial:
+    def test_matches_sklearn_multinomial(self, rng):
+        x, y = make_multiclass(rng)
+        reg = 0.1
+        model = (
+            LogisticRegression()
+            .setRegParam(reg)
+            .setStandardization(False)
+            .setTol(1e-10)
+            .setMaxIter(500)
+            .fit((x, y))
+        )
+        clf = sklearn_logreg(x, y, reg, multi=True)
+        # sklearn's multinomial softmax is also over-parameterized + L2 =>
+        # same unique solution.
+        np.testing.assert_allclose(model.coefficientMatrix, clf.coef_, atol=5e-4)
+        np.testing.assert_allclose(model.interceptVector, clf.intercept_, atol=5e-4)
+
+    def test_family_auto_picks_multinomial(self, rng):
+        x, y = make_multiclass(rng, c=3)
+        model = LogisticRegression().setRegParam(0.1).fit((x, y))
+        assert model.numClasses == 3
+        assert model.coefficientMatrix.shape == (3, x.shape[1])
+        assert model.interceptVector.shape == (3,)
+        with pytest.raises(AttributeError):
+            model.coefficients
+
+    def test_multinomial_two_class_consistent_with_binomial(self, rng):
+        """Unregularized: the 2-class softmax and the sigmoid have the same
+        optimum in probability space."""
+        x, y = make_binary(rng)
+        m_bin = LogisticRegression().setTol(1e-9).fit((x, y))
+        m_mult = (
+            LogisticRegression().setFamily("multinomial").setTol(1e-9).fit((x, y))
+        )
+        np.testing.assert_allclose(
+            m_bin.predictProbability(x), m_mult.predictProbability(x), atol=1e-3
+        )
+
+    def test_multinomial_two_class_l2_relation(self, rng):
+        """Under L2 the softmax splits the penalty across both class columns:
+        in difference space D = w1 - w0 the softmax objective is
+        logloss(D) + (reg/4)||D||^2, so multinomial(2*reg) == binomial(reg)
+        in probability space."""
+        x, y = make_binary(rng)
+        m_bin = (
+            LogisticRegression()
+            .setRegParam(0.1)
+            .setStandardization(False)
+            .setTol(1e-10)
+            .fit((x, y))
+        )
+        m_mult = (
+            LogisticRegression()
+            .setFamily("multinomial")
+            .setRegParam(0.2)
+            .setStandardization(False)
+            .setTol(1e-10)
+            .fit((x, y))
+        )
+        np.testing.assert_allclose(
+            m_bin.predictProbability(x), m_mult.predictProbability(x), atol=1e-4
+        )
+        # and the softmax solution is antisymmetric: w0 = -w1
+        cm = m_mult.coefficientMatrix
+        np.testing.assert_allclose(cm[0], -cm[1], atol=1e-5)
+
+    def test_unregularized_centered(self, rng):
+        x, y = make_multiclass(rng, c=3)
+        model = LogisticRegression().setMaxIter(100).fit((x, y))
+        # identifiability pivot: class-axis mean of coefficients ~ 0
+        np.testing.assert_allclose(
+            model.coefficientMatrix.mean(axis=0), 0.0, atol=1e-6
+        )
+
+    def test_accuracy_on_separated_clusters(self, rng):
+        x, y = make_multiclass(rng, c=4)
+        model = LogisticRegression().setRegParam(0.01).fit((x, y))
+        assert model.evaluate((x, y))["accuracy"] > 0.8
+
+
+class TestAPI:
+    def test_errors(self, rng):
+        x, y = make_binary(rng)
+        with pytest.raises(ValueError):
+            LogisticRegression().setRegParam(-1.0)
+        with pytest.raises(ValueError):
+            LogisticRegression().setFamily("gaussian")
+        with pytest.raises(ValueError):
+            LogisticRegression().setElasticNetParam(0.5).fit((x, y))
+        with pytest.raises(ValueError):
+            LogisticRegression().fit((x, y + 0.5))  # non-integer labels
+        with pytest.raises(ValueError):
+            LogisticRegression().setFamily("binomial").fit(
+                (x, np.arange(len(y)) % 3)
+            )
+
+    def test_dataframe_transform_columns(self, rng):
+        x, y = make_binary(rng, n=50)
+        df = DataFrame({"features": list(x), "label": list(y.astype(float))})
+        model = LogisticRegression().setRegParam(0.1).fit(df)
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        assert "probability" in out.columns
+        assert "rawPrediction" in out.columns
+
+    def test_persistence_roundtrip(self, rng, tmp_path):
+        x, y = make_multiclass(rng, c=3)
+        model = LogisticRegression().setRegParam(0.1).fit((x, y))
+        path = str(tmp_path / "lr")
+        model.write.save(path)
+        loaded = LogisticRegressionModel.load(path)
+        np.testing.assert_array_equal(loaded.weights, model.weights)
+        np.testing.assert_array_equal(loaded.intercepts, model.intercepts)
+        assert loaded.numClasses == model.numClasses
+        assert loaded.getRegParam() == 0.1
+        np.testing.assert_array_equal(loaded.predict(x), model.predict(x))
+
+    def test_copy_preserves_state(self, rng):
+        x, y = make_binary(rng)
+        model = LogisticRegression().setRegParam(0.1).fit((x, y))
+        clone = model.copy() if hasattr(model, "copy") else model
+        np.testing.assert_array_equal(clone.weights, model.weights)
+
+
+class TestDistributed:
+    def test_mesh_fit_matches_single_device(self, rng):
+        x, y = make_binary(rng, n=203)  # not divisible by mesh
+        mesh = make_mesh((4, 2))
+        single = LogisticRegression().setRegParam(0.1).setTol(1e-10).fit((x, y))
+        dist = (
+            LogisticRegression(mesh=mesh).setRegParam(0.1).setTol(1e-10).fit((x, y))
+        )
+        np.testing.assert_allclose(dist.coefficients, single.coefficients, atol=1e-5)
+        assert dist.intercept == pytest.approx(single.intercept, abs=1e-5)
+
+    def test_mesh_multinomial(self, rng):
+        x, y = make_multiclass(rng, n=301, c=3)
+        mesh = make_mesh((8, 1))
+        single = LogisticRegression().setRegParam(0.1).setTol(1e-10).fit((x, y))
+        dist = LogisticRegression(mesh=mesh).setRegParam(0.1).setTol(1e-10).fit((x, y))
+        np.testing.assert_allclose(
+            dist.coefficientMatrix, single.coefficientMatrix, atol=1e-5
+        )
