@@ -8,6 +8,10 @@ from spark_rapids_ml_tpu.models.logistic_regression import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.nearest_neighbors import (
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
 
 __all__ = [
     "PCA",
@@ -18,4 +22,6 @@ __all__ = [
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "NearestNeighbors",
+    "NearestNeighborsModel",
 ]

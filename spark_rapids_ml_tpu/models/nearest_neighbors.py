@@ -1,0 +1,237 @@
+"""NearestNeighbors estimator/model — exact brute-force kNN on the MXU.
+
+Beyond-the-reference capability (the reference ships only PCA — SURVEY.md
+§2; the modern RAPIDS Spark-ML line exposes cuML brute-force
+NearestNeighbors with this param surface: ``k``, ``inputCol``, ``idCol``).
+``fit`` indexes the item set; ``kneighbors(queries)`` returns (distances,
+indices) — plus caller ids when ``idCol`` is set, mirroring the
+item-id/query-id join the Spark version emits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_column
+from spark_rapids_ml_tpu.core.estimator import Estimator, Model
+from spark_rapids_ml_tpu.core.params import Param, Params, gt, toInt, toString
+from spark_rapids_ml_tpu.core.persistence import (
+    MLReadable,
+    get_and_set_params,
+    load_rows,
+    load_metadata,
+    save_metadata,
+    save_rows,
+)
+from spark_rapids_ml_tpu.ops.knn import knn, knn_sharded, shard_items
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def _extract_features(dataset: Any, col: str, drop: Optional[str] = None):
+    """Feature extraction shared by fit and kneighbors: DataFrame shim
+    selects ``col``; pandas uses ``col`` if present else treats the frame
+    (minus ``drop``) as a bare matrix; arrays pass through (the
+    kmeans._extract_features convention, delegating to core.data)."""
+    if isinstance(dataset, DataFrame):
+        return dataset.select(col)
+    try:
+        import pandas as pd
+
+        if isinstance(dataset, pd.DataFrame):
+            if col in dataset.columns:
+                return extract_column(dataset, col)
+            keep = [c for c in dataset.columns if c != drop]
+            return dataset[keep].to_numpy(dtype=np.float64)
+    except ImportError:  # pragma: no cover
+        pass
+    return dataset
+
+
+class _NearestNeighborsParams(Params):
+    k = Param("_", "k", "number of neighbors", lambda v: gt(0)(toInt(v)))
+    inputCol = Param("_", "inputCol", "features column name", toString)
+    idCol = Param("_", "idCol", "optional row-id column name", toString)
+    metric = Param("_", "metric", "euclidean, sqeuclidean, or cosine", toString)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(k=5, inputCol="features", metric="euclidean")
+
+    def getK(self) -> int:
+        return self.getOrDefault(self.k)
+
+    def getInputCol(self) -> str:
+        return self.getOrDefault(self.inputCol)
+
+    def getIdCol(self) -> Optional[str]:
+        return self.getOrDefault(self.idCol) if self.isDefined(self.idCol) else None
+
+    def getMetric(self) -> str:
+        return self.getOrDefault(self.metric)
+
+
+class NearestNeighbors(_NearestNeighborsParams, Estimator, MLReadable):
+    """``NearestNeighbors().setK(8).fit(items).kneighbors(queries)``."""
+
+    def __init__(self, uid: Optional[str] = None, mesh=None):
+        super().__init__(uid)
+        self.mesh = mesh
+
+    def setK(self, value: int) -> "NearestNeighbors":
+        self.set(self.k, value)
+        return self
+
+    def setInputCol(self, value: str) -> "NearestNeighbors":
+        self.set(self.inputCol, value)
+        return self
+
+    def setIdCol(self, value: str) -> "NearestNeighbors":
+        self.set(self.idCol, value)
+        return self
+
+    def setMetric(self, value: str) -> "NearestNeighbors":
+        if value not in ("euclidean", "sqeuclidean", "cosine"):
+            raise ValueError(
+                f"metric must be euclidean/sqeuclidean/cosine, got {value!r}"
+            )
+        self.set(self.metric, value)
+        return self
+
+    def setMesh(self, mesh) -> "NearestNeighbors":
+        self.mesh = mesh
+        return self
+
+    def fit(self, dataset: Any) -> "NearestNeighborsModel":
+        """Index the item set (brute force: store + pre-shard)."""
+        id_col = self.getIdCol()
+        items = as_matrix(_extract_features(dataset, self.getInputCol(), drop=id_col))
+        ids = None
+        if id_col is not None:
+            # idCol set but not extractable => raise rather than silently
+            # returning positional indices from kneighbors_ids later.
+            if isinstance(dataset, DataFrame):
+                ids = np.asarray(dataset.select(id_col))
+            else:
+                try:
+                    import pandas as pd
+                except ImportError:  # pragma: no cover
+                    pd = None
+                if pd is not None and isinstance(dataset, pd.DataFrame) and id_col in dataset.columns:
+                    ids = dataset[id_col].to_numpy()
+                else:
+                    raise ValueError(
+                        f"idCol={id_col!r} set, but the dataset has no such column"
+                    )
+        if self.getK() > items.shape[0]:
+            raise ValueError(f"k={self.getK()} exceeds item count {items.shape[0]}")
+        model = NearestNeighborsModel(self.uid, np.asarray(items), ids, mesh=self.mesh)
+        return self._copyValues(model)
+
+
+class NearestNeighborsModel(_NearestNeighborsParams, Model):
+    """Indexed item set; ``kneighbors`` runs the blocked distance GEMM."""
+
+    def __init__(
+        self,
+        uid: Optional[str] = None,
+        items: Optional[np.ndarray] = None,
+        ids: Optional[np.ndarray] = None,
+        mesh=None,
+    ):
+        super().__init__(uid)
+        self.items = None if items is None else np.asarray(items)
+        self.ids = None if ids is None else np.asarray(ids)
+        self.mesh = mesh
+        self._sharded = None  # lazily cached (items_sharded, mask_sharded)
+
+    def setMesh(self, mesh) -> "NearestNeighborsModel":
+        self.mesh = mesh
+        self._sharded = None
+        return self
+
+    def kneighbors(self, queries: Any, k: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """(distances (nq, k), indices (nq, k)). Indices are row positions in
+        the fitted item set; use ``kneighbors_ids`` for idCol-mapped output."""
+        if self.items is None:
+            raise RuntimeError("model has no indexed items")
+        k = self.getK() if k is None else k
+        if not 1 <= k <= self.items.shape[0]:
+            raise ValueError(f"k must be in [1, {self.items.shape[0]}], got {k}")
+        q = as_matrix(_extract_features(queries, self.getInputCol()))
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        with TraceRange("knn", TraceColor.PURPLE):
+            if self.mesh is not None:
+                if self.getMetric() != "euclidean":
+                    raise NotImplementedError(
+                        "mesh kneighbors supports euclidean only"
+                    )
+                if self._sharded is None:
+                    # One host->device upload of the index, reused across
+                    # query batches (fit's "store + pre-shard" promise).
+                    self._sharded = shard_items(
+                        self.items.astype(np.dtype(dtype)), self.mesh
+                    )
+                xs, mask = self._sharded
+                d2, idx = knn_sharded(
+                    jnp.asarray(q, dtype=dtype), xs, mask, self.mesh, k=k
+                )
+                d = jnp.sqrt(d2)
+            else:
+                d, idx = knn(
+                    jnp.asarray(q, dtype=dtype),
+                    jnp.asarray(self.items, dtype=dtype),
+                    k=k,
+                    metric=self.getMetric(),
+                )
+        return np.asarray(d), np.asarray(idx)
+
+    def kneighbors_ids(self, queries: Any, k: Optional[int] = None):
+        """(distances, ids) with indices mapped through the fitted idCol."""
+        d, idx = self.kneighbors(queries, k)
+        if self.ids is None:
+            return d, idx
+        return d, self.ids[idx]
+
+    def transform(self, dataset: Any) -> Any:
+        """Append neighbor indices + distances columns (DataFrame input)."""
+        d, idx = self.kneighbors(dataset)
+        if isinstance(dataset, DataFrame):
+            out = dataset.withColumn("knn_indices", list(idx))
+            return out.withColumn("knn_distances", list(d))
+        try:
+            import pandas as pd
+
+            if isinstance(dataset, pd.DataFrame):
+                out = dataset.copy()
+                out["knn_indices"] = list(idx)
+                out["knn_distances"] = list(d)
+                return out
+        except ImportError:  # pragma: no cover
+            pass
+        return d, idx
+
+    def _save_impl(self, path: str) -> None:
+        save_metadata(
+            self,
+            path,
+            class_name="com.nvidia.rapids.ml.NearestNeighborsModel",
+            extra_metadata={"hasIds": self.ids is not None},
+        )
+        cols = {"item": ("vector", [r for r in self.items])}
+        if self.ids is not None:
+            cols["id"] = ("scalar", self.ids.tolist())
+        save_rows(path, cols)
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "NearestNeighborsModel":
+        metadata = load_metadata(path, expected_class="NearestNeighborsModel")
+        rows = load_rows(path)
+        items = np.stack(rows["item"])
+        ids = np.asarray(rows["id"]) if metadata.get("hasIds") else None
+        model = cls(metadata["uid"], items, ids)
+        get_and_set_params(model, metadata)
+        return model

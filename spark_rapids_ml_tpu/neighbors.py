@@ -1,0 +1,8 @@
+"""Neighbors namespace — parity with the RAPIDS Spark-ML NearestNeighbors."""
+
+from spark_rapids_ml_tpu.models.nearest_neighbors import (
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
+
+__all__ = ["NearestNeighbors", "NearestNeighborsModel"]
