@@ -1,5 +1,6 @@
 """Clustering namespace — parity with ``org.apache.spark.ml.clustering``."""
 
 from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel
+from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
 
-__all__ = ["KMeans", "KMeansModel"]
+__all__ = ["KMeans", "KMeansModel", "DBSCAN", "DBSCANModel"]

@@ -179,6 +179,27 @@ def extract_column(dataset: Any, input_col: Optional[str]) -> Any:
     return dataset
 
 
+def extract_features(dataset: Any, col: str, drop: Optional[str] = None) -> Any:
+    """Feature extraction shared by the estimators (the single home of the
+    dispatch convention — keep models importing this rather than forking it):
+    DataFrame shim selects ``col``; pandas uses ``col`` if present, else
+    treats the frame (minus the optional ``drop`` column, e.g. a row-id)
+    as a bare feature matrix; arrays/lists pass through."""
+    if isinstance(dataset, DataFrame):
+        return dataset.select(col)
+    try:
+        import pandas as pd
+
+        if isinstance(dataset, pd.DataFrame):
+            if col in dataset.columns:
+                return extract_column(dataset, col)
+            keep = [c for c in dataset.columns if c != drop]
+            return dataset[keep].to_numpy(dtype=np.float64)
+    except ImportError:  # pragma: no cover
+        pass
+    return dataset
+
+
 def as_partitions(data: Any, num_partitions: Optional[int] = None) -> List[np.ndarray]:
     """Normalize input into a list of dense (rows_i, d) float64 partitions.
 

@@ -12,8 +12,11 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
     NearestNeighbors,
     NearestNeighborsModel,
 )
+from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
 
 __all__ = [
+    "DBSCAN",
+    "DBSCANModel",
     "PCA",
     "PCAModel",
     "KMeans",

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_column
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, gt, toFloat, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -175,21 +175,8 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
         return self._copyValues(model)
 
 
-def _extract_features(dataset, col: str):
-    """Column extraction with the KMeans convention: named frames must have
-    the features column; raw arrays/matrices are used as-is; a pandas frame
-    without the column is treated as a bare feature matrix. All dispatch is
-    delegated to core.data.extract_column."""
-    if isinstance(dataset, DataFrame):
-        return dataset.select(col)
-    try:
-        import pandas as pd
-
-        if isinstance(dataset, pd.DataFrame):
-            return extract_column(dataset, col if col in dataset.columns else None)
-    except ImportError:  # pragma: no cover
-        pass
-    return dataset
+# Shared extraction convention; re-exported name kept for back-compat.
+_extract_features = extract_features
 
 
 class KMeansModel(_KMeansParams, Model):

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_column
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, gt, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -31,24 +31,8 @@ from spark_rapids_ml_tpu.ops.knn import knn, knn_sharded, shard_items
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-def _extract_features(dataset: Any, col: str, drop: Optional[str] = None):
-    """Feature extraction shared by fit and kneighbors: DataFrame shim
-    selects ``col``; pandas uses ``col`` if present else treats the frame
-    (minus ``drop``) as a bare matrix; arrays pass through (the
-    kmeans._extract_features convention, delegating to core.data)."""
-    if isinstance(dataset, DataFrame):
-        return dataset.select(col)
-    try:
-        import pandas as pd
-
-        if isinstance(dataset, pd.DataFrame):
-            if col in dataset.columns:
-                return extract_column(dataset, col)
-            keep = [c for c in dataset.columns if c != drop]
-            return dataset[keep].to_numpy(dtype=np.float64)
-    except ImportError:  # pragma: no cover
-        pass
-    return dataset
+# Shared extraction convention lives in core.data; keep the old local name.
+_extract_features = extract_features
 
 
 class _NearestNeighborsParams(Params):
@@ -114,6 +98,10 @@ class NearestNeighbors(_NearestNeighborsParams, Estimator, MLReadable):
             # idCol set but not extractable => raise rather than silently
             # returning positional indices from kneighbors_ids later.
             if isinstance(dataset, DataFrame):
+                if id_col not in dataset.columns:
+                    raise ValueError(
+                        f"idCol={id_col!r} set, but the dataset has no such column"
+                    )
                 ids = np.asarray(dataset.select(id_col))
             else:
                 try:
@@ -161,7 +149,7 @@ class NearestNeighborsModel(_NearestNeighborsParams, Model):
         k = self.getK() if k is None else k
         if not 1 <= k <= self.items.shape[0]:
             raise ValueError(f"k must be in [1, {self.items.shape[0]}], got {k}")
-        q = as_matrix(_extract_features(queries, self.getInputCol()))
+        q = as_matrix(_extract_features(queries, self.getInputCol(), drop=self.getIdCol()))
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         with TraceRange("knn", TraceColor.PURPLE):
             if self.mesh is not None:

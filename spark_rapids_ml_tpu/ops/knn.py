@@ -79,7 +79,10 @@ def knn_sq_euclidean(
         xb, mb, start = blk
         d2 = _block_sq_distances(queries, xb, q_sq, prec)
         d2 = jnp.where(mb[None, :] > 0, d2, jnp.inf)
-        idx = start + jnp.arange(block, dtype=jnp.int32)
+        # Masked (padded) items keep index -1 so that when k exceeds the
+        # real item count the unfilled slots surface as (inf, -1) rather
+        # than as plausible-looking indices of padding rows.
+        idx = jnp.where(mb > 0, start + jnp.arange(block, dtype=jnp.int32), -1)
         cand_d = jnp.concatenate([best_d, d2], axis=1)
         cand_i = jnp.concatenate([best_i, jnp.broadcast_to(idx, (nq, block))], axis=1)
         # top_k selects LARGEST; negate for smallest-distance selection.

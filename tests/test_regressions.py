@@ -69,3 +69,44 @@ def test_generic_load_keeps_params_reachable(tmp_path):
     loaded.setMeanCentering(False)
     assert loaded.getMeanCentering() is False
     assert len([p for p in loaded._paramMap if p.name == "meanCentering"]) == 1
+
+
+def test_kneighbors_drops_id_col_from_pandas_queries(rng):
+    """Bare-matrix pandas frame with an id column: fit() strips it, and
+    kneighbors() on the same frame must strip it too (review finding)."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.neighbors import NearestNeighbors
+
+    x = rng.normal(size=(40, 5))
+    df = pd.DataFrame(x, columns=[f"c{i}" for i in range(5)])
+    df["rid"] = np.arange(100, 140)
+    model = NearestNeighbors().setK(3).setIdCol("rid").fit(df)
+    d, ids = model.kneighbors_ids(df)
+    assert d.shape == (40, 3)
+    # each row's nearest neighbor is itself, reported via the id column
+    np.testing.assert_array_equal(ids[:, 0], df["rid"].to_numpy())
+
+
+def test_fit_id_col_missing_in_dataframe_shim_raises_value_error(rng):
+    from spark_rapids_ml_tpu.neighbors import NearestNeighbors
+
+    x = rng.normal(size=(10, 3))
+    with pytest.raises(ValueError, match="idCol"):
+        NearestNeighbors().setK(2).setIdCol("rid").fit(DataFrame({"features": list(x)}))
+
+
+def test_knn_masked_overflow_slots_carry_minus_one(rng):
+    """k > real (masked) item count: unfilled slots must be (inf, -1), not
+    indices of padding rows (review finding)."""
+    from spark_rapids_ml_tpu.ops.knn import knn_sq_euclidean
+
+    q = rng.normal(size=(4, 3)).astype(np.float32)
+    items = np.zeros((8, 3), dtype=np.float32)
+    items[:5] = rng.normal(size=(5, 3))
+    mask = np.array([1.0] * 5 + [0.0] * 3, dtype=np.float32)
+    d, i = knn_sq_euclidean(q, items, k=7, item_mask=mask)
+    d, i = np.asarray(d), np.asarray(i)
+    assert np.all(np.isinf(d[:, 5:]))
+    assert np.all(i[:, 5:] == -1)
+    assert np.all(i[:, :5] >= 0) and np.all(i[:, :5] < 5)
