@@ -13,8 +13,14 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
     NearestNeighborsModel,
 )
 from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
+from spark_rapids_ml_tpu.models.approximate_nearest_neighbors import (
+    ApproximateNearestNeighbors,
+    ApproximateNearestNeighborsModel,
+)
 
 __all__ = [
+    "ApproximateNearestNeighbors",
+    "ApproximateNearestNeighborsModel",
     "DBSCAN",
     "DBSCANModel",
     "PCA",

@@ -1,0 +1,306 @@
+"""ApproximateNearestNeighbors estimator/model — IVF-Flat on the MXU.
+
+Beyond-the-reference capability (the reference ships only PCA — SURVEY.md
+§2; the modern RAPIDS Spark-ML line exposes cuML ApproximateNearestNeighbors
+with this param surface: ``k``, ``algorithm`` (default "ivfflat"),
+``algoParams`` (e.g. ``{"nlist": 50, "nprobe": 20}``), ``metric``,
+``inputCol``, ``idCol``). Algorithms: ``ivfflat`` (kernels in ``ops/ann.py``
+— see its docstring for the dense-tensor redesign of cuML's inverted lists)
+and ``brute`` (exact, delegates to ``ops/knn.py``).
+
+Metrics: ``euclidean`` / ``sqeuclidean`` natively; ``cosine`` by
+L2-normalizing items and queries, under which cosine distance equals half
+the squared euclidean distance.
+
+Persistence stores the raw items (+ ids); the IVF index is rebuilt on load
+from the persisted ``seed`` — the quantizer is deterministic given (items,
+n_lists, seed), so a reloaded model probes identical lists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features
+from spark_rapids_ml_tpu.core.estimator import Estimator, Model
+from spark_rapids_ml_tpu.core.params import Param, Params, gt, toInt, toString
+from spark_rapids_ml_tpu.core.persistence import (
+    MLReadable,
+    get_and_set_params,
+    load_metadata,
+    load_rows,
+    save_metadata,
+    save_rows,
+)
+from spark_rapids_ml_tpu.ops.ann import IVFIndex, build_ivf_index, ivf_search
+from spark_rapids_ml_tpu.ops.knn import knn
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+_ALGORITHMS = ("ivfflat", "brute")
+_METRICS = ("euclidean", "sqeuclidean", "cosine")
+
+
+def _dtype():
+    return np.float64 if jax.config.jax_enable_x64 else np.float32
+
+
+def _normalize(x: np.ndarray) -> np.ndarray:
+    norms = np.linalg.norm(x, axis=1, keepdims=True)
+    return x / np.maximum(norms, 1e-30)
+
+
+class _ANNParams(Params):
+    k = Param("_", "k", "number of neighbors", lambda v: gt(0)(toInt(v)))
+    algorithm = Param("_", "algorithm", "ivfflat or brute", toString)
+    algoParams = Param(
+        "_", "algoParams", "algorithm tuning dict, e.g. {'nlist': 50, 'nprobe': 20}",
+        lambda v: dict(v) if v is not None else {},
+    )
+    metric = Param("_", "metric", "euclidean, sqeuclidean, or cosine", toString)
+    inputCol = Param("_", "inputCol", "features column name", toString)
+    idCol = Param("_", "idCol", "optional row-id column name", toString)
+    seed = Param("_", "seed", "quantizer random seed", toInt)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(
+            k=5, algorithm="ivfflat", algoParams={}, metric="euclidean",
+            inputCol="features", seed=0,
+        )
+
+    def getK(self) -> int:
+        return self.getOrDefault(self.k)
+
+    def getAlgorithm(self) -> str:
+        return self.getOrDefault(self.algorithm)
+
+    def getAlgoParams(self) -> Dict[str, Any]:
+        return self.getOrDefault(self.algoParams)
+
+    def getMetric(self) -> str:
+        return self.getOrDefault(self.metric)
+
+    def getInputCol(self) -> str:
+        return self.getOrDefault(self.inputCol)
+
+    def getIdCol(self) -> Optional[str]:
+        return self.getOrDefault(self.idCol) if self.isDefined(self.idCol) else None
+
+    def getSeed(self) -> int:
+        return self.getOrDefault(self.seed)
+
+
+class ApproximateNearestNeighbors(_ANNParams, Estimator, MLReadable):
+    """``ApproximateNearestNeighbors().setK(8).setAlgoParams({"nlist": 64,
+    "nprobe": 8}).fit(items).kneighbors(queries)``."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+
+    def setK(self, value: int) -> "ApproximateNearestNeighbors":
+        self.set(self.k, value)
+        return self
+
+    def setAlgorithm(self, value: str) -> "ApproximateNearestNeighbors":
+        if value not in _ALGORITHMS:
+            raise ValueError(f"algorithm must be one of {_ALGORITHMS}, got {value!r}")
+        self.set(self.algorithm, value)
+        return self
+
+    def setAlgoParams(self, value: Dict[str, Any]) -> "ApproximateNearestNeighbors":
+        known = {"nlist", "nprobe", "kmeans_iters"}
+        unknown = set(value) - known
+        if unknown:
+            raise ValueError(f"unknown algoParams {sorted(unknown)}; known: {sorted(known)}")
+        self.set(self.algoParams, value)
+        return self
+
+    def setMetric(self, value: str) -> "ApproximateNearestNeighbors":
+        if value not in _METRICS:
+            raise ValueError(f"metric must be one of {_METRICS}, got {value!r}")
+        self.set(self.metric, value)
+        return self
+
+    def setInputCol(self, value: str) -> "ApproximateNearestNeighbors":
+        self.set(self.inputCol, value)
+        return self
+
+    def setIdCol(self, value: str) -> "ApproximateNearestNeighbors":
+        self.set(self.idCol, value)
+        return self
+
+    def setSeed(self, value: int) -> "ApproximateNearestNeighbors":
+        self.set(self.seed, value)
+        return self
+
+    def fit(self, dataset: Any) -> "ApproximateNearestNeighborsModel":
+        id_col = self.getIdCol()
+        items = as_matrix(extract_features(dataset, self.getInputCol(), drop=id_col))
+        ids = None
+        if id_col is not None:
+            if isinstance(dataset, DataFrame):
+                if id_col not in dataset.columns:
+                    raise ValueError(
+                        f"idCol={id_col!r} set, but the dataset has no such column"
+                    )
+                ids = np.asarray(dataset.select(id_col))
+            else:
+                try:
+                    import pandas as pd
+                except ImportError:  # pragma: no cover
+                    pd = None
+                if (
+                    pd is not None
+                    and isinstance(dataset, pd.DataFrame)
+                    and id_col in dataset.columns
+                ):
+                    ids = dataset[id_col].to_numpy()
+                else:
+                    raise ValueError(
+                        f"idCol={id_col!r} set, but the dataset has no such column"
+                    )
+        if self.getK() > items.shape[0]:
+            raise ValueError(f"k={self.getK()} exceeds item count {items.shape[0]}")
+        model = ApproximateNearestNeighborsModel(self.uid, np.asarray(items), ids)
+        model = self._copyValues(model)
+        if model.getAlgorithm() == "ivfflat":
+            with TraceRange("ann build index", TraceColor.YELLOW):
+                model._build_index()
+        return model
+
+
+class ApproximateNearestNeighborsModel(_ANNParams, Model):
+    """Indexed item set; ``kneighbors`` probes the IVF lists."""
+
+    def __init__(
+        self,
+        uid: Optional[str] = None,
+        items: Optional[np.ndarray] = None,
+        ids: Optional[np.ndarray] = None,
+    ):
+        super().__init__(uid)
+        self.items = None if items is None else np.asarray(items)
+        self.ids = None if ids is None else np.asarray(ids)
+        self._index: Optional[IVFIndex] = None
+
+    def _effective_nlist(self) -> int:
+        n = self.items.shape[0]
+        nlist = self.getAlgoParams().get("nlist")
+        if nlist is None:
+            # cuML-style default: ~sqrt(n) lists, at least 1.
+            nlist = max(1, int(np.sqrt(n)))
+        return min(int(nlist), n)
+
+    def _effective_nprobe(self, n_lists: int) -> int:
+        nprobe = self.getAlgoParams().get("nprobe")
+        if nprobe is None:
+            nprobe = max(1, n_lists // 8)
+        return min(int(nprobe), n_lists)
+
+    def _search_items(self) -> np.ndarray:
+        items = self.items.astype(_dtype(), copy=False)
+        return _normalize(items) if self.getMetric() == "cosine" else items
+
+    def _build_index(self) -> None:
+        self._index = build_ivf_index(
+            self._search_items(),
+            n_lists=self._effective_nlist(),
+            seed=self.getSeed(),
+            kmeans_iters=int(self.getAlgoParams().get("kmeans_iters", 10)),
+        )
+
+    def kneighbors(
+        self, queries: Any, k: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(distances (nq, k), indices (nq, k)) under the configured metric.
+
+        Unfilled slots when the probed lists hold fewer than k real
+        candidates are (inf, -1); raise nprobe/nlist to avoid them.
+        """
+        if self.items is None:
+            raise RuntimeError("model has no indexed items")
+        k = self.getK() if k is None else k
+        if not 1 <= k <= self.items.shape[0]:
+            raise ValueError(f"k must be in [1, {self.items.shape[0]}], got {k}")
+        metric = self.getMetric()
+        q = as_matrix(extract_features(queries, self.getInputCol(), drop=self.getIdCol()))
+        q = np.asarray(q).astype(_dtype(), copy=False)
+        if metric == "cosine":
+            q = _normalize(q)
+
+        with TraceRange("ann search", TraceColor.PURPLE):
+            if self.getAlgorithm() == "brute":
+                # knn's sqeuclidean output matches ivf_search's; the shared
+                # metric post-processing below then applies to both paths.
+                d2_j, idx = knn(
+                    jnp.asarray(q), jnp.asarray(self._search_items()), k=k,
+                    metric="sqeuclidean",
+                )
+                d2 = np.asarray(d2_j)
+            else:
+                if self._index is None:
+                    self._build_index()
+                d2_j, idx = ivf_search(self._index, jnp.asarray(q), k=k,
+                                       n_probe=self._effective_nprobe(self._index.n_lists))
+                d2 = np.asarray(d2_j)
+
+        idx = np.asarray(idx)
+        if metric == "euclidean":
+            return np.sqrt(d2), idx
+        if metric == "cosine":
+            return d2 / 2.0, idx
+        return d2, idx
+
+    def kneighbors_ids(self, queries: Any, k: Optional[int] = None):
+        """(distances, ids) mapped through the fitted idCol; -1 slots stay -1."""
+        d, idx = self.kneighbors(queries, k)
+        if self.ids is None:
+            return d, idx
+        mapped = np.where(idx >= 0, self.ids[np.clip(idx, 0, None)], -1)
+        return d, mapped
+
+    def transform(self, dataset: Any) -> Any:
+        """Append neighbor indices + distances columns (DataFrame input)."""
+        d, idx = self.kneighbors(dataset)
+        if isinstance(dataset, DataFrame):
+            out = dataset.withColumn("ann_indices", list(idx))
+            return out.withColumn("ann_distances", list(d))
+        try:
+            import pandas as pd
+
+            if isinstance(dataset, pd.DataFrame):
+                out = dataset.copy()
+                out["ann_indices"] = list(idx)
+                out["ann_distances"] = list(d)
+                return out
+        except ImportError:  # pragma: no cover
+            pass
+        return d, idx
+
+    def _save_impl(self, path: str) -> None:
+        save_metadata(
+            self,
+            path,
+            class_name="com.nvidia.rapids.ml.ApproximateNearestNeighborsModel",
+            extra_metadata={"hasIds": self.ids is not None},
+        )
+        cols = {"item": ("vector", [r for r in self.items])}
+        if self.ids is not None:
+            cols["id"] = ("scalar", self.ids.tolist())
+        save_rows(path, cols)
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "ApproximateNearestNeighborsModel":
+        metadata = load_metadata(path, expected_class="ApproximateNearestNeighborsModel")
+        rows = load_rows(path)
+        items = np.stack(rows["item"])
+        ids = np.asarray(rows["id"]) if metadata.get("hasIds") else None
+        model = cls(metadata["uid"], items, ids)
+        get_and_set_params(model, metadata)
+        # The index is rebuilt lazily on first kneighbors; deterministic
+        # given (items, nlist, seed), so probing matches the saved model.
+        return model

@@ -4,5 +4,14 @@ from spark_rapids_ml_tpu.models.nearest_neighbors import (
     NearestNeighbors,
     NearestNeighborsModel,
 )
+from spark_rapids_ml_tpu.models.approximate_nearest_neighbors import (
+    ApproximateNearestNeighbors,
+    ApproximateNearestNeighborsModel,
+)
 
-__all__ = ["NearestNeighbors", "NearestNeighborsModel"]
+__all__ = [
+    "NearestNeighbors",
+    "NearestNeighborsModel",
+    "ApproximateNearestNeighbors",
+    "ApproximateNearestNeighborsModel",
+]

@@ -1,0 +1,168 @@
+"""Approximate nearest neighbors — IVF-Flat, redesigned for the MXU.
+
+Beyond-the-reference capability (the reference ships only PCA — SURVEY.md
+§2; the modern RAPIDS Spark-ML line grew ApproximateNearestNeighbors on
+cuML, default algorithm ``ivfflat``). cuML's IVF-Flat walks per-list
+inverted indices with variable-length lists and warp-level scans — dynamic
+shapes and pointer-chasing a TPU can't tile. TPU-first redesign:
+
+  - **Coarse quantizer**: k-means over the items (``ops.kmeans`` — GEMM
+    Lloyd on the MXU).
+  - **Inverted lists as one dense tensor**: items grouped by list into a
+    (n_lists, L_max, d) array padded to the longest list, with a parallel
+    mask and original-index tensor. Padding trades HBM for static shapes —
+    the XLA-friendly version of CSR lists.
+  - **Search**: one (Bq, d) x (d, n_lists) GEMM ranks centroids, then a
+    ``lax.scan`` over the ``n_probe`` chosen lists: gather the (Bq, L_max)
+    candidate block, batched distance via einsum (MXU), and a running
+    top-k merge — identical merge discipline to ``ops.knn``. Live memory
+    is O(Bq * L_max * d), independent of n_probe and the item count.
+
+Setting ``n_probe = n_lists`` makes the search exact (every list probed),
+which the tests exploit as a brute-force oracle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_ml_tpu.ops.kmeans import assign_clusters, kmeans_plusplus_init, lloyd
+from spark_rapids_ml_tpu.ops.linalg import _dot_precision
+
+
+class IVFIndex(NamedTuple):
+    """Dense IVF-Flat index. All arrays are device-placeable.
+
+    centroids: (n_lists, d)
+    lists:     (n_lists, L_max, d)  — items grouped by nearest centroid
+    list_mask: (n_lists, L_max)     — 1.0 real row / 0.0 padding
+    list_ids:  (n_lists, L_max)     — original item indices, -1 at padding
+    """
+
+    centroids: jax.Array
+    lists: jax.Array
+    list_mask: jax.Array
+    list_ids: jax.Array
+
+    @property
+    def n_lists(self) -> int:
+        return self.lists.shape[0]
+
+
+def build_ivf_index(
+    items: np.ndarray,
+    n_lists: int,
+    seed: int = 0,
+    kmeans_iters: int = 10,
+) -> IVFIndex:
+    """Train the coarse quantizer and pack the inverted lists.
+
+    The quantizer runs on device (k-means++ init + Lloyd); the group-by-list
+    packing is a host-side argsort (one pass, done once at fit time).
+    """
+    items = np.asarray(items)
+    n, d = items.shape
+    if not 1 <= n_lists <= n:
+        raise ValueError(f"n_lists must be in [1, {n}], got {n_lists}")
+
+    x = jnp.asarray(items)
+    mask = jnp.ones(n, dtype=x.dtype)
+    key = jax.random.key(seed)
+    init = kmeans_plusplus_init(x, mask, key, n_lists)
+    centroids, _, _ = lloyd(x, mask, init, max_iter=kmeans_iters, tol=1e-4)
+    labels, _ = assign_clusters(x, centroids)
+    labels = np.asarray(labels)
+
+    order = np.argsort(labels, kind="stable")
+    counts = np.bincount(labels, minlength=n_lists)
+    l_max = max(int(counts.max()), 1)
+
+    lists = np.zeros((n_lists, l_max, d), dtype=items.dtype)
+    list_mask = np.zeros((n_lists, l_max), dtype=items.dtype)
+    list_ids = np.full((n_lists, l_max), -1, dtype=np.int32)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    for lid in range(n_lists):
+        sel = order[starts[lid] : starts[lid + 1]]
+        lists[lid, : sel.size] = items[sel]
+        list_mask[lid, : sel.size] = 1.0
+        list_ids[lid, : sel.size] = sel
+
+    return IVFIndex(
+        centroids=jnp.asarray(np.asarray(centroids)),
+        lists=jnp.asarray(lists),
+        list_mask=jnp.asarray(list_mask),
+        list_ids=jnp.asarray(list_ids),
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "n_probe", "block_q", "precision"))
+def ivf_search(
+    index: IVFIndex,
+    queries: jax.Array,
+    k: int,
+    n_probe: int,
+    block_q: int = 1024,
+    precision: str = "highest",
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k approximate neighbors: (sq-distances (nq, k), indices (nq, k)).
+
+    Indices are original item indices; unfilled slots (fewer than k
+    candidates in the probed lists) are (inf, -1).
+    """
+    n_lists, l_max, d = index.lists.shape
+    if not 1 <= n_probe <= n_lists:
+        raise ValueError(f"n_probe must be in [1, {n_lists}], got {n_probe}")
+    prec = _dot_precision(precision)
+    nq = queries.shape[0]
+    dtype = queries.dtype
+
+    n_qblocks = -(-nq // block_q)
+    pad = n_qblocks * block_q - nq
+    qp = jnp.pad(queries, ((0, pad), (0, 0)))
+
+    item_sq = jnp.sum(index.lists * index.lists, axis=2)  # (n_lists, L_max)
+
+    def one_query_block(qb):
+        q_sq = jnp.sum(qb * qb, axis=1)
+        c_sq = jnp.sum(index.centroids * index.centroids, axis=1)
+        qc = jnp.matmul(qb, index.centroids.T, precision=prec)
+        cd2 = q_sq[:, None] - 2.0 * qc + c_sq[None, :]
+        _, probe_ids = lax.top_k(-cd2, n_probe)  # (Bq, n_probe)
+
+        init = (
+            jnp.full((block_q, k), jnp.inf, dtype=dtype),
+            jnp.full((block_q, k), -1, jnp.int32),
+        )
+
+        def probe_step(carry, p):
+            best_d, best_i = carry
+            lid = probe_ids[:, p]  # (Bq,)
+            xb = index.lists[lid]  # (Bq, L_max, d) gather
+            mb = index.list_mask[lid]
+            ib = index.list_ids[lid]
+            xb_sq = item_sq[lid]
+            cross = jnp.einsum("bd,bld->bl", qb, xb, precision=prec)
+            d2 = jnp.maximum(q_sq[:, None] - 2.0 * cross + xb_sq, 0.0)
+            d2 = jnp.where(mb > 0, d2, jnp.inf)
+            cand_d = jnp.concatenate([best_d, d2], axis=1)
+            cand_i = jnp.concatenate([best_i, ib], axis=1)
+            neg_top, pos = lax.top_k(-cand_d, k)
+            return (-neg_top, jnp.take_along_axis(cand_i, pos, axis=1)), None
+
+        (best_d, best_i), _ = lax.scan(
+            probe_step, init, jnp.arange(n_probe, dtype=jnp.int32)
+        )
+        return best_d, best_i
+
+    qblocks = qp.reshape(n_qblocks, block_q, d)
+    best_d, best_i = lax.map(one_query_block, qblocks)
+    return (
+        best_d.reshape(n_qblocks * block_q, k)[:nq],
+        best_i.reshape(n_qblocks * block_q, k)[:nq],
+    )

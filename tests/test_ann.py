@@ -1,0 +1,194 @@
+"""ApproximateNearestNeighbors: IVF-Flat vs the exact brute-force oracle.
+
+Key oracle: probing ALL lists (n_probe = n_lists) makes IVF-Flat exact, so
+it must reproduce brute-force kNN bit-for-bit on indices (away from
+distance ties). Partial probing is checked via recall.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.core.data import DataFrame
+from spark_rapids_ml_tpu.models.approximate_nearest_neighbors import (
+    ApproximateNearestNeighbors,
+    ApproximateNearestNeighborsModel,
+)
+from spark_rapids_ml_tpu.ops.ann import build_ivf_index, ivf_search
+from spark_rapids_ml_tpu.ops.knn import knn
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def recall(approx_idx, exact_idx):
+    hits = sum(
+        len(set(a.tolist()) & set(e.tolist())) for a, e in zip(approx_idx, exact_idx)
+    )
+    return hits / exact_idx.size
+
+
+class TestOps:
+    def test_full_probe_is_exact(self, rng):
+        items = rng.normal(size=(500, 16)).astype(np.float32)
+        q = rng.normal(size=(40, 16)).astype(np.float32)
+        index = build_ivf_index(items, n_lists=10, seed=0)
+        d2, idx = ivf_search(index, q, k=5, n_probe=10)
+        d2_ref, idx_ref = knn(q, items, k=5, metric="sqeuclidean")
+        np.testing.assert_allclose(np.asarray(d2), np.asarray(d2_ref), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(idx), np.asarray(idx_ref))
+
+    def test_partial_probe_recall(self, rng):
+        # Clustered data: probing a few lists still finds the neighbors.
+        centers = rng.normal(size=(20, 8)) * 10
+        items = (centers[rng.integers(0, 20, 2000)] + rng.normal(size=(2000, 8))).astype(
+            np.float32
+        )
+        q = items[rng.integers(0, 2000, 100)] + 0.01
+        index = build_ivf_index(items, n_lists=20, seed=0)
+        _, idx = ivf_search(index, q, k=10, n_probe=5)
+        _, idx_ref = knn(q, items, k=10, metric="sqeuclidean")
+        assert recall(np.asarray(idx), np.asarray(idx_ref)) >= 0.9
+
+    def test_index_covers_all_items(self, rng):
+        items = rng.normal(size=(257, 4)).astype(np.float32)
+        index = build_ivf_index(items, n_lists=7, seed=1)
+        ids = np.asarray(index.list_ids)
+        real = ids[ids >= 0]
+        assert sorted(real.tolist()) == list(range(257))
+        mask = np.asarray(index.list_mask)
+        np.testing.assert_array_equal(mask > 0, ids >= 0)
+
+    def test_unfilled_slots_minus_one(self, rng):
+        # k exceeds candidates in the single probed list.
+        items = rng.normal(size=(50, 4)).astype(np.float32) * 10
+        index = build_ivf_index(items, n_lists=10, seed=0)
+        d2, idx = ivf_search(index, items[:3], k=40, n_probe=1)
+        d2, idx = np.asarray(d2), np.asarray(idx)
+        assert np.any(idx == -1)
+        assert np.all(np.isinf(d2[idx == -1]))
+
+    def test_query_blocking_matches(self, rng):
+        items = rng.normal(size=(300, 8)).astype(np.float32)
+        q = rng.normal(size=(70, 8)).astype(np.float32)
+        index = build_ivf_index(items, n_lists=6, seed=0)
+        d_a, i_a = ivf_search(index, q, k=4, n_probe=6, block_q=16)
+        d_b, i_b = ivf_search(index, q, k=4, n_probe=6, block_q=1024)
+        np.testing.assert_allclose(np.asarray(d_a), np.asarray(d_b), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(i_a), np.asarray(i_b))
+
+    def test_validation(self, rng):
+        items = rng.normal(size=(20, 4)).astype(np.float32)
+        with pytest.raises(ValueError):
+            build_ivf_index(items, n_lists=21)
+        index = build_ivf_index(items, n_lists=4)
+        with pytest.raises(ValueError):
+            ivf_search(index, items, k=3, n_probe=5)
+
+
+class TestEstimator:
+    def test_fit_kneighbors_exact_mode(self, rng):
+        items = rng.normal(size=(400, 8)).astype(np.float32)
+        model = (
+            ApproximateNearestNeighbors()
+            .setK(5)
+            .setAlgoParams({"nlist": 8, "nprobe": 8})
+            .fit(items)
+        )
+        d, idx = model.kneighbors(items[:20])
+        _, idx_ref = knn(items[:20], items, k=5, metric="sqeuclidean")
+        np.testing.assert_array_equal(idx, np.asarray(idx_ref))
+        # euclidean metric: self-distance 0, self first
+        np.testing.assert_array_equal(idx[:, 0], np.arange(20))
+        np.testing.assert_allclose(d[:, 0], 0.0, atol=1e-3)
+
+    def test_brute_algorithm(self, rng):
+        items = rng.normal(size=(100, 6)).astype(np.float32)
+        m = ApproximateNearestNeighbors().setK(3).setAlgorithm("brute").fit(items)
+        d, idx = m.kneighbors(items[:10])
+        np.testing.assert_array_equal(idx[:, 0], np.arange(10))
+
+    def test_cosine_metric(self, rng):
+        items = rng.normal(size=(200, 8)).astype(np.float32)
+        m = (
+            ApproximateNearestNeighbors()
+            .setK(4)
+            .setMetric("cosine")
+            .setAlgoParams({"nlist": 4, "nprobe": 4})
+            .fit(items)
+        )
+        d, idx = m.kneighbors(items[:15])
+        # cosine distance to self is 0; scaled copies are also at 0
+        np.testing.assert_allclose(d[:, 0], 0.0, atol=1e-5)
+        b = ApproximateNearestNeighbors().setK(4).setMetric("cosine").setAlgorithm(
+            "brute"
+        ).fit(items)
+        d_b, idx_b = b.kneighbors(items[:15])
+        np.testing.assert_array_equal(idx, idx_b)
+        np.testing.assert_allclose(d, d_b, atol=1e-5)
+
+    def test_id_col_mapping(self, rng):
+        import pandas as pd
+
+        x = rng.normal(size=(60, 5))
+        df = pd.DataFrame(x, columns=[f"c{i}" for i in range(5)])
+        df["rid"] = np.arange(1000, 1060)
+        m = (
+            ApproximateNearestNeighbors()
+            .setK(3)
+            .setIdCol("rid")
+            .setAlgoParams({"nlist": 4, "nprobe": 4})
+            .fit(df)
+        )
+        d, ids = m.kneighbors_ids(df)
+        np.testing.assert_array_equal(ids[:, 0], df["rid"].to_numpy())
+
+    def test_dataframe_transform(self, rng):
+        x = rng.normal(size=(50, 4))
+        df = DataFrame({"features": list(x)})
+        m = ApproximateNearestNeighbors().setK(2).setAlgoParams(
+            {"nlist": 2, "nprobe": 2}
+        ).fit(df)
+        out = m.transform(df)
+        assert "ann_indices" in out.columns and "ann_distances" in out.columns
+
+    def test_param_validation(self):
+        with pytest.raises(ValueError):
+            ApproximateNearestNeighbors().setAlgorithm("hnsw")
+        with pytest.raises(ValueError):
+            ApproximateNearestNeighbors().setMetric("manhattan")
+        with pytest.raises(ValueError):
+            ApproximateNearestNeighbors().setAlgoParams({"bogus": 1})
+        with pytest.raises(ValueError):
+            ApproximateNearestNeighbors().setK(0)
+
+    def test_defaults_and_auto_nlist(self, rng):
+        est = ApproximateNearestNeighbors()
+        assert est.getK() == 5
+        assert est.getAlgorithm() == "ivfflat"
+        items = rng.normal(size=(400, 4)).astype(np.float32)
+        m = est.fit(items)
+        # auto nlist ~ sqrt(400) = 20
+        assert m._index is not None
+        assert m._index.n_lists == 20
+
+    def test_read_write_round_trip(self, tmp_path, rng):
+        items = rng.normal(size=(120, 6)).astype(np.float32)
+        m = (
+            ApproximateNearestNeighbors()
+            .setK(4)
+            .setSeed(3)
+            .setAlgoParams({"nlist": 6, "nprobe": 3})
+            .fit(items)
+        )
+        q = rng.normal(size=(10, 6)).astype(np.float32)
+        d, idx = m.kneighbors(q)
+        path = str(tmp_path / "ann")
+        m.save(path)
+        loaded = ApproximateNearestNeighborsModel.load(path)
+        assert loaded.getAlgoParams() == {"nlist": 6, "nprobe": 3}
+        assert loaded.getSeed() == 3
+        d2, idx2 = loaded.kneighbors(q)
+        np.testing.assert_array_equal(idx, idx2)
+        np.testing.assert_allclose(d, d2, rtol=1e-6)
