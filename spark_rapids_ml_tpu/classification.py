@@ -4,5 +4,14 @@ from spark_rapids_ml_tpu.models.logistic_regression import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.random_forest import (
+    RandomForestClassifier,
+    RandomForestClassificationModel,
+)
 
-__all__ = ["LogisticRegression", "LogisticRegressionModel"]
+__all__ = [
+    "LogisticRegression",
+    "LogisticRegressionModel",
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+]

@@ -17,6 +17,12 @@ from spark_rapids_ml_tpu.models.approximate_nearest_neighbors import (
     ApproximateNearestNeighbors,
     ApproximateNearestNeighborsModel,
 )
+from spark_rapids_ml_tpu.models.random_forest import (
+    RandomForestClassifier,
+    RandomForestClassificationModel,
+    RandomForestRegressor,
+    RandomForestRegressionModel,
+)
 
 __all__ = [
     "ApproximateNearestNeighbors",
@@ -33,4 +39,8 @@ __all__ = [
     "LogisticRegressionModel",
     "NearestNeighbors",
     "NearestNeighborsModel",
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
 ]

@@ -1,0 +1,541 @@
+"""RandomForestClassifier / RandomForestRegressor — Spark ML surface, XLA compute.
+
+Param surface mirrors ``org.apache.spark.ml.classification.RandomForestClassifier``
+and ``...regression.RandomForestRegressor``: ``numTrees``, ``maxDepth``,
+``maxBins``, ``minInstancesPerNode``, ``minInfoGain``, ``subsamplingRate``,
+``featureSubsetStrategy``, ``impurity``, ``bootstrap``, ``seed``, plus the
+usual column params. Beyond-the-reference capability (the reference repo
+ships only PCA — SURVEY.md §2; the modern RAPIDS Spark-ML line accelerates
+random forests via cuML), so the test oracle is scikit-learn / handcrafted
+separable data rather than a reference file.
+
+All trees grow simultaneously, level by level, with histogram GEMMs on the
+MXU — see :mod:`spark_rapids_ml_tpu.ops.trees` for the kernel design.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix
+from spark_rapids_ml_tpu.core.estimator import Estimator, Model
+from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toInt, toString
+from spark_rapids_ml_tpu.core.persistence import (
+    MLReadable,
+    get_and_set_params,
+    load_metadata,
+    load_rows,
+    save_metadata,
+    save_rows,
+)
+from spark_rapids_ml_tpu.models.linear_regression import _extract_xy
+from spark_rapids_ml_tpu.ops.trees import (
+    Forest,
+    bin_features,
+    feature_importances,
+    forest_predict_proba,
+    forest_predict_reg,
+    grow_forest,
+    quantize_features,
+    sample_weights,
+)
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def resolve_feature_subset(strategy: str, d: int, n_trees: int, classification: bool) -> int:
+    """Spark's featureSubsetStrategy -> number of features per split."""
+    s = strategy.lower()
+    if s == "auto":
+        if n_trees == 1:
+            return d
+        return (
+            max(1, int(math.ceil(math.sqrt(d))))
+            if classification
+            else max(1, int(math.ceil(d / 3.0)))
+        )
+    if s == "all":
+        return d
+    if s == "sqrt":
+        return max(1, int(math.ceil(math.sqrt(d))))
+    if s == "log2":
+        return max(1, int(math.ceil(math.log2(max(d, 2)))))
+    if s == "onethird":
+        return max(1, int(math.ceil(d / 3.0)))
+    # Spark's grammar: an all-digits string is an absolute count; anything
+    # with a decimal point is a fraction in (0, 1] of the features (so
+    # "1.0" means ALL features, not one).
+    try:
+        return min(d, max(1, int(strategy)))
+    except ValueError:
+        pass
+    try:
+        v = float(strategy)
+    except ValueError:
+        raise ValueError(f"unknown featureSubsetStrategy {strategy!r}")
+    if 0 < v <= 1:
+        return max(1, int(math.ceil(v * d)))
+    raise ValueError(f"unknown featureSubsetStrategy {strategy!r}")
+
+
+class _RandomForestParams(Params):
+    numTrees = Param("_", "numTrees", "number of trees", toInt)
+    maxDepth = Param("_", "maxDepth", "maximum tree depth", toInt)
+    maxBins = Param("_", "maxBins", "max histogram bins per feature", toInt)
+    minInstancesPerNode = Param(
+        "_", "minInstancesPerNode", "min instances each child must have", toInt
+    )
+    minInfoGain = Param("_", "minInfoGain", "min info gain for a split", toFloat)
+    subsamplingRate = Param("_", "subsamplingRate", "row sampling rate per tree", toFloat)
+    featureSubsetStrategy = Param(
+        "_", "featureSubsetStrategy", "features considered per split", toString
+    )
+    impurity = Param("_", "impurity", "split criterion", toString)
+    bootstrap = Param("_", "bootstrap", "sample with replacement", toBoolean)
+    seed = Param("_", "seed", "random seed", toInt)
+    featuresCol = Param("_", "featuresCol", "features column name", toString)
+    labelCol = Param("_", "labelCol", "label column name", toString)
+    predictionCol = Param("_", "predictionCol", "prediction column name", toString)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(
+            numTrees=20,
+            maxDepth=5,
+            maxBins=32,
+            minInstancesPerNode=1,
+            minInfoGain=0.0,
+            subsamplingRate=1.0,
+            featureSubsetStrategy="auto",
+            bootstrap=True,
+            seed=0,
+            featuresCol="features",
+            labelCol="label",
+            predictionCol="prediction",
+        )
+
+    def getNumTrees(self) -> int:
+        return self.getOrDefault(self.numTrees)
+
+    def getMaxDepth(self) -> int:
+        return self.getOrDefault(self.maxDepth)
+
+    def getMaxBins(self) -> int:
+        return self.getOrDefault(self.maxBins)
+
+    def getMinInstancesPerNode(self) -> int:
+        return self.getOrDefault(self.minInstancesPerNode)
+
+    def getMinInfoGain(self) -> float:
+        return self.getOrDefault(self.minInfoGain)
+
+    def getSubsamplingRate(self) -> float:
+        return self.getOrDefault(self.subsamplingRate)
+
+    def getFeatureSubsetStrategy(self) -> str:
+        return self.getOrDefault(self.featureSubsetStrategy)
+
+    def getImpurity(self) -> str:
+        return self.getOrDefault(self.impurity)
+
+    def getBootstrap(self) -> bool:
+        return self.getOrDefault(self.bootstrap)
+
+    def getSeed(self) -> int:
+        return self.getOrDefault(self.seed)
+
+    def getFeaturesCol(self) -> str:
+        return self.getOrDefault(self.featuresCol)
+
+    def getLabelCol(self) -> str:
+        return self.getOrDefault(self.labelCol)
+
+    def getPredictionCol(self) -> str:
+        return self.getOrDefault(self.predictionCol)
+
+    # Chainable setters shared by estimators and models.
+    def _chain(self, param, value):
+        self.set(param, value)
+        return self
+
+    def setNumTrees(self, v: int):
+        if v < 1:
+            raise ValueError(f"numTrees must be >= 1, got {v}")
+        return self._chain(self.numTrees, v)
+
+    def setMaxDepth(self, v: int):
+        if not 0 <= v <= 14:
+            raise ValueError(f"maxDepth must be in [0, 14], got {v}")
+        return self._chain(self.maxDepth, v)
+
+    def setMaxBins(self, v: int):
+        if v < 2:
+            raise ValueError(f"maxBins must be >= 2, got {v}")
+        return self._chain(self.maxBins, v)
+
+    def setMinInstancesPerNode(self, v: int):
+        if v < 1:
+            raise ValueError(f"minInstancesPerNode must be >= 1, got {v}")
+        return self._chain(self.minInstancesPerNode, v)
+
+    def setMinInfoGain(self, v: float):
+        return self._chain(self.minInfoGain, v)
+
+    def setSubsamplingRate(self, v: float):
+        if not 0 < v <= 1:
+            raise ValueError(f"subsamplingRate must be in (0, 1], got {v}")
+        return self._chain(self.subsamplingRate, v)
+
+    def setFeatureSubsetStrategy(self, v: str):
+        return self._chain(self.featureSubsetStrategy, v)
+
+    def setBootstrap(self, v: bool):
+        return self._chain(self.bootstrap, v)
+
+    def setSeed(self, v: int):
+        return self._chain(self.seed, v)
+
+    def setFeaturesCol(self, v: str):
+        return self._chain(self.featuresCol, v)
+
+    def setLabelCol(self, v: str):
+        return self._chain(self.labelCol, v)
+
+    def setPredictionCol(self, v: str):
+        return self._chain(self.predictionCol, v)
+
+
+def _transform_features(dataset: Any, features_col: str, label_col: str):
+    """Dataset -> raw feature rows for transform(): DataFrame shim selects
+    the features column; pandas uses it if present, else treats the frame
+    (minus the label column) as a bare matrix; arrays pass through."""
+    if isinstance(dataset, DataFrame):
+        return dataset.select(features_col)
+    try:
+        import pandas as pd
+
+        if isinstance(dataset, pd.DataFrame):
+            if features_col in dataset.columns:
+                return dataset[features_col].tolist()
+            drop = [c for c in (label_col,) if c in dataset.columns]
+            return dataset.drop(columns=drop).to_numpy(dtype=np.float64)
+    except ImportError:  # pragma: no cover
+        pass
+    return dataset
+
+
+def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarray,
+                impurity: str, classification: bool) -> Forest:
+    """Shared fit: quantize, sample, grow. Returns the Forest arrays."""
+    n, d = x.shape
+    n_bins = min(params.getMaxBins(), max(2, n))
+    m = resolve_feature_subset(
+        params.getFeatureSubsetStrategy(), d, params.getNumTrees(), classification
+    )
+    key = jax.random.key(params.getSeed())
+    k_sample, k_feat = jax.random.split(key)
+
+    xj = jnp.asarray(x, dtype=jnp.float32)
+    edges = quantize_features(xj, n_bins)
+    xb = bin_features(xj, edges)
+    w = sample_weights(
+        k_sample, params.getNumTrees(), n, params.getSubsamplingRate(),
+        params.getBootstrap(),
+    )
+    return grow_forest(
+        xb,
+        jnp.asarray(row_stats, dtype=jnp.float32),
+        w,
+        edges.astype(jnp.float32),
+        k_feat,
+        max_depth=params.getMaxDepth(),
+        n_bins=n_bins,
+        impurity=impurity,
+        feat_subset=m,
+        min_instances=params.getMinInstancesPerNode(),
+        min_info_gain=params.getMinInfoGain(),
+    )
+
+
+class RandomForestClassifier(_RandomForestParams, Estimator, MLReadable):
+    """``RandomForestClassifier().setNumTrees(20).fit((X, y))``."""
+
+    probabilityCol = Param("_", "probabilityCol", "probability column name", toString)
+    rawPredictionCol = Param(
+        "_", "rawPredictionCol", "raw prediction column name", toString
+    )
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(
+            impurity="gini",
+            probabilityCol="probability",
+            rawPredictionCol="rawPrediction",
+        )
+
+    def getProbabilityCol(self) -> str:
+        return self.getOrDefault(self.probabilityCol)
+
+    def getRawPredictionCol(self) -> str:
+        return self.getOrDefault(self.rawPredictionCol)
+
+    def setProbabilityCol(self, v: str):
+        return self._chain(self.probabilityCol, v)
+
+    def setRawPredictionCol(self, v: str):
+        return self._chain(self.rawPredictionCol, v)
+
+    def setImpurity(self, v: str):
+        if v not in ("gini", "entropy"):
+            raise ValueError(f"impurity must be gini or entropy, got {v!r}")
+        return self._chain(self.impurity, v)
+
+    def fit(self, dataset: Any) -> "RandomForestClassificationModel":
+        x, y = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
+        y_int = y.astype(np.int64)
+        if not np.array_equal(y_int, y) or np.any(y_int < 0):
+            raise ValueError("labels must be non-negative integers")
+        n_classes = int(y_int.max()) + 1
+        n_classes = max(n_classes, 2)
+        row_stats = np.zeros((x.shape[0], n_classes), dtype=np.float32)
+        row_stats[np.arange(x.shape[0]), y_int] = 1.0  # one-hot class counts
+        with TraceRange("rf-classifier fit", TraceColor.GREEN):
+            forest = _fit_forest(self, x, row_stats, self.getImpurity(), True)
+        model = RandomForestClassificationModel(
+            self.uid, forest, numFeatures=x.shape[1], numClasses=n_classes
+        )
+        return self._copyValues(model)
+
+
+class RandomForestClassificationModel(_RandomForestParams, Model):
+    probabilityCol = RandomForestClassifier.probabilityCol
+    rawPredictionCol = RandomForestClassifier.rawPredictionCol
+
+    def __init__(
+        self,
+        uid: Optional[str] = None,
+        forest: Optional[Forest] = None,
+        numFeatures: int = 0,
+        numClasses: int = 0,
+    ):
+        super().__init__(uid)
+        self._setDefault(
+            impurity="gini",
+            probabilityCol="probability",
+            rawPredictionCol="rawPrediction",
+        )
+        self._forest = forest
+        self.numFeatures = numFeatures
+        self.numClasses = numClasses
+
+    def getProbabilityCol(self) -> str:
+        return self.getOrDefault(self.probabilityCol)
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        return feature_importances(self._forest, self.numFeatures)
+
+    @property
+    def totalNumNodes(self) -> int:
+        leaf = np.asarray(self._forest.is_leaf)
+        feat = np.asarray(self._forest.feature)
+        # Reachable nodes: splits plus leaves that carry weight.
+        w = np.asarray(self._forest.node_weight)
+        return int(np.sum((feat >= 0) | (leaf & (w > 0))))
+
+    def predictProbability(self, x) -> np.ndarray:
+        x = as_matrix(x)
+        probs = forest_predict_proba(
+            jnp.asarray(x, dtype=jnp.float32), self._forest, _forest_depth(self._forest)
+        )
+        return np.asarray(probs)
+
+    def predict(self, x) -> np.ndarray:
+        return np.argmax(self.predictProbability(x), axis=1)
+
+    def transform(self, dataset: Any) -> Any:
+        rows = _transform_features(dataset, self.getFeaturesCol(), self.getLabelCol())
+        probs = self.predictProbability(rows)
+        preds = np.argmax(probs, axis=1)
+        # rawPrediction mirrors Spark RF: unnormalized per-class vote mass
+        # (mean probability scaled by the tree count).
+        raws = probs * len(np.asarray(self._forest.feature))
+        if isinstance(dataset, DataFrame):
+            out = dataset.withColumn(self.getPredictionCol(), list(preds.astype(float)))
+            out = out.withColumn(self.getProbabilityCol(), [p for p in probs])
+            return out.withColumn(self.getOrDefault(self.rawPredictionCol), [r for r in raws])
+        try:
+            import pandas as pd
+
+            if isinstance(dataset, pd.DataFrame):
+                out = dataset.copy()
+                out[self.getPredictionCol()] = preds.astype(float)
+                out[self.getProbabilityCol()] = list(probs)
+                out[self.getOrDefault(self.rawPredictionCol)] = list(raws)
+                return out
+        except ImportError:  # pragma: no cover
+            pass
+        return preds
+
+    def _save_impl(self, path: str) -> None:
+        _save_forest_model(
+            self,
+            path,
+            "org.apache.spark.ml.classification.RandomForestClassificationModel",
+            {"numFeatures": self.numFeatures, "numClasses": self.numClasses},
+        )
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "RandomForestClassificationModel":
+        metadata, forest = _load_forest_model(path, "RandomForestClassificationModel")
+        model = cls(
+            metadata["uid"],
+            forest,
+            numFeatures=metadata.get("numFeatures", 0),
+            numClasses=metadata.get("numClasses", 0),
+        )
+        get_and_set_params(model, metadata)
+        return model
+
+
+class RandomForestRegressor(_RandomForestParams, Estimator, MLReadable):
+    """``RandomForestRegressor().setNumTrees(20).fit((X, y))``."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(impurity="variance")
+
+    def setImpurity(self, v: str):
+        if v != "variance":
+            raise ValueError(f"regression impurity must be variance, got {v!r}")
+        return self._chain(self.impurity, v)
+
+    def fit(self, dataset: Any) -> "RandomForestRegressionModel":
+        x, y = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
+        # Stats channels [1, y, y^2] -> weighted variance impurity. Labels
+        # are centered first: the E[y^2] - mean^2 form in float32 would lose
+        # the variance signal to cancellation when |mean(y)| >> std(y);
+        # variance gains are shift-invariant, so centering changes nothing
+        # but the conditioning. The mean is added back to the leaf values.
+        y_mean = float(np.mean(y)) if y.size else 0.0
+        yc = y - y_mean
+        row_stats = np.stack([np.ones_like(yc), yc, yc * yc], axis=1)
+        with TraceRange("rf-regressor fit", TraceColor.GREEN):
+            forest = _fit_forest(self, x, row_stats, "variance", False)
+        forest = forest._replace(leaf_value=forest.leaf_value + y_mean)
+        model = RandomForestRegressionModel(self.uid, forest, numFeatures=x.shape[1])
+        return self._copyValues(model)
+
+
+class RandomForestRegressionModel(_RandomForestParams, Model):
+    def __init__(
+        self,
+        uid: Optional[str] = None,
+        forest: Optional[Forest] = None,
+        numFeatures: int = 0,
+    ):
+        super().__init__(uid)
+        self._setDefault(impurity="variance")
+        self._forest = forest
+        self.numFeatures = numFeatures
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        return feature_importances(self._forest, self.numFeatures)
+
+    def predict(self, x) -> np.ndarray:
+        x = as_matrix(x)
+        return np.asarray(
+            forest_predict_reg(
+                jnp.asarray(x, dtype=jnp.float32), self._forest, _forest_depth(self._forest)
+            )
+        )
+
+    def transform(self, dataset: Any) -> Any:
+        rows = _transform_features(dataset, self.getFeaturesCol(), self.getLabelCol())
+        preds = self.predict(rows)
+        if isinstance(dataset, DataFrame):
+            return dataset.withColumn(self.getPredictionCol(), list(preds))
+        try:
+            import pandas as pd
+
+            if isinstance(dataset, pd.DataFrame):
+                out = dataset.copy()
+                out[self.getPredictionCol()] = preds
+                return out
+        except ImportError:  # pragma: no cover
+            pass
+        return preds
+
+    def _save_impl(self, path: str) -> None:
+        _save_forest_model(
+            self,
+            path,
+            "org.apache.spark.ml.regression.RandomForestRegressionModel",
+            {"numFeatures": self.numFeatures},
+        )
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "RandomForestRegressionModel":
+        metadata, forest = _load_forest_model(path, "RandomForestRegressionModel")
+        model = cls(metadata["uid"], forest, numFeatures=metadata.get("numFeatures", 0))
+        get_and_set_params(model, metadata)
+        return model
+
+
+def _forest_depth(forest: Forest) -> int:
+    """Recover max_depth from the heap size: N = 2^(D+1) - 1."""
+    n_nodes = forest.feature.shape[1]
+    return int(math.log2(n_nodes + 1)) - 1
+
+
+def _save_forest_model(model, path: str, class_name: str, extra: dict) -> None:
+    """Row-per-node layout (treeID, nodeID, split + leaf payload) — the same
+    shape as Spark's NodeData table (reference-era Spark stores
+    (treeID, nodeData struct) rows; here the struct is flattened)."""
+    f = model._forest
+    T, N = np.asarray(f.feature).shape
+    save_metadata(model, path, class_name=class_name, extra_metadata=extra)
+    tree_id = np.repeat(np.arange(T), N)
+    node_id = np.tile(np.arange(N), T)
+    save_rows(
+        path,
+        {
+            "treeID": ("scalar", tree_id.tolist()),
+            "nodeID": ("scalar", node_id.tolist()),
+            "feature": ("scalar", np.asarray(f.feature).ravel().tolist()),
+            "threshold": ("scalar", np.asarray(f.threshold).ravel().astype(float).tolist()),
+            "isLeaf": ("scalar", np.asarray(f.is_leaf).ravel().tolist()),
+            "leafValue": ("vector", list(np.asarray(f.leaf_value).reshape(T * N, -1))),
+            "nodeWeight": ("scalar", np.asarray(f.node_weight).ravel().astype(float).tolist()),
+            "nodeGain": ("scalar", np.asarray(f.node_gain).ravel().astype(float).tolist()),
+        },
+    )
+
+
+def _load_forest_model(path: str, expected_class: str):
+    metadata = load_metadata(path, expected_class=expected_class)
+    rows = load_rows(path)
+    tree_id = np.asarray(rows["treeID"])
+    node_id = np.asarray(rows["nodeID"])
+    T = int(tree_id.max()) + 1
+    N = int(node_id.max()) + 1
+    order = np.argsort(tree_id * N + node_id)
+
+    def grid(name, dtype):
+        return np.asarray(rows[name])[order].reshape(T, N).astype(dtype)
+
+    leaf_value = np.stack([rows["leafValue"][i] for i in order]).reshape(T, N, -1)
+    forest = Forest(
+        jnp.asarray(grid("feature", np.int32)),
+        jnp.asarray(grid("threshold", np.float32)),
+        jnp.asarray(grid("isLeaf", bool)),
+        jnp.asarray(leaf_value.astype(np.float32)),
+        jnp.asarray(grid("nodeWeight", np.float32)),
+        jnp.asarray(grid("nodeGain", np.float32)),
+    )
+    return metadata, forest

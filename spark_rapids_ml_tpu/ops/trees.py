@@ -1,0 +1,427 @@
+"""Random-forest kernels — level-order histogram tree growth on the MXU.
+
+Beyond-the-reference capability (the reference ships only PCA — SURVEY.md §2;
+the modern RAPIDS Spark-ML line grew RandomForestClassifier/Regressor on
+cuML). The CUDA lineage builds trees node-by-node with scatter-heavy
+histogram kernels; the TPU-first formulation instead grows ALL trees and ALL
+nodes of one depth level simultaneously with dense one-hot matmuls:
+
+  hist[t, node, feature, bin, stat] =
+      sum_r onehot_node[t, r, node] * onehot_bin[r, feature*B + bin]
+            * weight[t, r] * row_stat[r, stat]
+
+which is one (T*M, rows) x (rows, d*B) GEMM per stat channel per row block —
+exactly the shape the systolic array wants. Rows stream through a
+``lax.scan`` in fixed-size blocks so memory stays O(block * d * B) and every
+shape is static. Split evaluation (prefix sums over bins, impurity, argmax)
+is elementwise/reduction work XLA fuses behind the matmuls.
+
+Trees are heap-indexed, static-shape arrays: node ``g`` has children
+``2g+1`` / ``2g+2``; a ``max_depth`` forest always allocates
+``2^(max_depth+1)-1`` slots. Prediction walks all trees in parallel with a
+``fori_loop`` of gathers — no per-row Python, no recursion, no dynamic
+shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class Forest(NamedTuple):
+    """Heap-indexed forest arrays; N = 2^(max_depth+1) - 1 nodes per tree.
+
+    ``feature`` is -1 at leaves; traversal is governed by ``is_leaf``. A row
+    goes LEFT when ``x[feature] <= threshold``. ``leaf_value`` holds the
+    class distribution (classification, S=C) or [mean] (regression, S=1).
+    ``node_weight``/``node_gain`` feed featureImportances.
+    """
+
+    feature: jax.Array  # (T, N) int32
+    threshold: jax.Array  # (T, N) float32
+    is_leaf: jax.Array  # (T, N) bool
+    leaf_value: jax.Array  # (T, N, S_out) float32
+    node_weight: jax.Array  # (T, N) float32
+    node_gain: jax.Array  # (T, N) float32
+
+
+def quantize_features(
+    x: jax.Array, max_bins: int, max_sample_rows: int = 262_144
+) -> jax.Array:
+    """Per-feature quantile bin edges, shape (d, max_bins - 1), ascending.
+
+    Continuous-feature binning as in distributed tree learners: edges are
+    the (i+1)/B quantiles of (a row-sample of) each feature. Duplicate edges
+    from low-cardinality features simply produce empty bins, which can never
+    win a split (zero weight on one side).
+    """
+    n = x.shape[0]
+    if n > max_sample_rows:
+        stride = -(-n // max_sample_rows)
+        x = x[::stride]
+    qs = jnp.arange(1, max_bins, dtype=x.dtype) / max_bins
+    return jnp.quantile(x, qs, axis=0).T  # (d, B-1)
+
+
+@jax.jit
+def bin_features(x: jax.Array, edges: jax.Array) -> jax.Array:
+    """Map raw features to bin ids: bin = #{edges e : x > e}, in [0, B-1].
+
+    With this convention, "bin <= b" is exactly "x <= edges[b]", so raw
+    thresholds for prediction are just the winning bin's upper edge.
+    """
+    # (n, d, B-1) comparison; blocked over rows to bound the temporary.
+    n, d = x.shape
+    block = max(1, min(n, 1 << 22) // max(1, d * edges.shape[1]) + 1)
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+    xp = jnp.pad(x, ((0, pad), (0, 0))).reshape(n_blocks, block, d)
+
+    def step(_, xb):
+        return None, jnp.sum(xb[:, :, None] > edges[None, :, :], axis=2)
+
+    _, bins = lax.scan(step, None, xp)
+    return bins.reshape(-1, d)[:n].astype(jnp.int32)
+
+
+def _impurity(stats: jax.Array, kind: str) -> Tuple[jax.Array, jax.Array]:
+    """(impurity, total_weight) from a stats vector along the last axis.
+
+    Classification stats = per-class weighted counts; regression stats =
+    [w, w*y, w*y^2] (weighted variance impurity, as in Spark's Variance).
+    """
+    if kind in ("gini", "entropy"):
+        w = jnp.sum(stats, axis=-1)
+        p = stats / jnp.maximum(w, 1e-12)[..., None]
+        if kind == "gini":
+            imp = 1.0 - jnp.sum(p * p, axis=-1)
+        else:
+            # log2, matching Spark ML's Entropy — keeps minInfoGain
+            # thresholds comparable across frameworks.
+            imp = -jnp.sum(jnp.where(p > 0, p * jnp.log2(p), 0.0), axis=-1)
+        return jnp.where(w > 0, imp, 0.0), w
+    if kind == "variance":
+        w = stats[..., 0]
+        mean = stats[..., 1] / jnp.maximum(w, 1e-12)
+        var = stats[..., 2] / jnp.maximum(w, 1e-12) - mean * mean
+        return jnp.where(w > 0, jnp.maximum(var, 0.0), 0.0), w
+    raise ValueError(f"unknown impurity {kind!r}")
+
+
+def _level_histogram(
+    node_idx: jax.Array,  # (T, n) global heap ids, -1 = inactive
+    weights: jax.Array,  # (T, n)
+    x_binned: jax.Array,  # (n, d)
+    row_stats: jax.Array,  # (n, S)
+    offset: int,
+    n_nodes: int,
+    n_bins: int,
+    block_rows: int,
+) -> jax.Array:
+    """(T, n_nodes, d, n_bins, S) histogram via blocked one-hot GEMMs."""
+    T, n = node_idx.shape
+    d = x_binned.shape[1]
+    S = row_stats.shape[1]
+    block = min(block_rows, n)
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+
+    ni = jnp.pad(node_idx, ((0, 0), (0, pad)), constant_values=-1)
+    w = jnp.pad(weights, ((0, 0), (0, pad)))
+    xb = jnp.pad(x_binned, ((0, pad), (0, 0)))
+    rs = jnp.pad(row_stats, ((0, pad), (0, 0)))
+
+    ni = ni.reshape(T, n_blocks, block).transpose(1, 0, 2)  # (nb, T, bs)
+    w = w.reshape(T, n_blocks, block).transpose(1, 0, 2)
+    xb = xb.reshape(n_blocks, block, d)
+    rs = rs.reshape(n_blocks, block, S)
+
+    def step(hist, blk):
+        ni_b, w_b, xb_b, rs_b = blk
+        local = ni_b - offset
+        in_level = (local >= 0) & (local < n_nodes)
+        node_oh = (
+            (local[:, :, None] == jnp.arange(n_nodes, dtype=jnp.int32))
+            & in_level[:, :, None]
+        ).astype(jnp.float32)  # (T, bs, M)
+        bin_oh = (
+            xb_b[:, :, None] == jnp.arange(n_bins, dtype=jnp.int32)
+        ).astype(jnp.float32).reshape(block, d * n_bins)  # (bs, d*B)
+        per_s = []
+        for s in range(S):
+            coef = w_b * rs_b[None, :, s]  # (T, bs)
+            a = node_oh * coef[:, :, None]  # (T, bs, M)
+            per_s.append(
+                jnp.einsum(
+                    "tbm,bq->tmq", a, bin_oh, precision=lax.Precision.HIGHEST
+                )
+            )
+        return hist + jnp.stack(per_s, axis=-1), None
+
+    init = jnp.zeros((T, n_nodes, d * n_bins, S), dtype=jnp.float32)
+    hist, _ = lax.scan(step, init, (ni, w, xb, rs))
+    return hist.reshape(T, n_nodes, d, n_bins, S)
+
+
+def _node_totals(
+    node_idx: jax.Array,
+    weights: jax.Array,
+    row_stats: jax.Array,
+    offset: int,
+    n_nodes: int,
+    block_rows: int,
+) -> jax.Array:
+    """(T, n_nodes, S) per-node stat totals (no feature/bin split)."""
+    T, n = node_idx.shape
+    S = row_stats.shape[1]
+    block = min(block_rows, n)
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+    ni = jnp.pad(node_idx, ((0, 0), (0, pad)), constant_values=-1)
+    w = jnp.pad(weights, ((0, 0), (0, pad)))
+    rs = jnp.pad(row_stats, ((0, pad), (0, 0)))
+    ni = ni.reshape(T, n_blocks, block).transpose(1, 0, 2)
+    w = w.reshape(T, n_blocks, block).transpose(1, 0, 2)
+    rs = rs.reshape(n_blocks, block, S)
+
+    def step(tot, blk):
+        ni_b, w_b, rs_b = blk
+        local = ni_b - offset
+        in_level = (local >= 0) & (local < n_nodes)
+        node_oh = (
+            (local[:, :, None] == jnp.arange(n_nodes, dtype=jnp.int32))
+            & in_level[:, :, None]
+        ).astype(jnp.float32) * w_b[:, :, None]
+        return tot + jnp.einsum(
+            "tbm,bs->tms", node_oh, rs_b, precision=lax.Precision.HIGHEST
+        ), None
+
+    init = jnp.zeros((T, n_nodes, S), dtype=jnp.float32)
+    tot, _ = lax.scan(step, init, (ni, w, rs))
+    return tot
+
+
+def _leaf_prediction(stats: jax.Array, kind: str) -> jax.Array:
+    """Per-node prediction from stats: class distribution or [mean]."""
+    if kind in ("gini", "entropy"):
+        w = jnp.sum(stats, axis=-1, keepdims=True)
+        n_cls = stats.shape[-1]
+        return jnp.where(w > 0, stats / jnp.maximum(w, 1e-12), 1.0 / n_cls)
+    w = stats[..., 0]
+    mean = stats[..., 1] / jnp.maximum(w, 1e-12)
+    return jnp.where(w > 0, mean, 0.0)[..., None]
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "max_depth",
+        "n_bins",
+        "impurity",
+        "feat_subset",
+        "min_instances",
+        "min_info_gain",
+        "block_rows",
+    ),
+)
+def grow_forest(
+    x_binned: jax.Array,  # (n, d) int32
+    row_stats: jax.Array,  # (n, S) float32
+    weights: jax.Array,  # (T, n) float32 per-tree sample weights
+    edges: jax.Array,  # (d, n_bins - 1) float32
+    key: jax.Array,
+    *,
+    max_depth: int,
+    n_bins: int,
+    impurity: str,
+    feat_subset: int,
+    min_instances: int = 1,
+    min_info_gain: float = 0.0,
+    block_rows: int = 4096,
+) -> Forest:
+    """Grow T trees level-synchronously; all shapes static, one XLA program.
+
+    The depth loop is unrolled (max_depth is static and small); each level
+    does one blocked-GEMM histogram pass over the data, a fused split
+    search, and a gather-based row re-routing — the level-order analogue of
+    cuML's node-batched builder, with the MXU doing the counting.
+    """
+    T, n = weights.shape
+    d = x_binned.shape[1]
+    S = row_stats.shape[1]
+    n_total = 2 ** (max_depth + 1) - 1
+    s_out = S if impurity in ("gini", "entropy") else 1
+    min_w = float(min_instances)
+
+    feature = jnp.full((T, n_total), -1, dtype=jnp.int32)
+    threshold = jnp.zeros((T, n_total), dtype=jnp.float32)
+    is_leaf = jnp.zeros((T, n_total), dtype=bool)
+    leaf_value = jnp.zeros((T, n_total, s_out), dtype=jnp.float32)
+    node_weight = jnp.zeros((T, n_total), dtype=jnp.float32)
+    node_gain = jnp.zeros((T, n_total), dtype=jnp.float32)
+
+    node_idx = jnp.zeros((T, n), dtype=jnp.int32)  # all rows at the root
+    row_ids = jnp.arange(n)
+
+    for level in range(max_depth):
+        offset = 2**level - 1
+        m_nodes = 2**level
+        hist = _level_histogram(
+            node_idx, weights, x_binned, row_stats, offset, m_nodes, n_bins,
+            block_rows,
+        )  # (T, M, d, B, S)
+        left = jnp.cumsum(hist, axis=3)
+        total = left[:, :, 0, -1, :]  # (T, M, S): same for every feature
+        right = total[:, :, None, None, :] - left
+        imp_parent, w_parent = _impurity(total, impurity)  # (T, M)
+        imp_l, w_l = _impurity(left, impurity)  # (T, M, d, B)
+        imp_r, w_r = _impurity(right, impurity)
+        gain = imp_parent[:, :, None, None] - (
+            w_l * imp_l + w_r * imp_r
+        ) / jnp.maximum(w_parent, 1e-12)[:, :, None, None]
+
+        # Per-node random feature subset: exactly feat_subset features, at
+        # zero extra histogram cost (all features were counted anyway).
+        if feat_subset < d:
+            u = jax.random.uniform(
+                jax.random.fold_in(key, level), (T, m_nodes, d)
+            )
+            kth = lax.top_k(u, feat_subset)[0][..., -1:]
+            f_mask = u >= kth
+        else:
+            f_mask = jnp.ones((T, m_nodes, d), dtype=bool)
+
+        valid = (
+            (w_l >= min_w)
+            & (w_r >= min_w)
+            & (jnp.arange(n_bins) < n_bins - 1)[None, None, None, :]
+            & f_mask[:, :, :, None]
+        )
+        gain = jnp.where(valid, gain, -jnp.inf)
+        flat = gain.reshape(T, m_nodes, d * n_bins)
+        best = jnp.argmax(flat, axis=2)
+        best_gain = jnp.take_along_axis(flat, best[..., None], axis=2)[..., 0]
+        best_f = (best // n_bins).astype(jnp.int32)
+        best_b = (best % n_bins).astype(jnp.int32)
+        split_ok = (
+            (best_gain > 0)
+            & (best_gain >= min_info_gain)
+            & (w_parent > 0)
+        )
+
+        sl = slice(offset, offset + m_nodes)
+        feature = feature.at[:, sl].set(jnp.where(split_ok, best_f, -1))
+        threshold = threshold.at[:, sl].set(
+            jnp.where(split_ok, edges[best_f, best_b], 0.0)
+        )
+        is_leaf = is_leaf.at[:, sl].set(~split_ok)
+        leaf_value = leaf_value.at[:, sl, :].set(
+            _leaf_prediction(total, impurity)
+        )
+        node_weight = node_weight.at[:, sl].set(w_parent)
+        node_gain = node_gain.at[:, sl].set(
+            jnp.where(split_ok, best_gain, 0.0)
+        )
+
+        # Route rows: leaf rows retire (-1); split rows descend.
+        local = node_idx - offset
+        active = (local >= 0) & (local < m_nodes)
+        lc = jnp.clip(local, 0, m_nodes - 1)
+        f_r = jnp.take_along_axis(best_f, lc, axis=1)  # (T, n)
+        b_r = jnp.take_along_axis(best_b, lc, axis=1)
+        ok_r = jnp.take_along_axis(split_ok, lc, axis=1)
+        xb_r = jax.vmap(lambda fr: x_binned[row_ids, fr])(f_r)  # (T, n)
+        child = 2 * node_idx + 1 + (xb_r > b_r)
+        node_idx = jnp.where(active & ok_r, child, jnp.where(active, -1, node_idx))
+
+    # Bottom level: every surviving node is a leaf.
+    offset = 2**max_depth - 1
+    m_nodes = 2**max_depth
+    total = _node_totals(node_idx, weights, row_stats, offset, m_nodes, block_rows)
+    sl = slice(offset, offset + m_nodes)
+    is_leaf = is_leaf.at[:, sl].set(True)
+    leaf_value = leaf_value.at[:, sl, :].set(_leaf_prediction(total, impurity))
+    _, w_bottom = _impurity(total, impurity)
+    node_weight = node_weight.at[:, sl].set(w_bottom)
+
+    return Forest(feature, threshold, is_leaf, leaf_value, node_weight, node_gain)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def forest_apply(
+    x: jax.Array, forest: Forest, max_depth: int
+) -> jax.Array:
+    """Leaf index per (tree, row): parallel root-to-leaf walk, (T, n) int32."""
+    T = forest.feature.shape[0]
+    n = x.shape[0]
+    row_ids = jnp.arange(n)
+    idx = jnp.zeros((T, n), dtype=jnp.int32)
+
+    def body(_, idx):
+        f = jnp.take_along_axis(forest.feature, idx, axis=1)
+        thr = jnp.take_along_axis(forest.threshold, idx, axis=1)
+        leaf = jnp.take_along_axis(forest.is_leaf, idx, axis=1)
+        xv = jax.vmap(lambda fr: x[row_ids, jnp.maximum(fr, 0)])(f)
+        child = 2 * idx + 1 + (xv > thr)
+        return jnp.where(leaf, idx, child.astype(jnp.int32))
+
+    return lax.fori_loop(0, max_depth, body, idx)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def forest_predict_proba(x: jax.Array, forest: Forest, max_depth: int) -> jax.Array:
+    """(n, C) mean of per-tree leaf class distributions."""
+    idx = forest_apply(x, forest, max_depth)  # (T, n)
+    lv = jnp.take_along_axis(
+        forest.leaf_value, idx[:, :, None], axis=1
+    )  # (T, n, C)
+    return jnp.mean(lv, axis=0)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def forest_predict_reg(x: jax.Array, forest: Forest, max_depth: int) -> jax.Array:
+    """(n,) mean of per-tree leaf means."""
+    idx = forest_apply(x, forest, max_depth)
+    lv = jnp.take_along_axis(forest.leaf_value[:, :, 0], idx, axis=1)  # (T, n)
+    return jnp.mean(lv, axis=0)
+
+
+def sample_weights(
+    key: jax.Array, n_trees: int, n_rows: int, subsampling_rate: float, bootstrap: bool
+) -> jax.Array:
+    """Per-tree row weights: Poisson(rate) with replacement (the standard
+    distributed approximation of bootstrap resampling), Bernoulli(rate)
+    without."""
+    if bootstrap:
+        return jax.random.poisson(
+            key, subsampling_rate, (n_trees, n_rows)
+        ).astype(jnp.float32)
+    return jax.random.bernoulli(key, subsampling_rate, (n_trees, n_rows)).astype(
+        jnp.float32
+    )
+
+
+def feature_importances(forest: Forest, n_features: int) -> np.ndarray:
+    """Impurity-based importances, Spark-style: per tree, each split
+    contributes gain * node_weight to its feature; per-tree vectors are
+    normalized, averaged over trees, then renormalized to sum to 1."""
+    feat = np.asarray(forest.feature)  # (T, N)
+    gain = np.asarray(forest.node_gain)
+    w = np.asarray(forest.node_weight)
+    T = feat.shape[0]
+    per_tree = np.zeros((T, n_features))
+    contrib = gain * w
+    for t in range(T):
+        split = feat[t] >= 0
+        np.add.at(per_tree[t], feat[t][split], contrib[t][split])
+    sums = per_tree.sum(axis=1, keepdims=True)
+    per_tree = np.divide(per_tree, sums, out=np.zeros_like(per_tree), where=sums > 0)
+    avg = per_tree.mean(axis=0)
+    s = avg.sum()
+    return avg / s if s > 0 else avg

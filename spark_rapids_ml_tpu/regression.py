@@ -4,5 +4,14 @@ from spark_rapids_ml_tpu.models.linear_regression import (
     LinearRegression,
     LinearRegressionModel,
 )
+from spark_rapids_ml_tpu.models.random_forest import (
+    RandomForestRegressor,
+    RandomForestRegressionModel,
+)
 
-__all__ = ["LinearRegression", "LinearRegressionModel"]
+__all__ = [
+    "LinearRegression",
+    "LinearRegressionModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
+]
