@@ -1,0 +1,5 @@
+"""Manifold-learning namespace — the UMAP estimator (cuML-lineage surface)."""
+
+from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
+
+__all__ = ["UMAP", "UMAPModel"]

@@ -23,6 +23,7 @@ from spark_rapids_ml_tpu.models.random_forest import (
     RandomForestRegressor,
     RandomForestRegressionModel,
 )
+from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
 
 __all__ = [
     "ApproximateNearestNeighbors",
@@ -43,4 +44,6 @@ __all__ = [
     "RandomForestClassificationModel",
     "RandomForestRegressor",
     "RandomForestRegressionModel",
+    "UMAP",
+    "UMAPModel",
 ]

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -66,13 +66,19 @@ def resolve_feature_subset(strategy: str, d: int, n_trees: int, classification: 
         return max(1, int(math.ceil(math.log2(max(d, 2)))))
     if s == "onethird":
         return max(1, int(math.ceil(d / 3.0)))
-    # Spark's grammar: an all-digits string is an absolute count; anything
-    # with a decimal point is a fraction in (0, 1] of the features (so
-    # "1.0" means ALL features, not one).
+    # Spark's grammar: an all-digits string is an absolute count in [1, d];
+    # anything with a decimal point is a fraction in (0, 1] of the features
+    # (so "1.0" means ALL features, not one).
     try:
-        return min(d, max(1, int(strategy)))
+        count = int(strategy)
     except ValueError:
-        pass
+        count = None
+    if count is not None:
+        if count < 1:
+            raise ValueError(
+                f"featureSubsetStrategy integer must be >= 1, got {strategy!r}"
+            )
+        return min(d, count)
     try:
         v = float(strategy)
     except ValueError:
@@ -209,25 +215,6 @@ class _RandomForestParams(Params):
         return self._chain(self.predictionCol, v)
 
 
-def _transform_features(dataset: Any, features_col: str, label_col: str):
-    """Dataset -> raw feature rows for transform(): DataFrame shim selects
-    the features column; pandas uses it if present, else treats the frame
-    (minus the label column) as a bare matrix; arrays pass through."""
-    if isinstance(dataset, DataFrame):
-        return dataset.select(features_col)
-    try:
-        import pandas as pd
-
-        if isinstance(dataset, pd.DataFrame):
-            if features_col in dataset.columns:
-                return dataset[features_col].tolist()
-            drop = [c for c in (label_col,) if c in dataset.columns]
-            return dataset.drop(columns=drop).to_numpy(dtype=np.float64)
-    except ImportError:  # pragma: no cover
-        pass
-    return dataset
-
-
 def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarray,
                 impurity: str, classification: bool) -> Forest:
     """Shared fit: quantize, sample, grow. Returns the Forest arrays."""
@@ -358,7 +345,7 @@ class RandomForestClassificationModel(_RandomForestParams, Model):
         return np.argmax(self.predictProbability(x), axis=1)
 
     def transform(self, dataset: Any) -> Any:
-        rows = _transform_features(dataset, self.getFeaturesCol(), self.getLabelCol())
+        rows = extract_features(dataset, self.getFeaturesCol(), drop=self.getLabelCol())
         probs = self.predictProbability(rows)
         preds = np.argmax(probs, axis=1)
         # rawPrediction mirrors Spark RF: unnormalized per-class vote mass
@@ -456,7 +443,7 @@ class RandomForestRegressionModel(_RandomForestParams, Model):
         )
 
     def transform(self, dataset: Any) -> Any:
-        rows = _transform_features(dataset, self.getFeaturesCol(), self.getLabelCol())
+        rows = extract_features(dataset, self.getFeaturesCol(), drop=self.getLabelCol())
         preds = self.predict(rows)
         if isinstance(dataset, DataFrame):
             return dataset.withColumn(self.getPredictionCol(), list(preds))

@@ -1,0 +1,339 @@
+"""UMAP estimator/model — Spark ML surface, XLA compute.
+
+Beyond-the-reference capability (the reference ships only PCA — SURVEY.md
+§2; the modern RAPIDS Spark-ML line grew UMAP on cuML). Param surface
+follows the cuML-backed Spark estimator's knobs with this package's Spark
+ML naming convention: ``nNeighbors``, ``nComponents``, ``minDist``,
+``spread``, ``nEpochs`` (0 = auto), ``learningRate``, ``init``
+("spectral" | "random"), ``negativeSampleRate``, ``repulsionStrength``,
+``metric`` ("euclidean" | "cosine"), ``seed``, ``featuresCol``,
+``outputCol``.
+
+Pipeline: exact kNN graph on the MXU (:mod:`ops.knn`), vectorized
+smooth-kNN bisection + fuzzy symmetrization, spectral or random init, then
+synchronous-epoch SGD layout optimization — one jitted program per stage
+(:mod:`ops.umap`). ``transform`` places new points by membership-weighted
+interpolation of their training neighbors' coordinates, then refines with
+attraction-only epochs against the FIXED training embedding (cuML's
+transform semantics, batch-parallel)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features
+from spark_rapids_ml_tpu.core.estimator import Estimator, Model
+from spark_rapids_ml_tpu.core.params import Param, Params, toFloat, toInt, toString
+from spark_rapids_ml_tpu.core.persistence import (
+    MLReadable,
+    get_and_set_params,
+    load_data,
+    load_metadata,
+    save_data,
+    save_metadata,
+)
+from spark_rapids_ml_tpu.ops.knn import knn
+from spark_rapids_ml_tpu.ops.umap import (
+    FuzzyGraph,
+    find_ab_params,
+    fuzzy_simplicial_set,
+    optimize_layout,
+    smooth_knn_dist,
+    spectral_init,
+)
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+_SPECTRAL_CAP = 8192  # dense-Laplacian eigh above this would dominate fit time
+
+
+class _UMAPParams(Params):
+    nNeighbors = Param("_", "nNeighbors", "local neighborhood size", toInt)
+    nComponents = Param("_", "nComponents", "embedding dimension", toInt)
+    metric = Param("_", "metric", "distance metric", toString)
+    nEpochs = Param("_", "nEpochs", "optimization epochs (0 = auto)", toInt)
+    learningRate = Param("_", "learningRate", "initial SGD step", toFloat)
+    init = Param("_", "init", "spectral or random", toString)
+    minDist = Param("_", "minDist", "minimum embedded distance", toFloat)
+    spread = Param("_", "spread", "embedded scale", toFloat)
+    negativeSampleRate = Param("_", "negativeSampleRate", "negatives per edge", toInt)
+    repulsionStrength = Param("_", "repulsionStrength", "repulsion weight", toFloat)
+    seed = Param("_", "seed", "random seed", toInt)
+    featuresCol = Param("_", "featuresCol", "features column name", toString)
+    outputCol = Param("_", "outputCol", "embedding column name", toString)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(
+            nNeighbors=15,
+            nComponents=2,
+            metric="euclidean",
+            nEpochs=0,
+            learningRate=1.0,
+            init="spectral",
+            minDist=0.1,
+            spread=1.0,
+            negativeSampleRate=5,
+            repulsionStrength=1.0,
+            seed=0,
+            featuresCol="features",
+            outputCol="embedding",
+        )
+
+    def getNNeighbors(self) -> int:
+        return self.getOrDefault(self.nNeighbors)
+
+    def getNComponents(self) -> int:
+        return self.getOrDefault(self.nComponents)
+
+    def getMetric(self) -> str:
+        return self.getOrDefault(self.metric)
+
+    def getNEpochs(self) -> int:
+        return self.getOrDefault(self.nEpochs)
+
+    def getLearningRate(self) -> float:
+        return self.getOrDefault(self.learningRate)
+
+    def getInit(self) -> str:
+        return self.getOrDefault(self.init)
+
+    def getMinDist(self) -> float:
+        return self.getOrDefault(self.minDist)
+
+    def getSpread(self) -> float:
+        return self.getOrDefault(self.spread)
+
+    def getNegativeSampleRate(self) -> int:
+        return self.getOrDefault(self.negativeSampleRate)
+
+    def getRepulsionStrength(self) -> float:
+        return self.getOrDefault(self.repulsionStrength)
+
+    def getSeed(self) -> int:
+        return self.getOrDefault(self.seed)
+
+    def getFeaturesCol(self) -> str:
+        return self.getOrDefault(self.featuresCol)
+
+    def getOutputCol(self) -> str:
+        return self.getOrDefault(self.outputCol)
+
+    def _chain(self, param, value):
+        self.set(param, value)
+        return self
+
+    def setNNeighbors(self, v: int):
+        if v < 2:
+            raise ValueError(f"nNeighbors must be >= 2, got {v}")
+        return self._chain(self.nNeighbors, v)
+
+    def setNComponents(self, v: int):
+        if v < 1:
+            raise ValueError(f"nComponents must be >= 1, got {v}")
+        return self._chain(self.nComponents, v)
+
+    def setMetric(self, v: str):
+        if v not in ("euclidean", "cosine"):
+            raise ValueError(f"metric must be euclidean or cosine, got {v!r}")
+        return self._chain(self.metric, v)
+
+    def setNEpochs(self, v: int):
+        return self._chain(self.nEpochs, v)
+
+    def setLearningRate(self, v: float):
+        return self._chain(self.learningRate, v)
+
+    def setInit(self, v: str):
+        if v not in ("spectral", "random"):
+            raise ValueError(f"init must be spectral or random, got {v!r}")
+        return self._chain(self.init, v)
+
+    def setMinDist(self, v: float):
+        return self._chain(self.minDist, v)
+
+    def setSpread(self, v: float):
+        return self._chain(self.spread, v)
+
+    def setNegativeSampleRate(self, v: int):
+        return self._chain(self.negativeSampleRate, v)
+
+    def setRepulsionStrength(self, v: float):
+        return self._chain(self.repulsionStrength, v)
+
+    def setSeed(self, v: int):
+        return self._chain(self.seed, v)
+
+    def setFeaturesCol(self, v: str):
+        return self._chain(self.featuresCol, v)
+
+    def setOutputCol(self, v: str):
+        return self._chain(self.outputCol, v)
+
+    def _auto_epochs(self, n: int) -> int:
+        epochs = self.getNEpochs()
+        if epochs > 0:
+            return epochs
+        return 500 if n <= 10_000 else 200
+
+
+def _knn_excluding_self(x: jax.Array, k: int, metric: str):
+    """kNN of x against itself with the self-match column removed."""
+    d, idx = knn(x, x, k + 1, metric=metric)
+    # The self column is wherever idx == row (ties can displace it from 0);
+    # mask it out then take the first k of the rest.
+    rows = jnp.arange(x.shape[0])[:, None]
+    is_self = idx == rows
+    # Push self to the end by distance +inf, re-sort the small k+1 window.
+    d = jnp.where(is_self, jnp.inf, d)
+    order = jnp.argsort(d, axis=1)
+    d = jnp.take_along_axis(d, order, axis=1)[:, :k]
+    idx = jnp.take_along_axis(idx, order, axis=1)[:, :k]
+    return d, idx
+
+
+class UMAP(_UMAPParams, Estimator, MLReadable):
+    """``UMAP().setNNeighbors(15).setNComponents(2).fit(x)``."""
+
+    def fit(self, dataset: Any) -> "UMAPModel":
+        rows = extract_features(dataset, self.getFeaturesCol())
+        x_host = as_matrix(rows)
+        n = x_host.shape[0]
+        k = min(self.getNNeighbors(), n - 1)
+        if n < 3:
+            raise ValueError(f"UMAP needs at least 3 rows, got {n}")
+        dim = self.getNComponents()
+        a, b = find_ab_params(self.getSpread(), self.getMinDist())
+        key = jax.random.key(self.getSeed())
+        k_init, k_opt = jax.random.split(key)
+
+        with TraceRange("umap fit", TraceColor.PURPLE):
+            x = jnp.asarray(x_host, dtype=jnp.float32)
+            dists, idx = _knn_excluding_self(x, k, self.getMetric())
+            graph = fuzzy_simplicial_set(idx, dists)
+            if self.getInit() == "spectral" and n <= _SPECTRAL_CAP:
+                emb0 = spectral_init(graph, n, dim, k_init)
+            else:
+                emb0 = 10.0 * jax.random.uniform(
+                    k_init, (n, dim), minval=-1.0, maxval=1.0
+                )
+            emb = optimize_layout(
+                emb0.astype(jnp.float32),
+                graph,
+                k_opt,
+                n_epochs=self._auto_epochs(n),
+                neg_rate=self.getNegativeSampleRate(),
+                learning_rate=self.getLearningRate(),
+                repulsion=self.getRepulsionStrength(),
+                a=a,
+                b=b,
+            )
+
+        model = UMAPModel(
+            self.uid,
+            embedding=np.asarray(emb, dtype=np.float64),
+            trainData=np.asarray(x_host, dtype=np.float64),
+            a=a,
+            b=b,
+        )
+        return self._copyValues(model)
+
+
+class UMAPModel(_UMAPParams, Model):
+    """Fitted model: ``embedding`` (n, dim); transform embeds NEW points
+    against the frozen training layout."""
+
+    def __init__(
+        self,
+        uid: Optional[str] = None,
+        embedding: Optional[np.ndarray] = None,
+        trainData: Optional[np.ndarray] = None,
+        a: float = 1.577,
+        b: float = 0.895,
+    ):
+        super().__init__(uid)
+        self.embedding = embedding
+        self.trainData = trainData
+        self.a = a
+        self.b = b
+
+    def transform(self, dataset: Any) -> Any:
+        rows = extract_features(dataset, self.getFeaturesCol())
+        x = as_matrix(rows)
+        emb = self._embed_new(x)
+        if isinstance(dataset, DataFrame):
+            return dataset.withColumn(self.getOutputCol(), [e for e in emb])
+        try:
+            import pandas as pd
+
+            if isinstance(dataset, pd.DataFrame):
+                out = dataset.copy()
+                out[self.getOutputCol()] = list(emb)
+                return out
+        except ImportError:  # pragma: no cover
+            pass
+        return emb
+
+    def _embed_new(self, x_host: np.ndarray) -> np.ndarray:
+        n_train = self.trainData.shape[0]
+        k = min(self.getNNeighbors(), n_train)
+        x = jnp.asarray(x_host, dtype=jnp.float32)
+        train = jnp.asarray(self.trainData, dtype=jnp.float32)
+        train_emb = jnp.asarray(self.embedding, dtype=jnp.float32)
+
+        with TraceRange("umap transform", TraceColor.PURPLE):
+            dists, idx = knn(x, train, k, metric=self.getMetric())
+            sigmas, rhos = smooth_knn_dist(dists, float(k))
+            w = jnp.exp(
+                -jnp.maximum(dists - rhos[:, None], 0.0) / sigmas[:, None]
+            )
+            w = w / jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-12)
+            init = jnp.einsum("qk,qkd->qd", w, train_emb[idx])
+            graph = FuzzyGraph(idx.astype(jnp.int32), w.astype(jnp.float32), sigmas, rhos)
+            epochs = max(1, self._auto_epochs(n_train) // 3)
+            emb = optimize_layout(
+                init,
+                graph,
+                jax.random.key(self.getSeed() + 1),
+                n_epochs=epochs,
+                neg_rate=self.getNegativeSampleRate(),
+                learning_rate=self.getLearningRate(),
+                repulsion=self.getRepulsionStrength(),
+                a=self.a,
+                b=self.b,
+                move_other=False,
+                target=train_emb,
+            )
+        return np.asarray(emb, dtype=np.float64)
+
+    def _save_impl(self, path: str) -> None:
+        save_metadata(
+            self,
+            path,
+            class_name="com.nvidia.rapids.ml.UMAPModel",
+            extra_metadata={"a": self.a, "b": self.b},
+        )
+        save_data(
+            path,
+            {
+                "embedding": ("matrix", self.embedding),
+                "trainData": ("matrix", self.trainData),
+            },
+        )
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "UMAPModel":
+        metadata = load_metadata(path, expected_class="UMAPModel")
+        data = load_data(path)
+        model = cls(
+            metadata["uid"],
+            embedding=np.asarray(data["embedding"]),
+            trainData=np.asarray(data["trainData"]),
+            a=metadata.get("a", 1.577),
+            b=metadata.get("b", 0.895),
+        )
+        get_and_set_params(model, metadata)
+        return model

@@ -1,0 +1,223 @@
+"""UMAP kernels — fuzzy simplicial set + batched SGD layout, all on-chip.
+
+Beyond-the-reference capability (the reference ships only PCA — SURVEY.md
+§2; the modern RAPIDS Spark-ML line grew UMAP on cuML). The cuML lineage
+optimizes the layout with per-edge sequential SGD (scatter races resolved by
+atomics); the TPU-first formulation instead runs *synchronous* epochs: every
+epoch applies ALL attractive edge gradients and a fresh draw of negative
+samples in one fused program — gathers + elementwise + two scatter-adds —
+inside a ``lax.fori_loop``. Shapes are static (E = n * k edges, E * m
+negatives), determinism comes for free, and the annealed learning rate plays
+the role of umap-learn's per-edge epoch scheduling (edge sample frequency ∝
+membership weight becomes a per-edge gradient weight).
+
+Graph construction reuses the exact kNN GEMM kernels (:mod:`ops.knn`); the
+smooth-kNN sigma search is a vectorized 64-step bisection over all points at
+once instead of umap-learn's per-point Python loop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class FuzzyGraph(NamedTuple):
+    """Directed kNN edge list with symmetrized membership weights.
+
+    ``weight[i, j]`` is the probabilistic t-conorm w_ij + w_ji - w_ij * w_ji,
+    halved for mutual edges (which appear in both endpoints' lists) so each
+    undirected edge carries its weight exactly once across the edge set.
+    """
+
+    indices: jax.Array  # (n, k) int32 neighbor ids
+    weight: jax.Array  # (n, k) float32 symmetrized membership
+    sigmas: jax.Array  # (n,) smooth-kNN bandwidths
+    rhos: jax.Array  # (n,) distance to nearest neighbor
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def smooth_knn_dist(
+    knn_dists: jax.Array, k: float, n_iter: int = 64
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-point bandwidth sigma and connectivity offset rho.
+
+    Solves sum_j exp(-max(d_ij - rho_i, 0) / sigma_i) = log2(k) for every
+    point simultaneously by bisection — the all-points-at-once analogue of
+    umap-learn's smooth_knn_dist loop.
+    """
+    target = jnp.log2(k)
+    # rho: smallest positive neighbor distance (umap-learn with
+    # local_connectivity=1).
+    pos = jnp.where(knn_dists > 0, knn_dists, jnp.inf)
+    rho = jnp.min(pos, axis=1)
+    rho = jnp.where(jnp.isfinite(rho), rho, 0.0)
+
+    def psum(sigma):
+        return jnp.sum(
+            jnp.exp(-jnp.maximum(knn_dists - rho[:, None], 0.0) / sigma[:, None]),
+            axis=1,
+        )
+
+    lo = jnp.full(knn_dists.shape[0], 1e-12, knn_dists.dtype)
+    hi = jnp.full(knn_dists.shape[0], 1e4, knn_dists.dtype)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) / 2.0
+        too_high = psum(mid) > target  # sum decreases as sigma shrinks
+        return jnp.where(too_high, lo, mid), jnp.where(too_high, mid, hi)
+
+    lo, hi = lax.fori_loop(0, n_iter, body, (lo, hi))
+    sigma = (lo + hi) / 2.0
+    # Floor, as in umap-learn: sigma no smaller than 1e-3 * mean distance.
+    mean_d = jnp.mean(knn_dists)
+    return jnp.maximum(sigma, 1e-3 * mean_d), rho
+
+
+@jax.jit
+def fuzzy_simplicial_set(knn_idx: jax.Array, knn_dists: jax.Array) -> FuzzyGraph:
+    """Membership strengths + symmetrization over the directed kNN edges.
+
+    The reverse weight w_ji is looked up by scanning j's neighbor list for i
+    (a (n, k, k) compare — O(n k^2) elementwise, negligible next to the kNN
+    GEMM); absent reverse edges contribute 0, exactly like the sparse
+    transpose in umap-learn/cuML.
+    """
+    n, k = knn_idx.shape
+    sigmas, rhos = smooth_knn_dist(knn_dists, float(k))
+    w = jnp.exp(
+        -jnp.maximum(knn_dists - rhos[:, None], 0.0) / sigmas[:, None]
+    )  # (n, k) directed memberships
+
+    # Reverse lookup: for edge (i -> j), find i in row j of knn_idx.
+    src = jnp.broadcast_to(jnp.arange(n, dtype=knn_idx.dtype)[:, None], (n, k))
+    rows_j = knn_idx  # (n, k): the j of each edge
+    match = knn_idx[rows_j] == src[:, :, None]  # (n, k, k)
+    w_rev_rows = w[rows_j]  # (n, k, k): weights of j's edges
+    w_ji = jnp.sum(jnp.where(match, w_rev_rows, 0.0), axis=2)
+    mutual = jnp.any(match, axis=2)
+
+    w_sym = w + w_ji - w * w_ji
+    w_sym = jnp.where(mutual, 0.5 * w_sym, w_sym)
+    return FuzzyGraph(knn_idx.astype(jnp.int32), w_sym.astype(jnp.float32), sigmas, rhos)
+
+
+def find_ab_params(spread: float, min_dist: float) -> Tuple[float, float]:
+    """Fit the rational low-dimensional similarity curve 1/(1 + a d^2b) to
+    the desired (min_dist, spread) offset-exponential — same least-squares
+    target as umap-learn."""
+    from scipy.optimize import curve_fit
+
+    xv = np.linspace(0, spread * 3, 300)
+    yv = np.where(
+        xv < min_dist, 1.0, np.exp(-(xv - min_dist) / spread)
+    )
+
+    def curve(x, a, b):
+        return 1.0 / (1.0 + a * x ** (2 * b))
+
+    (a, b), _ = curve_fit(curve, xv, yv, p0=[1.0, 1.0], maxfev=10000)
+    return float(a), float(b)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_epochs", "neg_rate", "move_other"),
+)
+def optimize_layout(
+    embedding: jax.Array,  # (n, dim) initial layout
+    graph: FuzzyGraph,
+    key: jax.Array,
+    *,
+    n_epochs: int,
+    neg_rate: int = 5,
+    learning_rate: float = 1.0,
+    repulsion: float = 1.0,
+    a: float = 1.577,
+    b: float = 0.895,
+    move_other: bool = True,
+    target: jax.Array | None = None,
+) -> jax.Array:
+    """Synchronous-epoch UMAP layout optimization.
+
+    Every epoch: gradients of the fuzzy cross-entropy for all E edges
+    (attraction, weighted by membership) and E * neg_rate uniformly drawn
+    negatives (repulsion) are accumulated with two scatter-adds and applied
+    with a linearly annealed step — umap-learn's sampling schedule folded
+    into weights. ``target`` (if given) is a fixed reference point set the
+    tail of each edge attracts to instead of the live embedding — the
+    transform-time mode where train points stay put; ``move_other=False``
+    then skips the tail update.
+    """
+    n, dim = embedding.shape
+    k = graph.indices.shape[1]
+    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, k)).reshape(-1)
+    dst = graph.indices.reshape(-1)
+    w = graph.weight.reshape(-1)
+    e = src.shape[0]
+    ref = embedding if target is None else target
+    n_ref = ref.shape[0]
+
+    def epoch(ep, carry):
+        y, key = carry
+        key, k_neg = jax.random.split(key)
+        alpha = learning_rate * (1.0 - ep / n_epochs)
+
+        yi = y[src]  # (E, dim)
+        yj = (y if target is None else target)[dst]
+        diff = yi - yj
+        d2 = jnp.sum(diff * diff, axis=1)
+        # Attractive: d/dy_i of log(1/(1 + a d^2b)) -> -2ab d^{2(b-1)}/(1+a d^2b)
+        att = (-2.0 * a * b * jnp.power(jnp.maximum(d2, 1e-12), b - 1.0)) / (
+            1.0 + a * jnp.power(d2, b)
+        )
+        g_att = jnp.clip((att * w)[:, None] * diff, -4.0, 4.0)  # (E, dim)
+
+        neg_idx = jax.random.randint(k_neg, (e, neg_rate), 0, n_ref)
+        # Negatives come from the LIVE layout in fit mode (repulsion must
+        # track the moving points), from the frozen targets in transform.
+        yn = (y if target is None else target)[neg_idx]  # (E, m, dim)
+        diff_n = yi[:, None, :] - yn
+        d2n = jnp.sum(diff_n * diff_n, axis=2)
+        rep = (2.0 * repulsion * b) / (
+            (0.001 + d2n) * (1.0 + a * jnp.power(d2n, b))
+        )
+        g_rep = jnp.clip((rep * w[:, None])[:, :, None] * diff_n, -4.0, 4.0)
+
+        # Head moves along both terms (att < 0 pulls toward the neighbor,
+        # rep > 0 pushes off the negatives); the tail mirrors attraction.
+        grad_i = g_att + jnp.sum(g_rep, axis=1)  # (E, dim)
+        delta = jnp.zeros_like(y).at[src].add(alpha * grad_i)
+        if move_other and target is None:
+            delta = delta.at[dst].add(-alpha * g_att)
+        return y + delta, key
+
+    y, _ = lax.fori_loop(0, n_epochs, epoch, (embedding, key))
+    return y
+
+
+def spectral_init(
+    graph: FuzzyGraph, n: int, dim: int, key: jax.Array
+) -> jax.Array:
+    """Normalized-Laplacian spectral embedding of the fuzzy graph (dense —
+    one symmetric eigh on the device; used below a size cap, random init
+    above it). Scaled to the ±10 box with a small noise break, as in
+    umap-learn."""
+    w = jnp.zeros((n, n), dtype=jnp.float32)
+    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], graph.indices.shape)
+    w = w.at[src.reshape(-1), graph.indices.reshape(-1)].add(graph.weight.reshape(-1))
+    w = w + w.T  # undirected (weights were already de-duplicated for mutuals)
+    deg = jnp.maximum(jnp.sum(w, axis=1), 1e-8)
+    d_inv_sqrt = 1.0 / jnp.sqrt(deg)
+    lap = jnp.eye(n, dtype=jnp.float32) - d_inv_sqrt[:, None] * w * d_inv_sqrt[None, :]
+    vals, vecs = jnp.linalg.eigh(lap)
+    emb = vecs[:, 1 : dim + 1]  # skip the trivial constant eigenvector
+    expansion = 10.0 / jnp.maximum(jnp.max(jnp.abs(emb)), 1e-8)
+    noise = jax.random.normal(key, emb.shape, dtype=emb.dtype) * 1e-4
+    return emb * expansion + noise

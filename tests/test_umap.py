@@ -1,0 +1,183 @@
+"""UMAP tests — oracle is structural (trustworthiness + cluster geometry).
+
+Beyond-the-reference capability (reference ships only PCA — SURVEY.md §2).
+UMAP has no exact numeric oracle (stochastic optimization), so the suite
+checks the properties every correct implementation must deliver: local
+structure preservation (sklearn's trustworthiness), cluster separation on
+well-separated blobs, determinism for a fixed seed, and persistence.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.manifold import UMAP, UMAPModel
+from spark_rapids_ml_tpu.ops.umap import find_ab_params, fuzzy_simplicial_set, smooth_knn_dist
+
+
+def _three_blobs(rng, n_per=60, d=10, sep=12.0):
+    centers = np.zeros((3, d))
+    centers[0, 0] = sep
+    centers[1, 1] = sep
+    centers[2, 2] = sep
+    x = np.concatenate(
+        [rng.normal(size=(n_per, d)) + c for c in centers]
+    )
+    labels = np.repeat(np.arange(3), n_per)
+    return x, labels
+
+
+def _separation_ratio(emb, labels):
+    """min inter-centroid distance / mean intra-cluster spread."""
+    cents = np.stack([emb[labels == c].mean(axis=0) for c in np.unique(labels)])
+    inter = np.inf
+    for i in range(len(cents)):
+        for j in range(i + 1, len(cents)):
+            inter = min(inter, np.linalg.norm(cents[i] - cents[j]))
+    intra = np.mean(
+        [
+            np.linalg.norm(emb[labels == c] - cents[ci], axis=1).mean()
+            for ci, c in enumerate(np.unique(labels))
+        ]
+    )
+    return inter / max(intra, 1e-12)
+
+
+class TestOps:
+    def test_smooth_knn_solves_target(self, rng):
+        import jax.numpy as jnp
+
+        d = jnp.asarray(np.abs(rng.normal(size=(50, 10))) + 0.1, dtype=jnp.float32)
+        sigmas, rhos = smooth_knn_dist(d, 10.0)
+        # The defining equation: sum exp(-(d - rho)/sigma) == log2(k).
+        lhs = np.sum(
+            np.exp(-np.maximum(np.asarray(d) - np.asarray(rhos)[:, None], 0)
+                   / np.asarray(sigmas)[:, None]),
+            axis=1,
+        )
+        np.testing.assert_allclose(lhs, np.log2(10.0), rtol=1e-3)
+        assert np.all(np.asarray(rhos) > 0)
+
+    def test_fuzzy_graph_symmetric_weights(self, rng):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models.umap import _knn_excluding_self
+
+        x = jnp.asarray(rng.normal(size=(40, 5)), dtype=jnp.float32)
+        dists, idx = _knn_excluding_self(x, 8, "euclidean")
+        g = fuzzy_simplicial_set(idx, dists)
+        w = np.asarray(g.weight)
+        assert w.shape == (40, 8)
+        assert np.all(w >= 0) and np.all(w <= 1.0 + 1e-6)
+        # Reconstruct the dense symmetrized matrix: must be symmetric.
+        dense = np.zeros((40, 40))
+        src = np.repeat(np.arange(40), 8)
+        dense[src, np.asarray(g.indices).ravel()] += w.ravel()
+        dense = dense + dense.T
+        np.testing.assert_allclose(dense, dense.T, atol=1e-6)
+
+    def test_find_ab_params(self):
+        a, b = find_ab_params(1.0, 0.1)
+        # Known umap-learn values for the default (spread=1, min_dist=0.1).
+        assert abs(a - 1.577) < 0.05
+        assert abs(b - 0.895) < 0.05
+
+    def test_knn_excluding_self(self, rng):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models.umap import _knn_excluding_self
+
+        x = jnp.asarray(rng.normal(size=(30, 4)), dtype=jnp.float32)
+        dists, idx = _knn_excluding_self(x, 5, "euclidean")
+        rows = np.arange(30)[:, None]
+        assert not np.any(np.asarray(idx) == rows)
+        assert np.all(np.asarray(dists) > 0)
+
+
+class TestUMAP:
+    def test_blobs_separate(self, rng):
+        x, labels = _three_blobs(rng)
+        model = UMAP().setNNeighbors(10).setNEpochs(150).setSeed(0).fit(x)
+        emb = model.embedding
+        assert emb.shape == (180, 2)
+        assert np.all(np.isfinite(emb))
+        assert _separation_ratio(emb, labels) > 2.0
+
+    def test_trustworthiness(self, rng):
+        manifold = pytest.importorskip("sklearn.manifold")
+        x, _ = _three_blobs(rng, n_per=50)
+        model = UMAP().setNNeighbors(10).setNEpochs(150).setSeed(1).fit(x)
+        t = manifold.trustworthiness(x, model.embedding, n_neighbors=10)
+        assert t > 0.85
+
+    def test_determinism(self, rng):
+        x, _ = _three_blobs(rng, n_per=30)
+        e1 = UMAP().setNEpochs(50).setSeed(7).fit(x).embedding
+        e2 = UMAP().setNEpochs(50).setSeed(7).fit(x).embedding
+        np.testing.assert_allclose(e1, e2, atol=1e-6)
+
+    def test_random_init_and_cosine(self, rng):
+        x, labels = _three_blobs(rng, n_per=40)
+        model = (
+            UMAP()
+            .setInit("random")
+            .setMetric("cosine")
+            .setNNeighbors(8)
+            .setNEpochs(150)
+            .setSeed(3)
+            .fit(x)
+        )
+        assert _separation_ratio(model.embedding, labels) > 1.5
+
+    def test_transform_new_points(self, rng):
+        x, labels = _three_blobs(rng, n_per=50)
+        model = UMAP().setNNeighbors(10).setNEpochs(150).setSeed(2).fit(x)
+        # New points from blob 0 must land nearest blob 0's centroid.
+        x_new = rng.normal(size=(20, x.shape[1]))
+        x_new[:, 0] += 12.0
+        emb_new = model.transform(x_new)
+        assert emb_new.shape == (20, 2)
+        cents = np.stack(
+            [model.embedding[labels == c].mean(axis=0) for c in range(3)]
+        )
+        d = np.linalg.norm(emb_new[:, None, :] - cents[None, :, :], axis=2)
+        assert np.mean(np.argmin(d, axis=1) == 0) >= 0.9
+
+    def test_persistence_roundtrip(self, tmp_path, rng):
+        x, _ = _three_blobs(rng, n_per=20)
+        model = UMAP().setNEpochs(30).setSeed(4).fit(x)
+        path = str(tmp_path / "umap")
+        model.save(path)
+        loaded = UMAPModel.load(path)
+        np.testing.assert_allclose(model.embedding, loaded.embedding, atol=1e-12)
+        np.testing.assert_allclose(
+            model.transform(x[:5]), loaded.transform(x[:5]), atol=1e-6
+        )
+
+    def test_dataframe_shim(self, rng):
+        from spark_rapids_ml_tpu.core.data import DataFrame
+
+        x, _ = _three_blobs(rng, n_per=15)
+        df = DataFrame({"features": list(x)})
+        model = UMAP().setNEpochs(20).setSeed(5).fit(df)
+        out = model.transform(df)
+        assert "embedding" in out.columns
+        assert len(out.select("embedding")) == len(x)
+
+    def test_param_validation(self):
+        with pytest.raises(ValueError):
+            UMAP().setNNeighbors(1)
+        with pytest.raises(ValueError):
+            UMAP().setMetric("mahalanobis")
+        with pytest.raises(ValueError):
+            UMAP().setInit("pca")
+        with pytest.raises(ValueError):
+            UMAP().fit(np.zeros((2, 3)))
+
+    def test_defaults(self):
+        u = UMAP()
+        assert u.getNNeighbors() == 15
+        assert u.getNComponents() == 2
+        assert u.getMinDist() == 0.1
+        assert u.getInit() == "spectral"
+        assert u._auto_epochs(5_000) == 500
+        assert u._auto_epochs(50_000) == 200
