@@ -1,0 +1,257 @@
+"""Evaluators — parity with ``org.apache.spark.ml.evaluation``.
+
+Metric math is plain numpy on the collected (label, prediction) columns:
+evaluation operates on a handful of scalars per row and never justifies a
+device round-trip, matching where the reference keeps driver-side work on
+the JVM (SURVEY.md §3.3 — the transform UDF itself is CPU there).
+
+Datasets accepted by ``evaluate``: the DataFrame shim or a pandas frame
+carrying the evaluator's columns, or a plain ``(y_true, y_pred)`` tuple.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.core.data import DataFrame, extract_column
+from spark_rapids_ml_tpu.core.params import Param, Params, toString
+
+# numpy renamed trapz -> trapezoid in 2.0; support both.
+_trapezoid = getattr(np, "trapezoid", None) or np.trapz
+
+
+def _column(dataset: Any, name: str) -> np.ndarray:
+    """Named-column lookup via the shared dispatch (core.data), restricted
+    to containers that actually HAVE named columns — a bare array reaching
+    an evaluator is a caller bug and must not silently pass through."""
+    is_frame = isinstance(dataset, DataFrame)
+    if not is_frame:
+        try:
+            import pandas as pd
+
+            is_frame = isinstance(dataset, pd.DataFrame)
+        except ImportError:  # pragma: no cover
+            pass
+    if not is_frame:
+        raise TypeError(
+            f"cannot extract column {name!r} from {type(dataset).__name__}"
+        )
+    return np.asarray(extract_column(dataset, name), dtype=object)
+
+
+def _pair(dataset: Any, label_col: str, pred_col: str) -> Tuple[np.ndarray, np.ndarray]:
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        y, p = dataset
+        return np.asarray(y, dtype=np.float64).ravel(), np.asarray(
+            p, dtype=np.float64
+        ).ravel()
+    y = np.asarray(_column(dataset, label_col).tolist(), dtype=np.float64)
+    p = np.asarray(_column(dataset, pred_col).tolist(), dtype=np.float64)
+    return y.ravel(), p.ravel()
+
+
+class Evaluator(Params):
+    """Base: ``evaluate(dataset) -> float`` + ``isLargerBetter()``."""
+
+    def evaluate(self, dataset: Any) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+
+class RegressionEvaluator(Evaluator):
+    """metricName: rmse (default) | mse | mae | r2."""
+
+    metricName = Param("_", "metricName", "rmse|mse|mae|r2", toString)
+    labelCol = Param("_", "labelCol", "label column name", toString)
+    predictionCol = Param("_", "predictionCol", "prediction column name", toString)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(metricName="rmse", labelCol="label", predictionCol="prediction")
+
+    def setMetricName(self, v: str):
+        if v not in ("rmse", "mse", "mae", "r2"):
+            raise ValueError(f"metricName must be rmse|mse|mae|r2, got {v!r}")
+        self.set(self.metricName, v)
+        return self
+
+    def setLabelCol(self, v: str):
+        self.set(self.labelCol, v)
+        return self
+
+    def setPredictionCol(self, v: str):
+        self.set(self.predictionCol, v)
+        return self
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault(self.metricName)
+
+    def isLargerBetter(self) -> bool:
+        return self.getMetricName() == "r2"
+
+    def evaluate(self, dataset: Any) -> float:
+        y, p = _pair(
+            dataset, self.getOrDefault(self.labelCol), self.getOrDefault(self.predictionCol)
+        )
+        err = y - p
+        metric = self.getMetricName()
+        if metric == "rmse":
+            return float(np.sqrt(np.mean(err**2)))
+        if metric == "mse":
+            return float(np.mean(err**2))
+        if metric == "mae":
+            return float(np.mean(np.abs(err)))
+        ss_res = float(np.sum(err**2))
+        ss_tot = float(np.sum((y - y.mean()) ** 2))
+        return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+
+
+class MulticlassClassificationEvaluator(Evaluator):
+    """metricName: accuracy (default) | f1 | weightedPrecision | weightedRecall."""
+
+    metricName = Param(
+        "_", "metricName", "accuracy|f1|weightedPrecision|weightedRecall", toString
+    )
+    labelCol = Param("_", "labelCol", "label column name", toString)
+    predictionCol = Param("_", "predictionCol", "prediction column name", toString)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(
+            metricName="accuracy", labelCol="label", predictionCol="prediction"
+        )
+
+    def setMetricName(self, v: str):
+        if v not in ("accuracy", "f1", "weightedPrecision", "weightedRecall"):
+            raise ValueError(f"unknown metricName {v!r}")
+        self.set(self.metricName, v)
+        return self
+
+    def setLabelCol(self, v: str):
+        self.set(self.labelCol, v)
+        return self
+
+    def setPredictionCol(self, v: str):
+        self.set(self.predictionCol, v)
+        return self
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault(self.metricName)
+
+    def evaluate(self, dataset: Any) -> float:
+        y, p = _pair(
+            dataset, self.getOrDefault(self.labelCol), self.getOrDefault(self.predictionCol)
+        )
+        metric = self.getMetricName()
+        if metric == "accuracy":
+            return float(np.mean(y == p))
+        classes, counts = np.unique(y, return_counts=True)
+        weights = counts / counts.sum()
+        precisions, recalls, f1s = [], [], []
+        for c in classes:
+            tp = np.sum((p == c) & (y == c))
+            fp = np.sum((p == c) & (y != c))
+            fn = np.sum((p != c) & (y == c))
+            prec = tp / (tp + fp) if tp + fp > 0 else 0.0
+            rec = tp / (tp + fn) if tp + fn > 0 else 0.0
+            precisions.append(prec)
+            recalls.append(rec)
+            f1s.append(2 * prec * rec / (prec + rec) if prec + rec > 0 else 0.0)
+        if metric == "weightedPrecision":
+            return float(np.dot(weights, precisions))
+        if metric == "weightedRecall":
+            return float(np.dot(weights, recalls))
+        return float(np.dot(weights, f1s))
+
+
+class BinaryClassificationEvaluator(Evaluator):
+    """metricName: areaUnderROC (default) | areaUnderPR.
+
+    The score per row comes from ``rawPredictionCol``: the positive-class
+    component of a vector-valued column, or the value itself if scalar.
+    """
+
+    metricName = Param("_", "metricName", "areaUnderROC|areaUnderPR", toString)
+    labelCol = Param("_", "labelCol", "label column name", toString)
+    rawPredictionCol = Param("_", "rawPredictionCol", "score column name", toString)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(
+            metricName="areaUnderROC", labelCol="label", rawPredictionCol="rawPrediction"
+        )
+
+    def setMetricName(self, v: str):
+        if v not in ("areaUnderROC", "areaUnderPR"):
+            raise ValueError(f"unknown metricName {v!r}")
+        self.set(self.metricName, v)
+        return self
+
+    def setLabelCol(self, v: str):
+        self.set(self.labelCol, v)
+        return self
+
+    def setRawPredictionCol(self, v: str):
+        self.set(self.rawPredictionCol, v)
+        return self
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault(self.metricName)
+
+    def _scores(self, dataset: Any) -> Tuple[np.ndarray, np.ndarray]:
+        if isinstance(dataset, tuple) and len(dataset) == 2:
+            y, s = dataset
+            return np.asarray(y, dtype=np.float64).ravel(), np.asarray(
+                s, dtype=np.float64
+            ).ravel()
+        y = np.asarray(
+            _column(dataset, self.getOrDefault(self.labelCol)).tolist(),
+            dtype=np.float64,
+        ).ravel()
+        raw = _column(dataset, self.getOrDefault(self.rawPredictionCol))
+        first = raw[0]
+        if np.ndim(first) >= 1:  # vector-valued: positive class = component 1
+            s = np.asarray([np.asarray(r, dtype=np.float64)[-1] for r in raw])
+        else:
+            s = np.asarray(raw.tolist(), dtype=np.float64)
+        return y, s
+
+    def evaluate(self, dataset: Any) -> float:
+        y, s = self._scores(dataset)
+        order = np.argsort(-s, kind="stable")
+        y_sorted = y[order]
+        s_sorted = s[order]
+        n_pos = float(np.sum(y_sorted == 1))
+        n_neg = float(len(y_sorted) - n_pos)
+        if n_pos == 0 or n_neg == 0:
+            return 0.0
+        tp = np.cumsum(y_sorted == 1)
+        fp = np.cumsum(y_sorted == 0)
+        # Collapse tied scores to one ROC/PR point per distinct threshold —
+        # the within-tie row order is arbitrary and must not affect the
+        # area (the trapezoid then interpolates diagonally through ties,
+        # the standard tie treatment).
+        distinct = np.concatenate([s_sorted[1:] != s_sorted[:-1], [True]])
+        tp = tp[distinct]
+        fp = fp[distinct]
+        if self.getMetricName() == "areaUnderROC":
+            tpr = np.concatenate([[0.0], tp / n_pos])
+            fpr = np.concatenate([[0.0], fp / n_neg])
+            return float(_trapezoid(tpr, fpr))
+        precision = tp / np.maximum(tp + fp, 1)
+        recall = tp / n_pos
+        precision = np.concatenate([[1.0], precision])
+        recall = np.concatenate([[0.0], recall])
+        return float(_trapezoid(precision, recall))
+
+
+__all__ = [
+    "Evaluator",
+    "RegressionEvaluator",
+    "BinaryClassificationEvaluator",
+    "MulticlassClassificationEvaluator",
+]

@@ -65,7 +65,15 @@ def smooth_knn_dist(
         )
 
     lo = jnp.full(knn_dists.shape[0], 1e-12, knn_dists.dtype)
-    hi = jnp.full(knn_dists.shape[0], 1e4, knn_dists.dtype)
+    # Bracket expansion (umap-learn doubles hi until the target is
+    # bracketed): a fixed cap would silently saturate on data whose
+    # distance scale is large, collapsing all memberships toward zero.
+    hi = jnp.full(knn_dists.shape[0], 1.0, knn_dists.dtype)
+
+    def expand(_, hi):
+        return jnp.where(psum(hi) < target, hi * 2.0, hi)
+
+    hi = lax.fori_loop(0, 48, expand, hi)  # 2^48 spans any float32 scale
 
     def body(_, lohi):
         lo, hi = lohi

@@ -1,0 +1,287 @@
+"""Hyperparameter tuning — parity with ``org.apache.spark.ml.tuning``.
+
+``ParamGridBuilder`` / ``CrossValidator`` / ``TrainValidationSplit`` over
+this package's estimators. Fold orchestration is host-side (it is control
+flow over whole fits, the analogue of Spark's driver loop over param maps);
+each inner ``fit`` runs its own jitted XLA program, and because every fold
+of a grid cell reuses identical shapes, XLA's compile cache makes fold k > 1
+compile-free — the TPU-side win the JVM reference gets from reusing one
+native library across tasks (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.core.data import DataFrame
+from spark_rapids_ml_tpu.core.estimator import Estimator, Model
+from spark_rapids_ml_tpu.core.params import Param, Params, toFloat, toInt
+from spark_rapids_ml_tpu.evaluation import Evaluator
+
+
+class ParamGridBuilder:
+    """Cartesian product of param -> values grids (Spark's builder API)."""
+
+    def __init__(self):
+        self._grid: Dict[Param, Sequence[Any]] = {}
+
+    def addGrid(self, param: Param, values: Sequence[Any]) -> "ParamGridBuilder":
+        self._grid[param] = list(values)
+        return self
+
+    def baseOn(self, *args) -> "ParamGridBuilder":
+        pairs = args[0].items() if len(args) == 1 and isinstance(args[0], dict) else args
+        for param, value in pairs:
+            self._grid[param] = [value]
+        return self
+
+    def build(self) -> List[Dict[Param, Any]]:
+        maps: List[Dict[Param, Any]] = [{}]
+        for param, values in self._grid.items():
+            maps = [{**m, param: v} for m in maps for v in values]
+        return maps
+
+
+def _slice_dataset(dataset: Any, idx: np.ndarray) -> Any:
+    """Row-subset any supported dataset container by integer indices."""
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        x, y = dataset
+        return (np.asarray(x)[idx], np.asarray(y)[idx])
+    if isinstance(dataset, DataFrame):
+        return DataFrame(
+            {name: [dataset.select(name)[i] for i in idx] for name in dataset.columns}
+        )
+    try:
+        import pandas as pd
+
+        if isinstance(dataset, pd.DataFrame):
+            return dataset.iloc[idx].reset_index(drop=True)
+    except ImportError:  # pragma: no cover
+        pass
+    return np.asarray(dataset)[idx]
+
+
+def _num_rows(dataset: Any) -> int:
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        return len(np.asarray(dataset[1]))
+    if isinstance(dataset, DataFrame):
+        return dataset.count()
+    return len(dataset)
+
+
+def _eval_dataset(model: Model, val: Any, evaluator: Evaluator) -> Any:
+    """Transform the validation subset and hand the result to the evaluator.
+
+    Tuple datasets have no named columns, so the transform output (a
+    prediction array) is paired with the held-out labels directly.
+    """
+    if isinstance(val, tuple):
+        x_val, y_val = val
+        preds = model.transform(x_val)
+        return (y_val, preds)
+    return model.transform(val)
+
+
+class _ValidatorParams(Params):
+    seed = Param("_", "seed", "random seed", toInt)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self.estimator: Optional[Estimator] = None
+        self.estimatorParamMaps: List[Dict[Param, Any]] = []
+        self.evaluator: Optional[Evaluator] = None
+        self._setDefault(seed=0)
+
+    def setEstimator(self, value: Estimator):
+        self.estimator = value
+        return self
+
+    def getEstimator(self) -> Estimator:
+        return self.estimator
+
+    def setEstimatorParamMaps(self, value: List[Dict[Param, Any]]):
+        self.estimatorParamMaps = list(value)
+        return self
+
+    def getEstimatorParamMaps(self) -> List[Dict[Param, Any]]:
+        return self.estimatorParamMaps
+
+    def setEvaluator(self, value: Evaluator):
+        self.evaluator = value
+        return self
+
+    def getEvaluator(self) -> Evaluator:
+        return self.evaluator
+
+    def setSeed(self, value: int):
+        self.set(self.seed, value)
+        return self
+
+    def getSeed(self) -> int:
+        return self.getOrDefault(self.seed)
+
+    def _check(self):
+        if self.estimator is None or self.evaluator is None:
+            raise ValueError("estimator and evaluator must be set")
+        if not self.estimatorParamMaps:
+            raise ValueError("estimatorParamMaps must be a non-empty list")
+
+
+class CrossValidator(_ValidatorParams, Estimator):
+    """k-fold cross validation over a param grid; refits the winner on the
+    full dataset (Spark semantics: metrics averaged per grid cell,
+    best = extremum under ``evaluator.isLargerBetter``)."""
+
+    numFolds = Param("_", "numFolds", "number of folds", toInt)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(numFolds=3)
+
+    def setNumFolds(self, value: int):
+        if value < 2:
+            raise ValueError(f"numFolds must be >= 2, got {value}")
+        self.set(self.numFolds, value)
+        return self
+
+    def getNumFolds(self) -> int:
+        return self.getOrDefault(self.numFolds)
+
+    def fit(self, dataset: Any) -> "CrossValidatorModel":
+        self._check()
+        n = _num_rows(dataset)
+        k = self.getNumFolds()
+        if n < k:
+            raise ValueError(f"numFolds={k} exceeds number of rows {n}")
+        rng = np.random.default_rng(self.getSeed())
+        perm = rng.permutation(n)
+        folds = np.array_split(perm, k)
+
+        maps = self.getEstimatorParamMaps()
+        metrics = np.zeros((len(maps), k))
+        for fold_i, val_idx in enumerate(folds):
+            train_idx = np.concatenate(
+                [f for j, f in enumerate(folds) if j != fold_i]
+            )
+            train = _slice_dataset(dataset, np.sort(train_idx))
+            val = _slice_dataset(dataset, np.sort(val_idx))
+            for map_i, pm in enumerate(maps):
+                model = self.estimator.copy(pm).fit(train)
+                metrics[map_i, fold_i] = self.evaluator.evaluate(
+                    _eval_dataset(model, val, self.evaluator)
+                )
+
+        avg = metrics.mean(axis=1)
+        best_i = int(np.argmax(avg) if self.evaluator.isLargerBetter() else np.argmin(avg))
+        best_model = self.estimator.copy(maps[best_i]).fit(dataset)
+        cv_model = CrossValidatorModel(
+            self.uid, best_model, avgMetrics=avg.tolist(), bestIndex=best_i
+        )
+        cv_model.estimator = self.estimator
+        cv_model.estimatorParamMaps = maps
+        cv_model.evaluator = self.evaluator
+        return self._copyValues(cv_model)
+
+
+class CrossValidatorModel(_ValidatorParams, Model):
+    """Wraps the winning refitted model; ``avgMetrics[i]`` aligns with
+    ``estimatorParamMaps[i]``."""
+
+    numFolds = CrossValidator.numFolds
+
+    def __init__(
+        self,
+        uid: Optional[str] = None,
+        bestModel: Optional[Model] = None,
+        avgMetrics: Optional[List[float]] = None,
+        bestIndex: int = 0,
+    ):
+        super().__init__(uid)
+        self._setDefault(numFolds=3)
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics or []
+        self.bestIndex = bestIndex
+
+    def transform(self, dataset: Any) -> Any:
+        return self.bestModel.transform(dataset)
+
+
+class TrainValidationSplit(_ValidatorParams, Estimator):
+    """Single random train/validation split over a param grid."""
+
+    trainRatio = Param("_", "trainRatio", "fraction of rows used for training", toFloat)
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid)
+        self._setDefault(trainRatio=0.75)
+
+    def setTrainRatio(self, value: float):
+        if not 0 < value < 1:
+            raise ValueError(f"trainRatio must be in (0, 1), got {value}")
+        self.set(self.trainRatio, value)
+        return self
+
+    def getTrainRatio(self) -> float:
+        return self.getOrDefault(self.trainRatio)
+
+    def fit(self, dataset: Any) -> "TrainValidationSplitModel":
+        self._check()
+        n = _num_rows(dataset)
+        n_train = int(round(n * self.getTrainRatio()))
+        if n_train < 1 or n_train >= n:
+            raise ValueError(
+                f"trainRatio={self.getTrainRatio()} leaves an empty split for {n} rows"
+            )
+        rng = np.random.default_rng(self.getSeed())
+        perm = rng.permutation(n)
+        train = _slice_dataset(dataset, np.sort(perm[:n_train]))
+        val = _slice_dataset(dataset, np.sort(perm[n_train:]))
+
+        maps = self.getEstimatorParamMaps()
+        metrics = []
+        for pm in maps:
+            model = self.estimator.copy(pm).fit(train)
+            metrics.append(
+                self.evaluator.evaluate(_eval_dataset(model, val, self.evaluator))
+            )
+        arr = np.asarray(metrics)
+        best_i = int(np.argmax(arr) if self.evaluator.isLargerBetter() else np.argmin(arr))
+        best_model = self.estimator.copy(maps[best_i]).fit(dataset)
+        tvs_model = TrainValidationSplitModel(
+            self.uid, best_model, validationMetrics=metrics, bestIndex=best_i
+        )
+        tvs_model.estimator = self.estimator
+        tvs_model.estimatorParamMaps = maps
+        tvs_model.evaluator = self.evaluator
+        return self._copyValues(tvs_model)
+
+
+class TrainValidationSplitModel(_ValidatorParams, Model):
+    trainRatio = TrainValidationSplit.trainRatio
+
+    def __init__(
+        self,
+        uid: Optional[str] = None,
+        bestModel: Optional[Model] = None,
+        validationMetrics: Optional[List[float]] = None,
+        bestIndex: int = 0,
+    ):
+        super().__init__(uid)
+        self._setDefault(trainRatio=0.75)
+        self.bestModel = bestModel
+        self.validationMetrics = validationMetrics or []
+        self.bestIndex = bestIndex
+
+    def transform(self, dataset: Any) -> Any:
+        return self.bestModel.transform(dataset)
+
+
+__all__ = [
+    "ParamGridBuilder",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
+]

@@ -75,6 +75,22 @@ class TestOps:
         dense = dense + dense.T
         np.testing.assert_allclose(dense, dense.T, atol=1e-6)
 
+    def test_smooth_knn_large_scale(self, rng):
+        import jax.numpy as jnp
+
+        # Distances at O(1e5): the sigma bracket must expand past any fixed
+        # cap or memberships collapse to zero.
+        d = jnp.asarray(
+            (np.abs(rng.normal(size=(20, 8))) + 1.0) * 1e5, dtype=jnp.float32
+        )
+        sigmas, rhos = smooth_knn_dist(d, 8.0)
+        lhs = np.sum(
+            np.exp(-np.maximum(np.asarray(d) - np.asarray(rhos)[:, None], 0)
+                   / np.asarray(sigmas)[:, None]),
+            axis=1,
+        )
+        np.testing.assert_allclose(lhs, np.log2(8.0), rtol=1e-3)
+
     def test_find_ab_params(self):
         a, b = find_ab_params(1.0, 0.1)
         # Known umap-learn values for the default (spread=1, min_dist=0.1).
