@@ -199,7 +199,13 @@ class Params:
 
     def copy(self, extra: Optional[Dict[Param, Any]] = None) -> "Params":
         that = type(self)()
-        return self._copyValues(that, extra)
+        self._copyValues(that, extra)
+        # Estimators in this package may carry a non-Param device mesh; a
+        # copy that silently dropped it would downgrade tuning/pipeline
+        # fits to single-device for exactly the workloads that need sharding.
+        if hasattr(self, "mesh") and hasattr(that, "mesh"):
+            that.mesh = self.mesh
+        return that
 
     def _copyValues(self, to: "Params", extra: Optional[Dict[Param, Any]] = None) -> "Params":
         for param, value in self._defaultParamMap.items():

@@ -41,6 +41,7 @@ from spark_rapids_ml_tpu.ops.trees import (
     forest_predict_proba,
     forest_predict_reg,
     grow_forest,
+    grow_forest_sharded,
     quantize_features,
     sample_weights,
 )
@@ -216,8 +217,12 @@ class _RandomForestParams(Params):
 
 
 def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarray,
-                impurity: str, classification: bool) -> Forest:
-    """Shared fit: quantize, sample, grow. Returns the Forest arrays."""
+                impurity: str, classification: bool, mesh=None) -> Forest:
+    """Shared fit: quantize, sample, grow. Returns the Forest arrays.
+
+    With a mesh, rows are data-sharded and the per-level histograms merge
+    over ICI (:func:`grow_forest_sharded`); quantization and weight sampling
+    stay replicated (edges/weights are tiny and seed-deterministic)."""
     n, d = x.shape
     n_bins = min(params.getMaxBins(), max(2, n))
     m = resolve_feature_subset(
@@ -233,12 +238,7 @@ def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarra
         k_sample, params.getNumTrees(), n, params.getSubsamplingRate(),
         params.getBootstrap(),
     )
-    return grow_forest(
-        xb,
-        jnp.asarray(row_stats, dtype=jnp.float32),
-        w,
-        edges.astype(jnp.float32),
-        k_feat,
+    kwargs = dict(
         max_depth=params.getMaxDepth(),
         n_bins=n_bins,
         impurity=impurity,
@@ -246,6 +246,11 @@ def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarra
         min_instances=params.getMinInstancesPerNode(),
         min_info_gain=params.getMinInfoGain(),
     )
+    rs = jnp.asarray(row_stats, dtype=jnp.float32)
+    e = edges.astype(jnp.float32)
+    if mesh is not None:
+        return grow_forest_sharded(mesh, xb, rs, w, e, k_feat, **kwargs)
+    return grow_forest(xb, rs, w, e, k_feat, **kwargs)
 
 
 class RandomForestClassifier(_RandomForestParams, Estimator, MLReadable):
@@ -256,13 +261,18 @@ class RandomForestClassifier(_RandomForestParams, Estimator, MLReadable):
         "_", "rawPredictionCol", "raw prediction column name", toString
     )
 
-    def __init__(self, uid: Optional[str] = None):
+    def __init__(self, uid: Optional[str] = None, mesh=None):
         super().__init__(uid)
+        self.mesh = mesh
         self._setDefault(
             impurity="gini",
             probabilityCol="probability",
             rawPredictionCol="rawPrediction",
         )
+
+    def setMesh(self, mesh) -> "RandomForestClassifier":
+        self.mesh = mesh
+        return self
 
     def getProbabilityCol(self) -> str:
         return self.getOrDefault(self.probabilityCol)
@@ -291,7 +301,7 @@ class RandomForestClassifier(_RandomForestParams, Estimator, MLReadable):
         row_stats = np.zeros((x.shape[0], n_classes), dtype=np.float32)
         row_stats[np.arange(x.shape[0]), y_int] = 1.0  # one-hot class counts
         with TraceRange("rf-classifier fit", TraceColor.GREEN):
-            forest = _fit_forest(self, x, row_stats, self.getImpurity(), True)
+            forest = _fit_forest(self, x, row_stats, self.getImpurity(), True, self.mesh)
         model = RandomForestClassificationModel(
             self.uid, forest, numFeatures=x.shape[1], numClasses=n_classes
         )
@@ -392,9 +402,14 @@ class RandomForestClassificationModel(_RandomForestParams, Model):
 class RandomForestRegressor(_RandomForestParams, Estimator, MLReadable):
     """``RandomForestRegressor().setNumTrees(20).fit((X, y))``."""
 
-    def __init__(self, uid: Optional[str] = None):
+    def __init__(self, uid: Optional[str] = None, mesh=None):
         super().__init__(uid)
+        self.mesh = mesh
         self._setDefault(impurity="variance")
+
+    def setMesh(self, mesh) -> "RandomForestRegressor":
+        self.mesh = mesh
+        return self
 
     def setImpurity(self, v: str):
         if v != "variance":
@@ -412,7 +427,7 @@ class RandomForestRegressor(_RandomForestParams, Estimator, MLReadable):
         yc = y - y_mean
         row_stats = np.stack([np.ones_like(yc), yc, yc * yc], axis=1)
         with TraceRange("rf-regressor fit", TraceColor.GREEN):
-            forest = _fit_forest(self, x, row_stats, "variance", False)
+            forest = _fit_forest(self, x, row_stats, "variance", False, self.mesh)
         forest = forest._replace(leaf_value=forest.leaf_value + y_mean)
         model = RandomForestRegressionModel(self.uid, forest, numFeatures=x.shape[1])
         return self._copyValues(model)

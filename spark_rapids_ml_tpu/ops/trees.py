@@ -228,6 +228,7 @@ def _leaf_prediction(stats: jax.Array, kind: str) -> jax.Array:
         "min_instances",
         "min_info_gain",
         "block_rows",
+        "axis_name",
     ),
 )
 def grow_forest(
@@ -244,6 +245,7 @@ def grow_forest(
     min_instances: int = 1,
     min_info_gain: float = 0.0,
     block_rows: int = 4096,
+    axis_name: str | None = None,
 ) -> Forest:
     """Grow T trees level-synchronously; all shapes static, one XLA program.
 
@@ -251,6 +253,13 @@ def grow_forest(
     does one blocked-GEMM histogram pass over the data, a fused split
     search, and a gather-based row re-routing — the level-order analogue of
     cuML's node-batched builder, with the MXU doing the counting.
+
+    Distributed mode (``axis_name`` set, under ``shard_map``): rows are
+    sharded over the named mesh axis; each device builds its shard's partial
+    histogram and one ``psum`` per level merges them over ICI — the Spark
+    ``treeAggregate`` of the reference (RapidsRowMatrix.scala:207-233)
+    becomes an XLA collective. Split selection then runs identically
+    (replicated) on every device, so routing needs no further traffic.
     """
     T, n = weights.shape
     d = x_binned.shape[1]
@@ -276,6 +285,8 @@ def grow_forest(
             node_idx, weights, x_binned, row_stats, offset, m_nodes, n_bins,
             block_rows,
         )  # (T, M, d, B, S)
+        if axis_name is not None:
+            hist = lax.psum(hist, axis_name)
         left = jnp.cumsum(hist, axis=3)
         total = left[:, :, 0, -1, :]  # (T, M, S): same for every feature
         right = total[:, :, None, None, :] - left
@@ -344,6 +355,8 @@ def grow_forest(
     offset = 2**max_depth - 1
     m_nodes = 2**max_depth
     total = _node_totals(node_idx, weights, row_stats, offset, m_nodes, block_rows)
+    if axis_name is not None:
+        total = lax.psum(total, axis_name)
     sl = slice(offset, offset + m_nodes)
     is_leaf = is_leaf.at[:, sl].set(True)
     leaf_value = leaf_value.at[:, sl, :].set(_leaf_prediction(total, impurity))
@@ -351,6 +364,56 @@ def grow_forest(
     node_weight = node_weight.at[:, sl].set(w_bottom)
 
     return Forest(feature, threshold, is_leaf, leaf_value, node_weight, node_gain)
+
+
+def grow_forest_sharded(
+    mesh,
+    x_binned: jax.Array,
+    row_stats: jax.Array,
+    weights: jax.Array,
+    edges: jax.Array,
+    key: jax.Array,
+    **kwargs,
+) -> Forest:
+    """Mesh path: rows sharded over the data axis, per-shard partial
+    histograms merged with one ``psum`` per level (see :func:`grow_forest`).
+
+    Inputs are HOST arrays; rows are padded to a multiple of the data-axis
+    size with zero weight (padded rows contribute nothing to any histogram).
+    The returned forest is replicated — identical on every device.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    n = x_binned.shape[0]
+    dp = mesh.shape[DATA_AXIS]
+    pad = (-n) % dp
+    if pad:
+        x_binned = jnp.concatenate(
+            [x_binned, jnp.zeros((pad, x_binned.shape[1]), x_binned.dtype)]
+        )
+        row_stats = jnp.concatenate(
+            [row_stats, jnp.zeros((pad, row_stats.shape[1]), row_stats.dtype)]
+        )
+        weights = jnp.concatenate(
+            [weights, jnp.zeros((weights.shape[0], pad), weights.dtype)], axis=1
+        )
+
+    def local(xb, rs, w, e, k):
+        return grow_forest(xb, rs, w, e, k, axis_name=DATA_AXIS, **kwargs)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(None, DATA_AXIS), P(), P()),
+        out_specs=Forest(P(), P(), P(), P(), P(), P()),
+        # psum'd histograms make every split decision replicated; the vma
+        # checker cannot see that, so skip the static check (as in ops.knn).
+        check_vma=False,
+    )
+    return fn(x_binned, row_stats, weights, edges, key)
 
 
 @partial(jax.jit, static_argnames=("max_depth",))

@@ -1,0 +1,120 @@
+"""Pipeline / PipelineModel — parity with ``org.apache.spark.ml.Pipeline``.
+
+A pipeline chains transformers and estimators: ``fit`` walks the stages,
+fitting each estimator on the current dataset and transforming the dataset
+forward through every fitted stage; the result is a ``PipelineModel`` of
+pure transformers. Persistence stores each stage under ``stages/<i>_<uid>``
+with its import path, so heterogeneous stage types round-trip.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+from typing import Any, List, Optional
+
+from spark_rapids_ml_tpu.core.estimator import Estimator, Model, Transformer
+from spark_rapids_ml_tpu.core.persistence import MLReadable, load_metadata, save_metadata
+
+
+def save_stages(owner, path: str, stages: List[Any], class_name: str) -> None:
+    """Persist ``stages`` under ``<path>/stages/<i>_<uid>`` with import
+    paths in the metadata, so heterogeneous stage types round-trip."""
+    save_metadata(
+        owner,
+        path,
+        class_name=class_name,
+        extra_metadata={
+            "stageUids": [s.uid for s in stages],
+            "stageClasses": [
+                f"{type(s).__module__}.{type(s).__qualname__}" for s in stages
+            ],
+        },
+    )
+    for i, stage in enumerate(stages):
+        if not isinstance(stage, MLReadable):
+            raise TypeError(
+                f"stage {stage.uid} ({type(stage).__name__}) is not persistable"
+            )
+        stage.save(os.path.join(path, "stages", f"{i}_{stage.uid}"))
+
+
+def load_stages(path: str, expected_class: str):
+    """Load (metadata, stages) written by :func:`save_stages`."""
+    metadata = load_metadata(path, expected_class=expected_class)
+    stages: List[Any] = []
+    for i, (uid, class_path) in enumerate(
+        zip(metadata.get("stageUids", []), metadata.get("stageClasses", []))
+    ):
+        module_name, _, class_name = class_path.rpartition(".")
+        klass = getattr(importlib.import_module(module_name), class_name)
+        stages.append(klass.load(os.path.join(path, "stages", f"{i}_{uid}")))
+    return metadata, stages
+
+
+class Pipeline(Estimator, MLReadable):
+    """``Pipeline(stages=[...]).fit(df)`` — Spark's sequential composition."""
+
+    def __init__(self, uid: Optional[str] = None, stages: Optional[List[Any]] = None):
+        super().__init__(uid)
+        self.stages = list(stages or [])
+
+    def setStages(self, value: List[Any]) -> "Pipeline":
+        self.stages = list(value)
+        return self
+
+    def getStages(self) -> List[Any]:
+        return self.stages
+
+    def _save_impl(self, path: str) -> None:
+        save_stages(self, path, self.stages, "org.apache.spark.ml.Pipeline")
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "Pipeline":
+        metadata, stages = load_stages(path, "Pipeline")
+        return cls(metadata["uid"], stages)
+
+    def fit(self, dataset: Any) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        current = dataset
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+                fitted.append(model)
+                if i < len(self.stages) - 1:
+                    current = model.transform(current)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                if i < len(self.stages) - 1:
+                    current = stage.transform(current)
+            else:
+                raise TypeError(
+                    f"pipeline stage {i} is neither Estimator nor Transformer: "
+                    f"{type(stage).__name__}"
+                )
+        return PipelineModel(self.uid, fitted)
+
+
+class PipelineModel(Model):
+    """Fitted pipeline: transform passes the dataset through every stage."""
+
+    def __init__(self, uid: Optional[str] = None, stages: Optional[List[Transformer]] = None):
+        super().__init__(uid)
+        self.stages = list(stages or [])
+
+    def transform(self, dataset: Any) -> Any:
+        current = dataset
+        for stage in self.stages:
+            current = stage.transform(current)
+        return current
+
+    def _save_impl(self, path: str) -> None:
+        save_stages(self, path, self.stages, "org.apache.spark.ml.PipelineModel")
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "PipelineModel":
+        metadata, stages = load_stages(path, "PipelineModel")
+        return cls(metadata["uid"], stages)
+
+
+__all__ = ["Pipeline", "PipelineModel"]

@@ -15,10 +15,31 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import importlib
+import os
+
 from spark_rapids_ml_tpu.core.data import DataFrame
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, toFloat, toInt
+from spark_rapids_ml_tpu.core.persistence import load_metadata, save_metadata
 from spark_rapids_ml_tpu.evaluation import Evaluator
+
+
+def _save_best_model(owner, path: str, class_name: str, extra: dict) -> None:
+    best = owner.bestModel
+    if best is None:
+        raise ValueError("cannot save a validator model with no bestModel")
+    extra = dict(extra)
+    extra["bestModelClass"] = f"{type(best).__module__}.{type(best).__qualname__}"
+    save_metadata(owner, path, class_name=class_name, extra_metadata=extra)
+    best.save(os.path.join(path, "bestModel"))
+
+
+def _load_best_model(path: str, expected_class: str):
+    metadata = load_metadata(path, expected_class=expected_class)
+    module_name, _, class_name = metadata["bestModelClass"].rpartition(".")
+    klass = getattr(importlib.import_module(module_name), class_name)
+    return metadata, klass.load(os.path.join(path, "bestModel"))
 
 
 class ParamGridBuilder:
@@ -207,6 +228,25 @@ class CrossValidatorModel(_ValidatorParams, Model):
     def transform(self, dataset: Any) -> Any:
         return self.bestModel.transform(dataset)
 
+    def _save_impl(self, path: str) -> None:
+        _save_best_model(
+            self,
+            path,
+            "org.apache.spark.ml.tuning.CrossValidatorModel",
+            {"avgMetrics": list(self.avgMetrics), "bestIndex": self.bestIndex},
+        )
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "CrossValidatorModel":
+        metadata, best = _load_best_model(path, "CrossValidatorModel")
+        model = cls(
+            metadata["uid"],
+            best,
+            avgMetrics=list(metadata.get("avgMetrics", [])),
+            bestIndex=int(metadata.get("bestIndex", 0)),
+        )
+        return model
+
 
 class TrainValidationSplit(_ValidatorParams, Estimator):
     """Single random train/validation split over a param grid."""
@@ -276,6 +316,27 @@ class TrainValidationSplitModel(_ValidatorParams, Model):
 
     def transform(self, dataset: Any) -> Any:
         return self.bestModel.transform(dataset)
+
+    def _save_impl(self, path: str) -> None:
+        _save_best_model(
+            self,
+            path,
+            "org.apache.spark.ml.tuning.TrainValidationSplitModel",
+            {
+                "validationMetrics": list(self.validationMetrics),
+                "bestIndex": self.bestIndex,
+            },
+        )
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "TrainValidationSplitModel":
+        metadata, best = _load_best_model(path, "TrainValidationSplitModel")
+        return cls(
+            metadata["uid"],
+            best,
+            validationMetrics=list(metadata.get("validationMetrics", [])),
+            bestIndex=int(metadata.get("bestIndex", 0)),
+        )
 
 
 __all__ = [

@@ -83,3 +83,43 @@ class TestDistributedPCA:
         m_mesh = PCA(mesh=mesh_4x2).setK(4).fit(x)
         m_single = PCA().setK(4).fit(x)
         np.testing.assert_allclose(np.abs(m_mesh.pc), np.abs(m_single.pc), atol=1e-6)
+
+
+class TestDistributedRandomForest:
+    """Rows sharded over the data axis; per-level histograms psum over the
+    mesh. Classification counts are small integers (exact in fp32), so the
+    sharded fit must produce the IDENTICAL forest to the single-device fit."""
+
+    def test_sharded_classifier_identical(self, rng, mesh_8x1):
+        from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+        x = rng.normal(size=(203, 6))  # deliberately not divisible by 8
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(float)
+        kw = dict(numTrees=5, maxDepth=4, seed=3)
+        m_single = RandomForestClassifier()._set(**kw).fit((x, y))
+        m_mesh = RandomForestClassifier(mesh=mesh_8x1)._set(**kw).fit((x, y))
+        np.testing.assert_array_equal(
+            np.asarray(m_single._forest.feature), np.asarray(m_mesh._forest.feature)
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_single._forest.threshold),
+            np.asarray(m_mesh._forest.threshold),
+            atol=1e-6,
+        )
+        np.testing.assert_array_equal(m_single.predict(x), m_mesh.predict(x))
+
+    def test_sharded_regressor_quality(self, rng, mesh_4x2):
+        from spark_rapids_ml_tpu.regression import RandomForestRegressor
+
+        x = rng.normal(size=(240, 4))
+        y = 2.0 * x[:, 0] - x[:, 2]
+        model = (
+            RandomForestRegressor(mesh=mesh_4x2)
+            .setNumTrees(8)
+            .setMaxDepth(6)
+            .setFeatureSubsetStrategy("all")
+            .setSeed(1)
+            .fit((x, y))
+        )
+        rmse = np.sqrt(np.mean((model.predict(x) - y) ** 2))
+        assert rmse < 0.6

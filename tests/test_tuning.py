@@ -166,6 +166,31 @@ class TestCrossValidator:
         acc = np.mean(np.asarray(out.select("prediction")) == y)
         assert acc > 0.9
 
+    def test_model_persistence_roundtrip(self, tmp_path, rng):
+        from spark_rapids_ml_tpu.tuning import CrossValidatorModel
+
+        x, y = _ridge_data(rng)
+        lr = LinearRegression()
+        grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 1.0]).build()
+        model = (
+            CrossValidator()
+            .setEstimator(lr)
+            .setEstimatorParamMaps(grid)
+            .setEvaluator(RegressionEvaluator())
+            .setSeed(0)
+            .fit((x, y))
+        )
+        path = str(tmp_path / "cvm")
+        model.save(path)
+        loaded = CrossValidatorModel.load(path)
+        assert loaded.bestIndex == model.bestIndex
+        np.testing.assert_allclose(loaded.avgMetrics, model.avgMetrics)
+        np.testing.assert_allclose(loaded.transform(x), model.transform(x), atol=1e-10)
+
+    def test_copy_preserves_mesh(self):
+        rf = RandomForestClassifier(mesh="sentinel-mesh")
+        assert rf.copy({}).mesh == "sentinel-mesh"
+
     def test_validation_errors(self):
         cv = CrossValidator()
         with pytest.raises(ValueError):
