@@ -63,12 +63,16 @@ def centered_gram_pallas(
         return jnp.zeros((d, d), dtype=x.dtype)
     # Pad d to a lane multiple and rows to a whole number of tiles.
     d_pad = (-d) % 128
-    # VMEM budget: x tile (double-buffered) + centered temp + (dp, dp)
-    # accumulator must fit in ~16 MB. Clamp block_rows so
-    # (3*block*dp + dp^2) * 4B <= 12 MB, keeping a sublane multiple.
+    # VMEM budget: x tile (double-buffered) + centered temp + the HIGHEST-
+    # precision dot's multi-pass scratch (6 bf16 passes keep ~6 tile-sized
+    # operand splits live) + (dp, dp) accumulator, all within the ~16 MB
+    # scoped limit. Empirically on v5e at d=1024 a 256-row tile compiles and
+    # 512 does not, which matches an 8*tile + acc model against a 12 MB
+    # budget — so clamp block_rows to (12 MB/4 - dp^2) / (8*dp), keeping a
+    # sublane multiple.
     dp_ = d + d_pad
     budget_elems = (12 << 20) // 4
-    max_block = (budget_elems - dp_ * dp_) // (3 * dp_)
+    max_block = (budget_elems - dp_ * dp_) // (8 * dp_)
     if max_block < 8:
         raise ValueError(
             f"d={d} needs a ({dp_}, {dp_}) VMEM accumulator that exceeds the "
