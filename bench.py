@@ -56,13 +56,12 @@ def main() -> None:
     x = jax.random.normal(jax.random.key(7), (N_ROWS, N_COLS), dtype=jnp.float32)
     float(jnp.sum(x[0]))  # materialize input before timing
 
-    from benchmarks.common import time_median
+    from benchmarks.common import time_amortized
 
-    def run() -> None:
-        pc, ev = fit(x)
-        float(ev[0])  # sync: force the computation to complete
-
-    elapsed = time_median(run)
+    # Amortized sync: the tunnel's scalar-readback round trip (~tens of ms)
+    # is paid once per batch of queued executions, not once per run, so the
+    # number measures the device, not the relay.
+    elapsed = time_amortized(lambda: fit(x)[1], lambda ev: float(ev[0]), inner=5)
     rows_per_sec = N_ROWS / elapsed
 
     print(

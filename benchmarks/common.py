@@ -20,6 +20,30 @@ def time_median(fn: Callable[[], None], repeats: int = 3) -> float:
     return times[len(times) // 2]
 
 
+def time_amortized(dispatch: Callable[[], object], sync: Callable[[object], None],
+                   inner: int = 8, repeats: int = 3) -> float:
+    """Median per-execution wall-clock with the device-sync cost amortized.
+
+    The TPU here sits behind a relay tunnel whose scalar-readback round trip
+    is tens of milliseconds — comparable to the small configs' entire
+    compute. ``dispatch`` enqueues one (async) execution and returns its
+    output; ``inner`` executions are queued back-to-back and ``sync`` blocks
+    on the LAST one (the device stream is in-order), so the round trip is
+    paid once per ``inner`` runs instead of once per run.
+    """
+    sync(dispatch())  # warmup: compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(inner):
+            out = dispatch()
+        sync(out)
+        times.append((time.perf_counter() - t0) / inner)
+    times.sort()
+    return times[len(times) // 2]
+
+
 def _timed(fn: Callable[[], None]) -> float:
     t0 = time.perf_counter()
     fn()

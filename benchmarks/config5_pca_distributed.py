@@ -22,7 +22,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import emit, time_median
+from benchmarks.common import emit, time_amortized
 
 BLOCK, D, K = 1_000_000, 1024, 16
 TOTAL_ROWS, N_CHIPS = 100_000_000, 8
@@ -43,11 +43,9 @@ def main() -> None:
     mean = jnp.mean(x, axis=0)
     float(jnp.sum(x[0]))
 
-    def run_block() -> None:
-        g = block_cov(x, mean)
-        float(g[0, 0])
-
-    block_t = time_median(run_block)
+    block_t = time_amortized(
+        lambda: block_cov(x, mean), lambda g: float(g[0, 0]), inner=5
+    )
     rows_per_sec_chip = BLOCK / block_t
 
     @jax.jit
@@ -57,11 +55,7 @@ def main() -> None:
 
     cov = jnp.asarray(block_cov(x, mean)) / (BLOCK - 1)
 
-    def run_eig() -> None:
-        v, w = eig(cov)
-        float(w[0])
-
-    eig_t = time_median(run_eig)
+    eig_t = time_amortized(lambda: eig(cov)[1], lambda w: float(w[0]), inner=5)
 
     projected_wall = TOTAL_ROWS / (rows_per_sec_chip * N_CHIPS) + eig_t
     emit(

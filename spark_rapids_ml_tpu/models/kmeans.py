@@ -159,9 +159,12 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                 init = random_init(xs, mask, key, k)
             else:
                 init = kmeans_plusplus_init(xs, mask, key, k)
+            from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+            shards = self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
             centers, cost, n_iter = lloyd(
                 xs, mask, init, max_iter=self.getMaxIter(), tol=self.getTol(),
-                cosine=cosine,
+                cosine=cosine, data_shards=shards,
             )
 
         # Strip model-axis feature padding introduced by shard_rows.

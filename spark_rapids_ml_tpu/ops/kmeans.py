@@ -50,30 +50,74 @@ def assign_clusters(x, centers, precision: str = "highest"):
     return labels, jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
 
 
-def lloyd_step(x, mask, centers, x2, prec, cosine: bool = False):
+def _assign_and_accumulate(xb, mb, x2b, centers, k, prec):
+    """Block-local assignment + sufficient stats: (sums (k,d), counts (k),
+    cost) for one row block — everything stays block-sized, so XLA fuses
+    the distance GEMM, argmin, and one-hot matmul without ever writing an
+    (n, k) array to HBM."""
+    d2 = _sq_dists(xb, centers, x2b, prec)
+    labels = jnp.argmin(d2, axis=1)
+    min_d2 = jnp.min(d2, axis=1)
+    one_hot = jax.nn.one_hot(labels, k, dtype=xb.dtype) * mb[:, None]
+    sums = jnp.matmul(one_hot.T, xb, precision=prec)  # (k, d) on MXU
+    counts = jnp.sum(one_hot, axis=0)
+    cost = jnp.sum(min_d2 * mb)
+    return sums, counts, cost
+
+
+def lloyd_step(x, mask, centers, x2, prec, cosine: bool = False,
+               block_rows: int | None = None):
     """One Lloyd iteration. Returns (new_centers, cost).
 
     ``cosine``: renormalize updated centers to unit norm (Spark's
     CosineDistanceMeasure.updateClusterCenter) so assignments stay true
     cosine argmins given unit-normalized input rows.
+
+    ``block_rows``: stream rows through a ``lax.scan`` in fixed blocks.
+    The unblocked step materializes two (n, k) arrays per iteration —
+    ~2·n·k·4 bytes of HBM write+read traffic that dominates the wall clock
+    once n·k outgrows the caches; the blocked step's per-iteration traffic
+    is one read of x. Rows must already be padded (mask=0) to a multiple
+    of ``block_rows`` by the caller-facing :func:`lloyd`.
     """
     k = centers.shape[0]
-    d2 = _sq_dists(x, centers, x2, prec)
-    labels = jnp.argmin(d2, axis=1)
-    min_d2 = jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
-    one_hot = jax.nn.one_hot(labels, k, dtype=x.dtype) * mask[:, None]
-    sums = jnp.matmul(one_hot.T, x, precision=prec)          # (k, d) on MXU
-    counts = jnp.sum(one_hot, axis=0)                         # (k,)
+    if block_rows is None or x.shape[0] <= block_rows:
+        sums, counts, cost = _assign_and_accumulate(x, mask, x2, centers, k, prec)
+    else:
+        nb = x.shape[0] // block_rows
+
+        def body(carry, blk):
+            s, c, j = carry
+            xb, mb, x2b = blk
+            sb, cb, jb = _assign_and_accumulate(xb, mb, x2b, centers, k, prec)
+            return (s + sb, c + cb, j + jb), None
+
+        init = (
+            jnp.zeros((k, x.shape[1]), x.dtype),
+            jnp.zeros((k,), x.dtype),
+            jnp.asarray(0.0, x.dtype),
+        )
+        (sums, counts, cost), _ = jax.lax.scan(
+            body,
+            init,
+            (
+                x.reshape(nb, block_rows, -1),
+                mask.reshape(nb, block_rows),
+                x2.reshape(nb, block_rows),
+            ),
+        )
     new_centers = jnp.where(
         counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
     )
     if cosine:
         new_centers = normalize_rows(new_centers)
-    cost = jnp.sum(min_d2 * mask)
     return new_centers, cost
 
 
-@partial(jax.jit, static_argnames=("max_iter", "precision", "cosine"))
+@partial(
+    jax.jit,
+    static_argnames=("max_iter", "precision", "cosine", "block_rows", "data_shards"),
+)
 def lloyd(
     x: jax.Array,
     mask: jax.Array,
@@ -82,6 +126,8 @@ def lloyd(
     tol: float = 1e-4,
     precision: str = "highest",
     cosine: bool = False,
+    block_rows: Optional[int] = None,
+    data_shards: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full Lloyd fit: returns (centers, cost, n_iters).
 
@@ -89,9 +135,38 @@ def lloyd(
     more than ``tol`` (euclidean), or at ``max_iter``. With ``cosine``,
     centers stay unit-normalized every iteration (input rows must already be
     unit-normalized), so the returned cost is the cosine-distance potential.
+
+    ``block_rows``: None = auto. The unblocked step is the fast path —
+    measured 373M vs 280M row-iters/s at 20M x 16, k=100 on v5e, because
+    the distance reduction fuses into the GEMM epilogue and a scan only
+    adds sequential dependencies. Blocking exists for MEMORY: once the
+    (n, k) one-hot temporary approaches HBM capacity (~9 GB here), rows
+    stream through a scan in blocks sized to ~1 GB of temporaries.
+
+    ``data_shards``: number of mesh data-axis shards the rows are spread
+    over (1 = single device). The auto threshold compares the PER-DEVICE
+    (n/shards, k) temporary against HBM — a row-sharded multi-chip fit must
+    not fall onto the sequential blocked path dp times too early.
     """
     prec = _dot_precision(precision)
+    n = x.shape[0]
+    k = init_centers.shape[0]
+    if block_rows is None:
+        # Per-device (n, k) fp32 temporary vs the HBM budget.
+        if 4 * n * k // max(data_shards, 1) > 9_000_000_000:
+            # Block sized so block*k*4B stays ~1 GB (no larger floor: a
+            # floor above this budget would reintroduce the OOM for big k).
+            block_rows = max(8, (250_000_000 // max(k, 1) // 8) * 8)
+        else:
+            block_rows = n + 1  # unblocked
+    blocked = n > block_rows
+    if blocked:
+        pad = (-n) % block_rows
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)])
+            mask = jnp.concatenate([mask, jnp.zeros((pad,), mask.dtype)])
     x2 = jnp.sum(x * x, axis=1)
+    br = block_rows if blocked else None
 
     def cond(state):
         _, moved, it, _ = state
@@ -99,14 +174,16 @@ def lloyd(
 
     def body(state):
         centers, _, it, _ = state
-        new_centers, cost = lloyd_step(x, mask, centers, x2, prec, cosine=cosine)
+        new_centers, cost = lloyd_step(
+            x, mask, centers, x2, prec, cosine=cosine, block_rows=br
+        )
         moved = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
         return new_centers, moved, it + 1, cost
 
     init_state = (init_centers, jnp.asarray(jnp.inf, x.dtype), 0, jnp.asarray(0.0, x.dtype))
     centers, _, n_iter, cost = jax.lax.while_loop(cond, body, init_state)
     # One final cost evaluation against the converged centers.
-    _, final_cost = lloyd_step(x, mask, centers, x2, prec, cosine=cosine)
+    _, final_cost = lloyd_step(x, mask, centers, x2, prec, cosine=cosine, block_rows=br)
     return centers, final_cost, n_iter
 
 
