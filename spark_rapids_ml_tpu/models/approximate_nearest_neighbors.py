@@ -19,6 +19,7 @@ n_lists, seed), so a reloaded model probes identical lists.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -36,11 +37,48 @@ from spark_rapids_ml_tpu.core.persistence import (
     save_metadata,
     save_rows,
 )
-from spark_rapids_ml_tpu.ops.ann import IVFIndex, build_ivf_index, ivf_search
+from spark_rapids_ml_tpu.ops.ann import (
+    IVFIndex,
+    IVFPQIndex,
+    build_ivf_index,
+    build_ivfpq_index,
+    ivf_search,
+    ivfpq_search,
+)
 from spark_rapids_ml_tpu.ops.knn import knn
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
-_ALGORITHMS = ("ivfflat", "brute")
+_ALGORITHMS = ("ivfflat", "ivfpq", "brute")
+
+
+@partial(jax.jit, static_argnames=("k", "block_q"))
+def _refine_exact(q, items, cand_idx, k, block_q: int = 1024):
+    """Re-rank PQ candidates with exact squared distances.
+
+    Queries stream in ``block_q`` chunks (same memory discipline as the
+    searches — an unblocked (nq, k', d) gather would OOM large batches).
+    ``cand_idx`` (nq, k') may contain -1 fill slots; those stay at +inf.
+    Returns ascending (d2 (nq, k), idx (nq, k))."""
+    nq = q.shape[0]
+    n_blocks = -(-nq // block_q)
+    pad = n_blocks * block_q - nq
+    qp = jnp.pad(q, ((0, pad), (0, 0)))
+    cp = jnp.pad(cand_idx, ((0, pad), (0, 0)), constant_values=-1)
+
+    def one_block(args):
+        qb, cb = args
+        gathered = items[jnp.maximum(cb, 0)]  # (Bq, k', d)
+        diff = qb[:, None, :] - gathered
+        d2 = jnp.sum(diff * diff, axis=2)
+        d2 = jnp.where(cb >= 0, d2, jnp.inf)
+        neg_top, pos = jax.lax.top_k(-d2, k)
+        return -neg_top, jnp.take_along_axis(cb, pos, axis=1)
+
+    d2, idx = jax.lax.map(
+        one_block,
+        (qp.reshape(n_blocks, block_q, -1), cp.reshape(n_blocks, block_q, -1)),
+    )
+    return d2.reshape(-1, k)[:nq], idx.reshape(-1, k)[:nq]
 _METRICS = ("euclidean", "sqeuclidean", "cosine")
 
 
@@ -112,7 +150,10 @@ class ApproximateNearestNeighbors(_ANNParams, Estimator, MLReadable):
         return self
 
     def setAlgoParams(self, value: Dict[str, Any]) -> "ApproximateNearestNeighbors":
-        known = {"nlist", "nprobe", "kmeans_iters"}
+        known = {
+            "nlist", "nprobe", "kmeans_iters", "M", "n_bits", "pq_iters",
+            "refine_ratio",
+        }
         unknown = set(value) - known
         if unknown:
             raise ValueError(f"unknown algoParams {sorted(unknown)}; known: {sorted(known)}")
@@ -167,7 +208,7 @@ class ApproximateNearestNeighbors(_ANNParams, Estimator, MLReadable):
             raise ValueError(f"k={self.getK()} exceeds item count {items.shape[0]}")
         model = ApproximateNearestNeighborsModel(self.uid, np.asarray(items), ids)
         model = self._copyValues(model)
-        if model.getAlgorithm() == "ivfflat":
+        if model.getAlgorithm() in ("ivfflat", "ivfpq"):
             with TraceRange("ann build index", TraceColor.YELLOW):
                 model._build_index()
         return model
@@ -185,7 +226,8 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
         super().__init__(uid)
         self.items = None if items is None else np.asarray(items)
         self.ids = None if ids is None else np.asarray(ids)
-        self._index: Optional[IVFIndex] = None
+        self._index: Optional[IVFIndex | IVFPQIndex] = None
+        self._items_dev = None  # cached device copy of _search_items()
 
     def _effective_nlist(self) -> int:
         n = self.items.shape[0]
@@ -205,13 +247,45 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
         items = self.items.astype(_dtype(), copy=False)
         return _normalize(items) if self.getMetric() == "cosine" else items
 
+    def _search_items_device(self):
+        """Device copy of the (normalized) items, computed once — repeated
+        kneighbors calls must not redo the O(n*d) host normalize+transfer."""
+        if self._items_dev is None:
+            self._items_dev = jnp.asarray(self._search_items())
+        return self._items_dev
+
+    def _effective_m(self, d: int) -> int:
+        m = self.getAlgoParams().get("M")
+        if m is not None:
+            # An EXPLICIT M must divide d — silently retuning a user's
+            # compression setting would contradict build_ivfpq_index, which
+            # raises for the same input.
+            return int(m)
+        # cuML-style auto default: ~d/4-dim subspaces, nudged to divide d.
+        m = max(1, d // 4)
+        while m > 1 and d % m != 0:
+            m -= 1
+        return m
+
     def _build_index(self) -> None:
-        self._index = build_ivf_index(
-            self._search_items(),
-            n_lists=self._effective_nlist(),
-            seed=self.getSeed(),
-            kmeans_iters=int(self.getAlgoParams().get("kmeans_iters", 10)),
-        )
+        params = self.getAlgoParams()
+        if self.getAlgorithm() == "ivfpq":
+            self._index = build_ivfpq_index(
+                self._search_items(),
+                n_lists=self._effective_nlist(),
+                m_subspaces=self._effective_m(self.items.shape[1]),
+                n_bits=int(params.get("n_bits", 8)),
+                seed=self.getSeed(),
+                kmeans_iters=int(params.get("kmeans_iters", 10)),
+                pq_iters=int(params.get("pq_iters", 10)),
+            )
+        else:
+            self._index = build_ivf_index(
+                self._search_items(),
+                n_lists=self._effective_nlist(),
+                seed=self.getSeed(),
+                kmeans_iters=int(params.get("kmeans_iters", 10)),
+            )
 
     def kneighbors(
         self, queries: Any, k: Optional[int] = None
@@ -237,16 +311,39 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
                 # knn's sqeuclidean output matches ivf_search's; the shared
                 # metric post-processing below then applies to both paths.
                 d2_j, idx = knn(
-                    jnp.asarray(q), jnp.asarray(self._search_items()), k=k,
+                    jnp.asarray(q), self._search_items_device(), k=k,
                     metric="sqeuclidean",
                 )
                 d2 = np.asarray(d2_j)
             else:
                 if self._index is None:
                     self._build_index()
-                d2_j, idx = ivf_search(self._index, jnp.asarray(q), k=k,
-                                       n_probe=self._effective_nprobe(self._index.n_lists))
-                d2 = np.asarray(d2_j)
+                if isinstance(self._index, IVFPQIndex):
+                    # Refine (FAISS IndexRefineFlat / cuML refine_ratio):
+                    # over-fetch candidates under the quantized metric, then
+                    # re-rank that shortlist with exact distances — recovers
+                    # most of the recall PQ noise costs, at k*ratio exact
+                    # distance computations per query.
+                    ratio = int(self.getAlgoParams().get("refine_ratio", 1))
+                    k_fetch = min(max(k * max(ratio, 1), k), self.items.shape[0])
+                    d2_j, idx_j = ivfpq_search(
+                        self._index, jnp.asarray(q), k=k_fetch,
+                        n_probe=self._effective_nprobe(self._index.n_lists),
+                    )
+                    if k_fetch > k:
+                        d2_j, idx_j = _refine_exact(
+                            jnp.asarray(q),
+                            self._search_items_device(),
+                            idx_j,
+                            k,
+                        )
+                    d2, idx = np.asarray(d2_j), np.asarray(idx_j)
+                else:
+                    d2_j, idx_j = ivf_search(
+                        self._index, jnp.asarray(q), k=k,
+                        n_probe=self._effective_nprobe(self._index.n_lists),
+                    )
+                    d2, idx = np.asarray(d2_j), np.asarray(idx_j)
 
         idx = np.asarray(idx)
         if metric == "euclidean":

@@ -38,7 +38,7 @@ from spark_rapids_ml_tpu.ops.kmeans import (
     normalize_rows,
     random_init,
 )
-from spark_rapids_ml_tpu.parallel.mesh import shard_rows
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, shard_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
@@ -159,8 +159,6 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                 init = random_init(xs, mask, key, k)
             else:
                 init = kmeans_plusplus_init(xs, mask, key, k)
-            from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
-
             shards = self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
             centers, cost, n_iter = lloyd(
                 xs, mask, init, max_iter=self.getMaxIter(), tol=self.getTol(),

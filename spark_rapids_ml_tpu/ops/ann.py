@@ -1,8 +1,8 @@
-"""Approximate nearest neighbors — IVF-Flat, redesigned for the MXU.
+"""Approximate nearest neighbors — IVF-Flat and IVF-PQ, redesigned for the MXU.
 
 Beyond-the-reference capability (the reference ships only PCA — SURVEY.md
 §2; the modern RAPIDS Spark-ML line grew ApproximateNearestNeighbors on
-cuML, default algorithm ``ivfflat``). cuML's IVF-Flat walks per-list
+cuML, algorithms ``ivfflat`` and ``ivfpq``). cuML's IVF walks per-list
 inverted indices with variable-length lists and warp-level scans — dynamic
 shapes and pointer-chasing a TPU can't tile. TPU-first redesign:
 
@@ -20,6 +20,15 @@ shapes and pointer-chasing a TPU can't tile. TPU-first redesign:
 
 Setting ``n_probe = n_lists`` makes the search exact (every list probed),
 which the tests exploit as a brute-force oracle.
+
+**IVF-PQ** adds product quantization of the per-list residuals: the feature
+axis splits into M subspaces, each residual subvector is snapped to one of
+2^n_bits codebook entries (codebooks trained by the same GEMM Lloyd,
+vmapped over subspaces), and search replaces the per-item distance GEMM
+with an ADC lookup — a (Bq, M, K) distance table per probed list (one small
+batched GEMM) followed by M table gathers summed over subspaces. Memory per
+item drops from 4·d bytes to M code bytes; the table gather is the TPU
+analogue of cuML's shared-memory LUT walk.
 """
 
 from __future__ import annotations
@@ -101,32 +110,24 @@ def build_ivf_index(
     )
 
 
-@partial(jax.jit, static_argnames=("k", "n_probe", "block_q", "precision"))
-def ivf_search(
-    index: IVFIndex,
-    queries: jax.Array,
-    k: int,
-    n_probe: int,
-    block_q: int = 1024,
-    precision: str = "highest",
-) -> Tuple[jax.Array, jax.Array]:
-    """Top-k approximate neighbors: (sq-distances (nq, k), indices (nq, k)).
+def _probe_scaffold(index, queries, k, n_probe, block_q, prec, list_d2_fn):
+    """Shared IVF search scaffold: query blocking/padding, coarse centroid
+    ranking, scan over probed lists with a running top-k merge.
 
-    Indices are original item indices; unfilled slots (fewer than k
-    candidates in the probed lists) are (inf, -1).
+    ``list_d2_fn(qb, q_sq, lid)`` computes the (Bq, L_max) squared-distance
+    estimate of query block ``qb`` against list ``lid`` — the ONLY piece
+    that differs between IVF-Flat (exact GEMM) and IVF-PQ (ADC tables).
+    Unfilled slots surface as (inf, -1).
     """
-    n_lists, l_max, d = index.lists.shape
+    n_lists = index.list_mask.shape[0]
     if not 1 <= n_probe <= n_lists:
         raise ValueError(f"n_probe must be in [1, {n_lists}], got {n_probe}")
-    prec = _dot_precision(precision)
-    nq = queries.shape[0]
+    nq, d = queries.shape
     dtype = queries.dtype
 
     n_qblocks = -(-nq // block_q)
     pad = n_qblocks * block_q - nq
     qp = jnp.pad(queries, ((0, pad), (0, 0)))
-
-    item_sq = jnp.sum(index.lists * index.lists, axis=2)  # (n_lists, L_max)
 
     def one_query_block(qb):
         q_sq = jnp.sum(qb * qb, axis=1)
@@ -143,15 +144,10 @@ def ivf_search(
         def probe_step(carry, p):
             best_d, best_i = carry
             lid = probe_ids[:, p]  # (Bq,)
-            xb = index.lists[lid]  # (Bq, L_max, d) gather
-            mb = index.list_mask[lid]
-            ib = index.list_ids[lid]
-            xb_sq = item_sq[lid]
-            cross = jnp.einsum("bd,bld->bl", qb, xb, precision=prec)
-            d2 = jnp.maximum(q_sq[:, None] - 2.0 * cross + xb_sq, 0.0)
-            d2 = jnp.where(mb > 0, d2, jnp.inf)
+            d2 = list_d2_fn(qb, q_sq, lid)
+            d2 = jnp.where(index.list_mask[lid] > 0, d2, jnp.inf)
             cand_d = jnp.concatenate([best_d, d2], axis=1)
-            cand_i = jnp.concatenate([best_i, ib], axis=1)
+            cand_i = jnp.concatenate([best_i, index.list_ids[lid]], axis=1)
             neg_top, pos = lax.top_k(-cand_d, k)
             return (-neg_top, jnp.take_along_axis(cand_i, pos, axis=1)), None
 
@@ -166,3 +162,146 @@ def ivf_search(
         best_d.reshape(n_qblocks * block_q, k)[:nq],
         best_i.reshape(n_qblocks * block_q, k)[:nq],
     )
+
+
+@partial(jax.jit, static_argnames=("k", "n_probe", "block_q", "precision"))
+def ivf_search(
+    index: IVFIndex,
+    queries: jax.Array,
+    k: int,
+    n_probe: int,
+    block_q: int = 1024,
+    precision: str = "highest",
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k approximate neighbors: (sq-distances (nq, k), indices (nq, k)).
+
+    Indices are original item indices; unfilled slots (fewer than k
+    candidates in the probed lists) are (inf, -1).
+    """
+    prec = _dot_precision(precision)
+    item_sq = jnp.sum(index.lists * index.lists, axis=2)  # (n_lists, L_max)
+
+    def list_d2(qb, q_sq, lid):
+        xb = index.lists[lid]  # (Bq, L_max, d) gather
+        cross = jnp.einsum("bd,bld->bl", qb, xb, precision=prec)
+        return jnp.maximum(q_sq[:, None] - 2.0 * cross + item_sq[lid], 0.0)
+
+    return _probe_scaffold(index, queries, k, n_probe, block_q, prec, list_d2)
+
+
+class IVFPQIndex(NamedTuple):
+    """Dense IVF-PQ index: coarse lists + per-subspace residual codebooks.
+
+    centroids: (n_lists, d)
+    codebooks: (M, K, ds)        — K = 2^n_bits entries per subspace
+    codes:     (n_lists, L_max, M) int32 — residual code per item/subspace
+    list_mask: (n_lists, L_max)
+    list_ids:  (n_lists, L_max)  — original item indices, -1 at padding
+    """
+
+    centroids: jax.Array
+    codebooks: jax.Array
+    codes: jax.Array
+    list_mask: jax.Array
+    list_ids: jax.Array
+
+    @property
+    def n_lists(self) -> int:
+        return self.codes.shape[0]
+
+
+def build_ivfpq_index(
+    items: np.ndarray,
+    n_lists: int,
+    m_subspaces: int,
+    n_bits: int = 8,
+    seed: int = 0,
+    kmeans_iters: int = 10,
+    pq_iters: int = 10,
+) -> IVFPQIndex:
+    """Train the coarse quantizer, then per-subspace residual codebooks.
+
+    Builds on the IVF-Flat packer for grouping; the PQ training runs one
+    GEMM Lloyd per subspace over (a sample of) the residuals.
+    """
+    items = np.asarray(items)
+    n, d = items.shape
+    if d % m_subspaces != 0:
+        raise ValueError(f"d={d} not divisible by M={m_subspaces} subspaces")
+    if not 1 <= n_bits <= 8:
+        raise ValueError(f"n_bits must be in [1, 8], got {n_bits}")
+    ds = d // m_subspaces
+    n_codes = min(1 << n_bits, n)
+
+    flat = build_ivf_index(items, n_lists, seed=seed, kmeans_iters=kmeans_iters)
+    # Residuals of the REAL items, flattened over lists (padding excluded
+    # from training via its zero mask weight).
+    residuals = flat.lists - flat.centroids[:, None, :]  # (n_lists, L_max, d)
+    r = residuals.reshape(-1, d)
+    w = flat.list_mask.reshape(-1)
+
+    key = jax.random.key(seed + 1)
+    codebooks = []
+    codes = []
+    r_sub = r.reshape(r.shape[0], m_subspaces, ds)
+    for m in range(m_subspaces):
+        rm = r_sub[:, m, :]
+        init = kmeans_plusplus_init(rm, w, jax.random.fold_in(key, m), n_codes)
+        cb, _, _ = lloyd(rm, w, init, max_iter=pq_iters, tol=1e-4)
+        code_m, _ = assign_clusters(rm, cb)
+        codebooks.append(cb)
+        codes.append(code_m)
+    codebooks = jnp.stack(codebooks)  # (M, K, ds)
+    # uint8 delivers the documented M-bytes-per-item footprint (n_bits <= 8
+    # guarantees codes fit); search upcasts per probed block for indexing.
+    codes = jnp.stack(codes, axis=-1).reshape(
+        flat.lists.shape[0], flat.lists.shape[1], m_subspaces
+    ).astype(jnp.uint8)
+
+    return IVFPQIndex(
+        centroids=flat.centroids,
+        codebooks=codebooks,
+        codes=codes,
+        list_mask=flat.list_mask,
+        list_ids=flat.list_ids,
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "n_probe", "block_q", "precision"))
+def ivfpq_search(
+    index: IVFPQIndex,
+    queries: jax.Array,
+    k: int,
+    n_probe: int,
+    block_q: int = 1024,
+    precision: str = "highest",
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k by ADC (asymmetric distance): (sq-distances (nq, k), ids (nq, k)).
+
+    Per probed list: residual r = q - centroid, one batched GEMM builds the
+    (Bq, M, K) subspace distance table, then d2(item) = sum_m LUT[m, code_m]
+    via M gathers. Distances are quantization approximations of the true
+    squared euclidean distance (standard IVF-PQ semantics).
+    """
+    n_lists, l_max, m_sub = index.codes.shape
+    _, n_codes, ds = index.codebooks.shape
+    prec = _dot_precision(precision)
+    cb_sq = jnp.sum(index.codebooks * index.codebooks, axis=2)  # (M, K)
+
+    def list_d2(qb, q_sq, lid):
+        bq = qb.shape[0]
+        r = (qb - index.centroids[lid]).reshape(bq, m_sub, ds)
+        # ADC table: ||r_m - cb[m, j]||^2 for every subspace/entry.
+        r_sq = jnp.sum(r * r, axis=2)  # (Bq, M)
+        cross = jnp.einsum(
+            "bms,mjs->bmj", r, index.codebooks, precision=prec
+        )  # (Bq, M, K)
+        lut = jnp.maximum(r_sq[:, :, None] - 2.0 * cross + cb_sq[None, :, :], 0.0)
+        codes_b = index.codes[lid].astype(jnp.int32)  # (Bq, L_max, M)
+        rows = jnp.arange(bq)[:, None]
+        d2 = jnp.zeros((bq, l_max), dtype=qb.dtype)
+        for m in range(m_sub):  # static M: unrolled table gathers
+            d2 = d2 + lut[:, m, :][rows, codes_b[:, :, m]]
+        return d2
+
+    return _probe_scaffold(index, queries, k, n_probe, block_q, prec, list_d2)

@@ -192,3 +192,128 @@ class TestEstimator:
         d2, idx2 = loaded.kneighbors(q)
         np.testing.assert_array_equal(idx, idx2)
         np.testing.assert_allclose(d, d2, rtol=1e-6)
+
+
+class TestIVFPQ:
+    """IVF-PQ: quantized distances trade exactness for memory; recall on
+    probe-all must stay high, and the ADC distance must approximate the
+    true squared distance at codebook resolution."""
+
+    def test_recall_probe_all(self, rng):
+        from spark_rapids_ml_tpu.models.approximate_nearest_neighbors import (
+            ApproximateNearestNeighbors,
+        )
+        from spark_rapids_ml_tpu.ops.knn import knn
+        import jax.numpy as jnp
+
+        items = rng.normal(size=(400, 16))
+        queries = rng.normal(size=(25, 16))
+        model = (
+            ApproximateNearestNeighbors()
+            .setAlgorithm("ivfpq")
+            .setAlgoParams({"nlist": 8, "nprobe": 8, "M": 8, "n_bits": 6})
+            .setK(10)
+            .setSeed(0)
+            .fit(items)
+        )
+        d_pq, i_pq = model.kneighbors(queries)
+        _, i_true = knn(jnp.asarray(queries), jnp.asarray(items), 10,
+                        metric="sqeuclidean")
+        i_true = np.asarray(i_true)
+        recall = np.mean([
+            len(set(i_pq[q]) & set(i_true[q])) / 10 for q in range(len(queries))
+        ])
+        assert recall >= 0.7  # quantized at 6 bits x 8 subspaces
+        assert np.all(np.diff(d_pq, axis=1) >= -1e-5)  # ascending distances
+
+    def test_adc_distance_accuracy(self, rng):
+        from spark_rapids_ml_tpu.ops.ann import build_ivfpq_index, ivfpq_search
+        import jax.numpy as jnp
+
+        items = rng.normal(size=(300, 8)).astype(np.float32)
+        queries = rng.normal(size=(10, 8)).astype(np.float32)
+        index = build_ivfpq_index(items, n_lists=4, m_subspaces=4, n_bits=8, seed=1)
+        d2, idx = ivfpq_search(index, jnp.asarray(queries), k=5, n_probe=4)
+        d2, idx = np.asarray(d2), np.asarray(idx)
+        # ADC distance within quantization error of the true distance.
+        for q in range(10):
+            for j in range(5):
+                true = np.sum((queries[q] - items[idx[q, j]]) ** 2)
+                assert abs(d2[q, j] - true) < max(1.0, 0.5 * true)
+
+    def test_refine_improves_recall(self, rng):
+        from spark_rapids_ml_tpu.models.approximate_nearest_neighbors import (
+            ApproximateNearestNeighbors,
+        )
+        from spark_rapids_ml_tpu.ops.knn import knn
+        import jax.numpy as jnp
+
+        items = rng.normal(size=(600, 32))
+        queries = rng.normal(size=(40, 32))
+        _, i_true = knn(jnp.asarray(queries), jnp.asarray(items), 10,
+                        metric="sqeuclidean")
+        i_true = np.asarray(i_true)
+
+        def recall(ap):
+            m = (
+                ApproximateNearestNeighbors()
+                .setAlgorithm("ivfpq")
+                .setAlgoParams(ap)
+                .setK(10)
+                .setSeed(0)
+                .fit(items)
+            )
+            _, i = m.kneighbors(queries)
+            return np.mean([len(set(i[q]) & set(i_true[q])) / 10 for q in range(40)])
+
+        base = {"nlist": 6, "nprobe": 6, "M": 8, "n_bits": 4}
+        r_plain = recall(base)
+        r_refined = recall({**base, "refine_ratio": 8})
+        # Probe-all isolates quantization loss; exact re-ranking of an 8x
+        # shortlist must recover most of it (4-bit codes are deliberately
+        # coarse, so the unrefined ranking is far from exact).
+        assert r_refined >= r_plain + 0.05
+        assert r_refined >= 0.85
+
+    def test_bad_params(self, rng):
+        from spark_rapids_ml_tpu.ops.ann import build_ivfpq_index
+
+        items = rng.normal(size=(50, 10))
+        with pytest.raises(ValueError):
+            build_ivfpq_index(items, n_lists=4, m_subspaces=3)  # 10 % 3 != 0
+        with pytest.raises(ValueError):
+            build_ivfpq_index(items, n_lists=4, m_subspaces=2, n_bits=9)
+
+    def test_m_auto_divides(self):
+        from spark_rapids_ml_tpu.models.approximate_nearest_neighbors import (
+            ApproximateNearestNeighborsModel,
+        )
+
+        m = ApproximateNearestNeighborsModel()
+        assert 10 % m._effective_m(10) == 0
+        assert 16 % m._effective_m(16) == 0
+        assert m._effective_m(7) == 1
+
+    def test_explicit_bad_m_raises(self, rng):
+        # An explicit M that does not divide d must raise, not be retuned.
+        from spark_rapids_ml_tpu.models.approximate_nearest_neighbors import (
+            ApproximateNearestNeighbors,
+        )
+
+        items = rng.normal(size=(50, 10))
+        with pytest.raises(ValueError, match="not divisible"):
+            (
+                ApproximateNearestNeighbors()
+                .setAlgorithm("ivfpq")
+                .setAlgoParams({"nlist": 4, "M": 3})
+                .fit(items)
+            )
+
+    def test_codes_are_uint8(self, rng):
+        from spark_rapids_ml_tpu.ops.ann import build_ivfpq_index
+        import jax.numpy as jnp
+
+        index = build_ivfpq_index(
+            rng.normal(size=(100, 8)), n_lists=4, m_subspaces=4, n_bits=8
+        )
+        assert index.codes.dtype == jnp.uint8
