@@ -2,9 +2,11 @@
 
 Param surface mirrors ``org.apache.spark.ml.regression.LinearRegression``:
 ``featuresCol``, ``labelCol``, ``predictionCol``, ``fitIntercept``,
-``regParam`` (L2 -> Ridge), ``elasticNetParam`` (must be 0 for the normal
-solver, as in Spark), ``standardization``, ``solver`` ("normal" | "auto").
-Beyond-the-reference capability (BASELINE.md config 4).
+``regParam``, ``elasticNetParam`` (0 -> Ridge via the exact normal-equation
+solve; > 0 -> Lasso/elastic net via FISTA on the same sufficient
+statistics — solver="normal" rejects it, as in Spark), ``standardization``,
+``solver`` ("normal" | "auto"). Beyond-the-reference capability
+(BASELINE.md config 4).
 """
 
 from __future__ import annotations
@@ -30,6 +32,7 @@ from spark_rapids_ml_tpu.ops.linear import (
     normal_eq_stats,
     predict_linear,
     regression_metrics,
+    solve_elastic_net,
     solve_normal,
 )
 from spark_rapids_ml_tpu.parallel.mesh import shard_rows
@@ -120,6 +123,8 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         return self
 
     def setElasticNetParam(self, value: float) -> "LinearRegression":
+        if not 0.0 <= value <= 1.0:
+            raise ValueError(f"elasticNetParam must be in [0, 1], got {value}")
         self.set(self.elasticNetParam, value)
         return self
 
@@ -138,9 +143,6 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         return self
 
     def fit(self, dataset: Any) -> "LinearRegressionModel":
-        if self.getElasticNetParam() != 0.0:
-            # Same restriction as Spark's normal solver (L1 needs OWL-QN).
-            raise ValueError("normal solver supports only L2 (elasticNetParam must be 0)")
         x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
@@ -159,16 +161,41 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
                 mask = jnp.ones(xs.shape[0], dtype=dtype)
             xtx, xty, x_sum, y_sum, yty, count = normal_eq_stats(xs, ys, mask)
             d = x_host.shape[1]
-            coef, intercept = solve_normal(
-                xtx[:d, :d],
-                xty[:d],
-                x_sum[:d],
-                y_sum,
-                count,
-                reg_param=self.getRegParam(),
-                fit_intercept=self.getFitIntercept(),
-                standardization=self.getStandardization(),
-            )
+            enet = self.getElasticNetParam()
+            if enet > 0.0 and self.getOrDefault(self.solver) == "normal":
+                # Spark's normal solver rejects L1 the same way.
+                raise ValueError(
+                    "solver='normal' supports only L2 (elasticNetParam must "
+                    "be 0); use solver='auto' for elastic net"
+                )
+            if enet == 0.0 or self.getRegParam() == 0.0:
+                # Zero effective penalty: the exact (Cholesky) solve, not a
+                # fixed-step proximal approximation of the same objective.
+                coef, intercept = solve_normal(
+                    xtx[:d, :d],
+                    xty[:d],
+                    x_sum[:d],
+                    y_sum,
+                    count,
+                    reg_param=self.getRegParam(),
+                    fit_intercept=self.getFitIntercept(),
+                    standardization=self.getStandardization(),
+                )
+            else:
+                # L1/elastic net: FISTA on the same sufficient statistics —
+                # one data GEMM pass, then O(d^2) proximal iterations
+                # (Spark reaches this case via OWL-QN over the data).
+                coef, intercept, _ = solve_elastic_net(
+                    xtx[:d, :d],
+                    xty[:d],
+                    x_sum[:d],
+                    y_sum,
+                    count,
+                    reg_param=self.getRegParam(),
+                    elastic_net_param=enet,
+                    fit_intercept=self.getFitIntercept(),
+                    standardization=self.getStandardization(),
+                )
 
         model = LinearRegressionModel(
             self.uid, np.asarray(coef, dtype=np.float64), float(intercept)

@@ -2,12 +2,13 @@
 
 Param surface mirrors ``org.apache.spark.ml.classification.LogisticRegression``:
 ``featuresCol``, ``labelCol``, ``predictionCol``, ``probabilityCol``,
-``rawPredictionCol``, ``maxIter``, ``regParam``, ``elasticNetParam`` (must be
-0 — L2 only, like this framework's normal-equation LinearRegression),
-``tol``, ``fitIntercept``, ``standardization``, ``family``
-("auto" | "binomial" | "multinomial"), ``threshold``. Beyond-the-reference
-capability (the reference ships only PCA — SURVEY.md §2); the whole
-optimization is one jitted L-BFGS program (ops.logistic), mesh-shardable.
+``rawPredictionCol``, ``maxIter``, ``regParam``, ``elasticNetParam`` (0 ->
+L2 via jitted L-BFGS; > 0 -> L1/elastic net via jitted FISTA, Spark's
+OWL-QN analogue), ``tol``, ``fitIntercept``, ``standardization``,
+``family`` ("auto" | "binomial" | "multinomial"), ``threshold``.
+Beyond-the-reference capability (the reference ships only PCA — SURVEY.md
+§2); the whole optimization is one jitted program (ops.logistic),
+mesh-shardable.
 
 Model attributes follow Spark: binomial exposes ``coefficients`` (d,) and
 ``intercept``; multinomial exposes ``coefficientMatrix`` (numClasses, d) and
@@ -37,6 +38,7 @@ from spark_rapids_ml_tpu.models.linear_regression import _extract_xy
 from spark_rapids_ml_tpu.ops.logistic import (
     classification_metrics,
     fit_logistic,
+    fit_logistic_elastic_net,
     predict_logistic,
 )
 from spark_rapids_ml_tpu.parallel.mesh import shard_rows
@@ -156,6 +158,8 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
         return self
 
     def setElasticNetParam(self, value: float) -> "LogisticRegression":
+        if not 0.0 <= value <= 1.0:
+            raise ValueError(f"elasticNetParam must be in [0, 1], got {value}")
         self.set(self.elasticNetParam, value)
         return self
 
@@ -186,8 +190,6 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
         return self
 
     def fit(self, dataset: Any) -> "LogisticRegressionModel":
-        if self.getElasticNetParam() != 0.0:
-            raise ValueError("only L2 supported (elasticNetParam must be 0)")
         x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         y_int = y_host.astype(np.int64)
         if not np.array_equal(y_int, y_host):
@@ -217,18 +219,42 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                 ys = jnp.asarray(y_int, dtype=jnp.int32)
                 mask = jnp.ones(xs.shape[0], dtype=dtype)
             use_multinomial = family == "multinomial"
-            result = fit_logistic(
-                xs,
-                ys,
-                mask,
-                n_classes=n_classes,
-                reg_param=self.getRegParam(),
-                fit_intercept=self.getFitIntercept(),
-                standardization=self.getStandardization(),
-                max_iter=self.getMaxIter(),
-                tol=self.getTol(),
-                multinomial=use_multinomial,
-            )
+            enet = self.getElasticNetParam()
+            # regParam == 0 means zero effective penalty whatever enet says:
+            # use the L-BFGS path (faster, and it applies the multinomial
+            # identifiability pivot the proximal path has no need for).
+            if enet == 0.0 or self.getRegParam() == 0.0:
+                result = fit_logistic(
+                    xs,
+                    ys,
+                    mask,
+                    n_classes=n_classes,
+                    reg_param=self.getRegParam(),
+                    fit_intercept=self.getFitIntercept(),
+                    standardization=self.getStandardization(),
+                    max_iter=self.getMaxIter(),
+                    tol=self.getTol(),
+                    multinomial=use_multinomial,
+                )
+            else:
+                # L1/elastic net: FISTA (Spark reaches this via OWL-QN).
+                # maxIter caps proximal iterations exactly as it caps
+                # OWL-QN iterations in Spark — users of the slower-
+                # converging proximal steps raise maxIter, preserving the
+                # totalIterations <= maxIter invariant.
+                result = fit_logistic_elastic_net(
+                    xs,
+                    ys,
+                    mask,
+                    n_classes=n_classes,
+                    reg_param=self.getRegParam(),
+                    elastic_net_param=enet,
+                    fit_intercept=self.getFitIntercept(),
+                    standardization=self.getStandardization(),
+                    max_iter=self.getMaxIter(),
+                    tol=self.getTol(),
+                    multinomial=use_multinomial,
+                )
             weights = np.asarray(result.weights)
             intercepts = np.asarray(result.intercepts)
 

@@ -93,3 +93,10 @@ def triu_to_full(packed: jax.Array) -> jax.Array:
     full = full.at[rows, cols].set(packed)
     off_diag = jnp.where(jnp.arange(n)[:, None] < jnp.arange(n)[None, :], full, 0.0)
     return full + off_diag.T
+
+
+def soft_threshold(v, t):
+    """Proximal operator of t*||.||_1: sign(v) * max(|v| - t, 0)."""
+    import jax.numpy as jnp
+
+    return jnp.sign(v) * jnp.maximum(jnp.abs(v) - t, 0.0)
