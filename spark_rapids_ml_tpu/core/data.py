@@ -234,3 +234,41 @@ def as_matrix(data: Any) -> np.ndarray:
     if len(parts) == 1:
         return parts[0]
     return np.concatenate(parts, axis=0)
+
+
+def extract_weights(dataset: Any, weight_col: Optional[str]) -> Optional[np.ndarray]:
+    """Optional per-row weight column (Spark's ``weightCol``).
+
+    Returns None when no weight column is configured. Named-column
+    containers only — a bare (X, y) tuple has no columns to resolve the
+    name against, so configuring weightCol with one is an error rather
+    than a silent ignore. Weights must be non-negative and not all zero.
+    """
+    if weight_col is None:
+        return None
+    w = None
+    if isinstance(dataset, DataFrame):
+        w = np.asarray(dataset.select(weight_col), dtype=np.float64)
+    else:
+        try:
+            import pandas as pd
+
+            if isinstance(dataset, pd.DataFrame):
+                if weight_col not in dataset.columns:
+                    raise KeyError(f"no column {weight_col!r} in pandas DataFrame")
+                w = dataset[weight_col].to_numpy(dtype=np.float64)
+        except ImportError:  # pragma: no cover
+            pass
+    if w is None:
+        raise TypeError(
+            f"weightCol={weight_col!r} requires a dataset with named columns "
+            f"(DataFrame shim or pandas), got {type(dataset).__name__}"
+        )
+    w = w.ravel()
+    # `not all(w >= 0)` (unlike `any(w < 0)`) also rejects NaN, which would
+    # otherwise poison every weighted sum downstream.
+    if not np.all(w >= 0):
+        raise ValueError("weights must be non-negative and non-NaN")
+    if not np.any(w > 0):
+        raise ValueError("at least one weight must be positive")
+    return w

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features, extract_weights
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, gt, toFloat, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -38,7 +38,7 @@ from spark_rapids_ml_tpu.ops.kmeans import (
     normalize_rows,
     random_init,
 )
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, shard_rows
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, shard_rows, weights_as_mask
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
@@ -51,6 +51,7 @@ class _KMeansParams(Params):
     distanceMeasure = Param("_", "distanceMeasure", "euclidean or cosine", toString)
     featuresCol = Param("_", "featuresCol", "features column name", toString)
     predictionCol = Param("_", "predictionCol", "prediction column name", toString)
+    weightCol = Param("_", "weightCol", "per-row weight column name", toString)
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
@@ -88,6 +89,13 @@ class _KMeansParams(Params):
 
     def getPredictionCol(self) -> str:
         return self.getOrDefault(self.predictionCol)
+
+    def getWeightCol(self) -> Optional[str]:
+        return (
+            self.getOrDefault(self.weightCol)
+            if self.isDefined(self.weightCol)
+            else None
+        )
 
 
 class KMeans(_KMeansParams, Estimator, MLReadable):
@@ -133,6 +141,10 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
         self.set(self.predictionCol, value)
         return self
 
+    def setWeightCol(self, value: str) -> "KMeans":
+        self.set(self.weightCol, value)
+        return self
+
     def setMesh(self, mesh) -> "KMeans":
         self.mesh = mesh
         return self
@@ -140,6 +152,7 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
     def fit(self, dataset: Any) -> "KMeansModel":
         rows = _extract_features(dataset, self.getFeaturesCol())
         x_host = as_matrix(rows)
+        w_host = extract_weights(dataset, self.getWeightCol())
         k = self.getK()
         if k > x_host.shape[0]:
             raise ValueError(f"k={k} exceeds number of rows {x_host.shape[0]}")
@@ -153,8 +166,13 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
             else:
                 xs = jnp.asarray(x_host, dtype=dtype)
                 mask = jnp.ones(xs.shape[0], dtype=dtype)
+            if w_host is not None:
+                # The row mask doubles as the per-row weight (padding = 0).
+                mask = weights_as_mask(w_host, xs.shape[0], np.dtype(dtype), self.mesh)
             if cosine:
-                xs = normalize_rows(xs) * mask[:, None]  # keep padding at zero
+                # Zero out padding via the mask's SUPPORT, not its value —
+                # fractional weights must not rescale the unit vectors.
+                xs = normalize_rows(xs) * (mask > 0).astype(dtype)[:, None]
             if self.getInitMode() == "random":
                 init = random_init(xs, mask, key, k)
             else:

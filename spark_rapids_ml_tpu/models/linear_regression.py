@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_weights
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -35,7 +35,7 @@ from spark_rapids_ml_tpu.ops.linear import (
     solve_elastic_net,
     solve_normal,
 )
-from spark_rapids_ml_tpu.parallel.mesh import shard_rows
+from spark_rapids_ml_tpu.parallel.mesh import shard_rows, weights_as_mask
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
@@ -50,6 +50,7 @@ class _LinearRegressionParams(Params):
         "_", "standardization", "penalize standardized coefficients", toBoolean
     )
     solver = Param("_", "solver", "normal or auto", toString)
+    weightCol = Param("_", "weightCol", "per-row weight column name", toString)
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
@@ -87,6 +88,13 @@ class _LinearRegressionParams(Params):
 
     def getSolver(self) -> str:
         return self.getOrDefault(self.solver)
+
+    def getWeightCol(self) -> Optional[str]:
+        return (
+            self.getOrDefault(self.weightCol)
+            if self.isDefined(self.weightCol)
+            else None
+        )
 
 
 class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
@@ -138,12 +146,24 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         self.set(self.solver, value)
         return self
 
+    def setWeightCol(self, value: str) -> "LinearRegression":
+        self.set(self.weightCol, value)
+        return self
+
     def setMesh(self, mesh) -> "LinearRegression":
         self.mesh = mesh
         return self
 
     def fit(self, dataset: Any) -> "LinearRegressionModel":
+        if self.getElasticNetParam() > 0.0 and self.getSolver() == "normal":
+            # Spark's normal solver rejects L1 the same way; validate before
+            # any data movement or GEMM work.
+            raise ValueError(
+                "solver='normal' supports only L2 (elasticNetParam must "
+                "be 0); use solver='auto' for elastic net"
+            )
         x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
+        w_host = extract_weights(dataset, self.getWeightCol())
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
         with TraceRange("linreg fit", TraceColor.DARK_GREEN):
@@ -159,15 +179,12 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
                 xs = jnp.asarray(x_host, dtype=dtype)
                 ys = jnp.asarray(y_host, dtype=dtype)
                 mask = jnp.ones(xs.shape[0], dtype=dtype)
+            if w_host is not None:
+                # The row mask doubles as the per-row weight (padding = 0).
+                mask = weights_as_mask(w_host, xs.shape[0], np.dtype(dtype), self.mesh)
             xtx, xty, x_sum, y_sum, yty, count = normal_eq_stats(xs, ys, mask)
             d = x_host.shape[1]
             enet = self.getElasticNetParam()
-            if enet > 0.0 and self.getOrDefault(self.solver) == "normal":
-                # Spark's normal solver rejects L1 the same way.
-                raise ValueError(
-                    "solver='normal' supports only L2 (elasticNetParam must "
-                    "be 0); use solver='auto' for elastic net"
-                )
             if enet == 0.0 or self.getRegParam() == 0.0:
                 # Zero effective penalty: the exact (Cholesky) solve, not a
                 # fixed-step proximal approximation of the same objective.

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_weights
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -41,7 +41,7 @@ from spark_rapids_ml_tpu.ops.logistic import (
     fit_logistic_elastic_net,
     predict_logistic,
 )
-from spark_rapids_ml_tpu.parallel.mesh import shard_rows
+from spark_rapids_ml_tpu.parallel.mesh import shard_rows, weights_as_mask
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
@@ -61,6 +61,7 @@ class _LogisticRegressionParams(Params):
     )
     family = Param("_", "family", "auto, binomial, or multinomial", toString)
     threshold = Param("_", "threshold", "binary decision threshold", toFloat)
+    weightCol = Param("_", "weightCol", "per-row weight column name", toString)
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
@@ -118,6 +119,13 @@ class _LogisticRegressionParams(Params):
 
     def getThreshold(self) -> float:
         return self.getOrDefault(self.threshold)
+
+    def getWeightCol(self):
+        return (
+            self.getOrDefault(self.weightCol)
+            if self.isDefined(self.weightCol)
+            else None
+        )
 
 
 class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
@@ -185,12 +193,17 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
         self.set(self.threshold, value)
         return self
 
+    def setWeightCol(self, value: str) -> "LogisticRegression":
+        self.set(self.weightCol, value)
+        return self
+
     def setMesh(self, mesh) -> "LogisticRegression":
         self.mesh = mesh
         return self
 
     def fit(self, dataset: Any) -> "LogisticRegressionModel":
         x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
+        w_host = extract_weights(dataset, self.getWeightCol())
         y_int = y_host.astype(np.int64)
         if not np.array_equal(y_int, y_host):
             raise ValueError("labels must be integers in [0, numClasses)")
@@ -218,6 +231,9 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                 xs = jnp.asarray(x_host, dtype=dtype)
                 ys = jnp.asarray(y_int, dtype=jnp.int32)
                 mask = jnp.ones(xs.shape[0], dtype=dtype)
+            if w_host is not None:
+                # The row mask doubles as the per-row weight (padding = 0).
+                mask = weights_as_mask(w_host, xs.shape[0], np.dtype(dtype), self.mesh)
             use_multinomial = family == "multinomial"
             enet = self.getElasticNetParam()
             # regParam == 0 means zero effective penalty whatever enet says:

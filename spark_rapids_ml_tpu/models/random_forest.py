@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features, extract_weights
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -107,6 +107,7 @@ class _RandomForestParams(Params):
     featuresCol = Param("_", "featuresCol", "features column name", toString)
     labelCol = Param("_", "labelCol", "label column name", toString)
     predictionCol = Param("_", "predictionCol", "prediction column name", toString)
+    weightCol = Param("_", "weightCol", "per-row weight column name", toString)
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
@@ -164,6 +165,13 @@ class _RandomForestParams(Params):
     def getPredictionCol(self) -> str:
         return self.getOrDefault(self.predictionCol)
 
+    def getWeightCol(self) -> Optional[str]:
+        return (
+            self.getOrDefault(self.weightCol)
+            if self.isDefined(self.weightCol)
+            else None
+        )
+
     # Chainable setters shared by estimators and models.
     def _chain(self, param, value):
         self.set(param, value)
@@ -214,6 +222,9 @@ class _RandomForestParams(Params):
 
     def setPredictionCol(self, v: str):
         return self._chain(self.predictionCol, v)
+
+    def setWeightCol(self, v: str):
+        return self._chain(self.weightCol, v)
 
 
 def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarray,
@@ -300,6 +311,12 @@ class RandomForestClassifier(_RandomForestParams, Estimator, MLReadable):
         n_classes = max(n_classes, 2)
         row_stats = np.zeros((x.shape[0], n_classes), dtype=np.float32)
         row_stats[np.arange(x.shape[0]), y_int] = 1.0  # one-hot class counts
+        w = extract_weights(dataset, self.getWeightCol())
+        if w is not None:
+            # Per-row weights multiply into the stat channels: histogram
+            # contributions become weight * count, composing with the
+            # per-tree bootstrap weights untouched.
+            row_stats *= w[:, None].astype(np.float32)
         with TraceRange("rf-classifier fit", TraceColor.GREEN):
             forest = _fit_forest(self, x, row_stats, self.getImpurity(), True, self.mesh)
         model = RandomForestClassificationModel(
@@ -423,9 +440,16 @@ class RandomForestRegressor(_RandomForestParams, Estimator, MLReadable):
         # the variance signal to cancellation when |mean(y)| >> std(y);
         # variance gains are shift-invariant, so centering changes nothing
         # but the conditioning. The mean is added back to the leaf values.
-        y_mean = float(np.mean(y)) if y.size else 0.0
+        w = extract_weights(dataset, self.getWeightCol())
+        y_mean = (
+            float(np.average(y, weights=w))
+            if w is not None
+            else (float(np.mean(y)) if y.size else 0.0)
+        )
         yc = y - y_mean
         row_stats = np.stack([np.ones_like(yc), yc, yc * yc], axis=1)
+        if w is not None:
+            row_stats *= w[:, None]
         with TraceRange("rf-regressor fit", TraceColor.GREEN):
             forest = _fit_forest(self, x, row_stats, "variance", False, self.mesh)
         forest = forest._replace(leaf_value=forest.leaf_value + y_mean)

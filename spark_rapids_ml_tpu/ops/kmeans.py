@@ -219,15 +219,20 @@ def kmeans_plusplus_init(
     g0 = jax.random.gumbel(key0, (n,), dtype=x.dtype)
     first = jnp.argmax(jnp.where(mask > 0, g0, neg_inf))
     centers = jnp.zeros((k, d), x.dtype).at[0].set(x[first])
-    # min_d2: distance to nearest chosen center, maintained incrementally.
+    # min_d2: UNWEIGHTED distance to the nearest chosen center, maintained
+    # incrementally. The mask (which may carry fractional weightCol weights)
+    # enters only at the sampling probabilities and the potential — scaling
+    # min_d2 itself would compound weights across iterations (w^i) and
+    # compare weighted against unweighted candidate distances.
     min_d2 = jnp.maximum(x2 - 2.0 * jnp.matmul(x, x[first], precision=prec) + x2[first], 0.0)
-    min_d2 = min_d2 * mask
 
     def body(i, carry):
         centers, min_d2, key = carry
         key, sub = jax.random.split(key)
-        # Gumbel-top-t draw of candidates ∝ min_d2 over unmasked rows.
-        logw = jnp.where((mask > 0) & (min_d2 > 0), jnp.log(min_d2), neg_inf)
+        # Gumbel-top-t draw of candidates ∝ weight * min_d2 (weighted D^2).
+        logw = jnp.where(
+            (mask > 0) & (min_d2 > 0), jnp.log(mask * min_d2), neg_inf
+        )
         g = jax.random.gumbel(sub, (n,), dtype=x.dtype)
         _, cand = jax.lax.top_k(logw + g, t)
         # all-zero residual (duplicate data): fall back to the first row
@@ -243,7 +248,7 @@ def kmeans_plusplus_init(
         pot = jnp.sum(jnp.minimum(min_d2[None, :], d2c) * mask[None, :], axis=1)
         best = jnp.argmin(pot)
         idx = cand[best]
-        new_min_d2 = jnp.minimum(min_d2, d2c[best]) * mask
+        new_min_d2 = jnp.minimum(min_d2, d2c[best])
         return centers.at[i].set(x[idx]), new_min_d2, key
 
     centers, _, _ = jax.lax.fori_loop(1, k, body, (centers, min_d2, key_loop))

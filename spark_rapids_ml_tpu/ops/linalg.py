@@ -97,6 +97,4 @@ def triu_to_full(packed: jax.Array) -> jax.Array:
 
 def soft_threshold(v, t):
     """Proximal operator of t*||.||_1: sign(v) * max(|v| - t, 0)."""
-    import jax.numpy as jnp
-
     return jnp.sign(v) * jnp.maximum(jnp.abs(v) - t, 0.0)

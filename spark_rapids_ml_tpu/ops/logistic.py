@@ -45,10 +45,15 @@ class LogisticFit(NamedTuple):
 
 
 def _masked_feature_moments(x: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Masked per-feature mean and stddev (population, like Spark's scaler)."""
+    """Weighted per-feature mean and stddev (population, like Spark's scaler).
+
+    The mask may carry fractional weightCol weights, so it must enter the
+    variance LINEARLY — squaring it (masking the residual instead of the
+    squared residual) would inflate sigma by sqrt(w) under uniform weights.
+    """
     n = jnp.sum(mask)
     mean = jnp.sum(x * mask[:, None], axis=0) / n
-    var = jnp.sum(((x - mean) * mask[:, None]) ** 2, axis=0) / n
+    var = jnp.sum(((x - mean) ** 2) * mask[:, None], axis=0) / n
     return mean, jnp.sqrt(var)
 
 

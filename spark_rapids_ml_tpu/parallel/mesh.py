@@ -77,3 +77,17 @@ def shard_rows(x, mesh: Mesh, pad_value: float = 0.0):
     xs = jax.device_put(x, row_sharding(mesh))
     ms = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
     return xs, ms, n
+
+
+def weights_as_mask(w_host, n_rows: int, dtype, mesh: Optional[Mesh] = None):
+    """Per-row weightCol weights as the row mask: padded to ``n_rows`` with
+    zeros (padding must contribute nothing) and, under a mesh, placed with
+    the same P(data) sharding the row mask uses."""
+    w_pad = np.zeros(n_rows, dtype=dtype)
+    w_host = np.asarray(w_host)
+    w_pad[: len(w_host)] = w_host
+    if mesh is not None:
+        return jax.device_put(w_pad, NamedSharding(mesh, P(DATA_AXIS)))
+    import jax.numpy as jnp
+
+    return jnp.asarray(w_pad)
