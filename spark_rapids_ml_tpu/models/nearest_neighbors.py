@@ -153,21 +153,22 @@ class NearestNeighborsModel(_NearestNeighborsParams, Model):
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         with TraceRange("knn", TraceColor.PURPLE):
             if self.mesh is not None:
-                if self.getMetric() != "euclidean":
-                    raise NotImplementedError(
-                        "mesh kneighbors supports euclidean only"
+                metric = self.getMetric()
+                if self._sharded is None or self._sharded[2] != metric:
+                    # One host->device upload of the index (cosine rows
+                    # pre-normalized by shard_items), reused across query
+                    # batches (fit's "store + pre-shard" promise). Keyed by
+                    # metric: re-normalization is baked into the upload.
+                    xs, mask = shard_items(
+                        self.items.astype(np.dtype(dtype)), self.mesh,
+                        metric=metric,
                     )
-                if self._sharded is None:
-                    # One host->device upload of the index, reused across
-                    # query batches (fit's "store + pre-shard" promise).
-                    self._sharded = shard_items(
-                        self.items.astype(np.dtype(dtype)), self.mesh
-                    )
-                xs, mask = self._sharded
-                d2, idx = knn_sharded(
-                    jnp.asarray(q, dtype=dtype), xs, mask, self.mesh, k=k
+                    self._sharded = (xs, mask, metric)
+                xs, mask, _ = self._sharded
+                d, idx = knn_sharded(
+                    jnp.asarray(q, dtype=dtype), xs, mask, self.mesh, k=k,
+                    metric=metric,
                 )
-                d = jnp.sqrt(d2)
             else:
                 d, idx = knn(
                     jnp.asarray(q, dtype=dtype),

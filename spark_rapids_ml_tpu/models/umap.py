@@ -180,9 +180,24 @@ class _UMAPParams(Params):
         return 500 if n <= 10_000 else 200
 
 
-def _knn_excluding_self(x: jax.Array, k: int, metric: str):
-    """kNN of x against itself with the self-match column removed."""
-    d, idx = knn(x, x, k + 1, metric=metric)
+def _knn_excluding_self(x: jax.Array, k: int, metric: str, mesh=None,
+                        x_host=None):
+    """kNN of x against itself with the self-match column removed.
+
+    ``x_host``: the host copy of ``x`` when the caller still has it — the
+    sharded index upload then skips a device->host round trip.
+    """
+    if mesh is not None:
+        from spark_rapids_ml_tpu.ops.knn import knn_sharded, shard_items
+
+        host = x_host if x_host is not None else np.asarray(x)
+        items, item_mask = shard_items(host, mesh, metric=metric)
+        d, idx = knn_sharded(
+            x, items.astype(x.dtype), item_mask.astype(x.dtype), mesh, k + 1,
+            metric=metric,
+        )
+    else:
+        d, idx = knn(x, x, k + 1, metric=metric)
     # The self column is wherever idx == row (ties can displace it from 0);
     # mask it out then take the first k of the rest.
     rows = jnp.arange(x.shape[0])[:, None]
@@ -196,7 +211,22 @@ def _knn_excluding_self(x: jax.Array, k: int, metric: str):
 
 
 class UMAP(_UMAPParams, Estimator, MLReadable):
-    """``UMAP().setNNeighbors(15).setNComponents(2).fit(x)``."""
+    """``UMAP().setNNeighbors(15).setNComponents(2).fit(x)``.
+
+    With a mesh, the kNN graph build — the O(n^2 d) stage — shards items
+    over the data axis (local top-k + all-gathered candidate merge over
+    ICI, :func:`ops.knn.knn_sharded`); the layout optimization stays
+    replicated (its working set is the O(n k) edge list, tiny next to the
+    distance matrix the graph stage avoids materializing).
+    """
+
+    def __init__(self, uid: Optional[str] = None, mesh=None):
+        super().__init__(uid)
+        self.mesh = mesh
+
+    def setMesh(self, mesh) -> "UMAP":
+        self.mesh = mesh
+        return self
 
     def fit(self, dataset: Any) -> "UMAPModel":
         rows = extract_features(dataset, self.getFeaturesCol())
@@ -212,7 +242,9 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
 
         with TraceRange("umap fit", TraceColor.PURPLE):
             x = jnp.asarray(x_host, dtype=jnp.float32)
-            dists, idx = _knn_excluding_self(x, k, self.getMetric())
+            dists, idx = _knn_excluding_self(
+                x, k, self.getMetric(), self.mesh, x_host=x_host
+            )
             graph = fuzzy_simplicial_set(idx, dists)
             if self.getInit() == "spectral" and n <= _SPECTRAL_CAP:
                 emb0 = spectral_init(graph, n, dim, k_init)

@@ -124,16 +124,22 @@ def knn(
     return d2, idx
 
 
-def shard_items(items, mesh) -> Tuple[jax.Array, jax.Array]:
+def shard_items(items, mesh, metric: str = "euclidean") -> Tuple[jax.Array, jax.Array]:
     """Place a host (n, d) item matrix on the mesh for :func:`knn_sharded`:
     rows padded up to a multiple of the data axis and sharded P(data),
     features REPLICATED (the model axis contributes nothing to the top-k
     merge, so column-sharding would only buy an implicit all-gather per
-    query batch). Returns (items_sharded, item_mask_sharded)."""
+    query batch). ``metric="cosine"`` L2-normalizes rows on the host BEFORE
+    the upload, so the sharded index is ready for cosine search. Returns
+    (items_sharded, item_mask_sharded)."""
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     items = np.asarray(items)
+    if metric == "cosine":
+        items = items / np.maximum(
+            np.linalg.norm(items, axis=1, keepdims=True), 1e-30
+        )
     n = items.shape[0]
     dp = mesh.shape[DATA_AXIS]
     n_pad = (-n) % dp
@@ -197,6 +203,7 @@ def knn_sharded(
     mesh,
     k: int,
     precision: str = "highest",
+    metric: str = "sqeuclidean",
 ) -> Tuple[jax.Array, jax.Array]:
     """Mesh path: items row-sharded P(data) (see :func:`shard_items`),
     queries replicated.
@@ -204,7 +211,24 @@ def knn_sharded(
     Each device computes its shard's local (nq, k) top-k, candidates are
     all-gathered over ICI (k per shard per query — tiny), and one final
     merge picks the global winners. Indices returned are GLOBAL item rows.
+
+    ``metric``: "sqeuclidean" (default, the raw merge quantity) |
+    "euclidean" | "cosine". Cosine expects the items to have been sharded
+    with ``shard_items(..., metric="cosine")`` (rows pre-normalized);
+    queries are normalized here — the same sqeuclidean reduction
+    :func:`knn` uses, owned in one place for both call paths.
     """
+    if metric not in ("euclidean", "sqeuclidean", "cosine"):
+        raise ValueError(f"unknown metric {metric!r}")
+    if metric == "cosine":
+        queries = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30
+        )
     n_shard = items.shape[0] // mesh.shape[DATA_AXIS]
     fn = _sharded_knn_fn(mesh, k, n_shard, precision)
-    return fn(queries, items, item_mask)
+    d2, idx = fn(queries, items, item_mask)
+    if metric == "euclidean":
+        return jnp.sqrt(d2), idx
+    if metric == "cosine":
+        return d2 / 2.0, idx
+    return d2, idx

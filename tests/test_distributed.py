@@ -123,3 +123,45 @@ class TestDistributedRandomForest:
         )
         rmse = np.sqrt(np.mean((model.predict(x) - y) ** 2))
         assert rmse < 0.6
+
+
+class TestDistributedUMAP:
+    def test_sharded_knn_graph_matches(self, rng, mesh_8x1):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models.umap import _knn_excluding_self
+
+        x = jnp.asarray(rng.normal(size=(101, 6)), dtype=jnp.float32)
+        d_s, i_s = _knn_excluding_self(x, 8, "euclidean", mesh_8x1)
+        d_u, i_u = _knn_excluding_self(x, 8, "euclidean", None)
+        np.testing.assert_array_equal(np.asarray(i_s), np.asarray(i_u))
+        np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_u), atol=1e-5)
+
+    def test_mesh_umap_fit(self, rng, mesh_8x1):
+        from spark_rapids_ml_tpu.manifold import UMAP
+
+        x = np.concatenate(
+            [rng.normal(size=(40, 8)) + off for off in (0.0, 10.0)]
+        )
+        model = UMAP(mesh=mesh_8x1).setNNeighbors(8).setNEpochs(60).setSeed(0).fit(x)
+        emb = model.embedding
+        assert emb.shape == (80, 2)
+        labels = np.repeat([0, 1], 40)
+        c0, c1 = emb[labels == 0].mean(0), emb[labels == 1].mean(0)
+        spread = np.mean(np.linalg.norm(emb[labels == 0] - c0, axis=1))
+        assert np.linalg.norm(c0 - c1) > 2 * spread
+
+
+class TestDistributedKnnMetrics:
+    def test_mesh_cosine_matches_single(self, rng, mesh_8x1):
+        from spark_rapids_ml_tpu.neighbors import NearestNeighbors
+
+        items = rng.normal(size=(150, 8))
+        q = rng.normal(size=(11, 8))
+        m_mesh = NearestNeighbors().setK(5).setMetric("cosine").fit(items)
+        m_mesh.setMesh(mesh_8x1)
+        m_single = NearestNeighbors().setK(5).setMetric("cosine").fit(items)
+        d_m, i_m = m_mesh.kneighbors(q)
+        d_s, i_s = m_single.kneighbors(q)
+        np.testing.assert_array_equal(i_m, i_s)
+        np.testing.assert_allclose(d_m, d_s, atol=1e-6)
