@@ -35,7 +35,11 @@ from spark_rapids_ml_tpu.core.persistence import (
     save_metadata,
     save_rows,
 )
-from spark_rapids_ml_tpu.ops.dbscan import dbscan_labels, relabel_consecutive
+from spark_rapids_ml_tpu.ops.dbscan import (
+    dbscan_labels,
+    dbscan_labels_sharded,
+    relabel_consecutive,
+)
 from spark_rapids_ml_tpu.ops.knn import knn_sq_euclidean
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
@@ -84,10 +88,15 @@ class _DBSCANParams(Params):
 
 
 class DBSCAN(_DBSCANParams, Estimator, MLReadable):
-    """``DBSCAN().setEps(0.3).setMinSamples(10).fit(x)``."""
+    """``DBSCAN().setEps(0.3).setMinSamples(10).fit(x)``.
 
-    def __init__(self, uid: Optional[str] = None):
+    With a mesh, the epsilon sweeps shard query rows over the data axis and
+    the label-diffusion rounds all-gather the (tiny) label vector over ICI
+    (:func:`ops.dbscan.dbscan_labels_sharded`)."""
+
+    def __init__(self, uid: Optional[str] = None, mesh=None):
         super().__init__(uid)
+        self.mesh = mesh
 
     def setEps(self, value: float) -> "DBSCAN":
         self.set(self.eps, value)
@@ -111,12 +120,21 @@ class DBSCAN(_DBSCANParams, Estimator, MLReadable):
         self.set(self.predictionCol, value)
         return self
 
+    def setMesh(self, mesh) -> "DBSCAN":
+        self.mesh = mesh
+        return self
+
     def fit(self, dataset: Any) -> "DBSCANModel":
         x = as_matrix(extract_features(dataset, self.getFeaturesCol())).astype(
             _dtype(), copy=False
         )
         with TraceRange("dbscan fit", TraceColor.RED):
-            labels, core = dbscan_labels(x, self.getEps(), self.getMinSamples())
+            if self.mesh is not None:
+                labels, core = dbscan_labels_sharded(
+                    self.mesh, x, self.getEps(), self.getMinSamples()
+                )
+            else:
+                labels, core = dbscan_labels(x, self.getEps(), self.getMinSamples())
         labels = relabel_consecutive(np.asarray(labels))
         model = DBSCANModel(
             self.uid,

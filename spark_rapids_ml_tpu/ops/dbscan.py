@@ -49,19 +49,24 @@ def _pad_rows(x: jax.Array, block: int) -> Tuple[jax.Array, int]:
     return x, n_blocks
 
 
-def _eps_sweep(x, valid, eps_sq, per_block, combine, init, block_q, block_i, prec):
+def _eps_sweep(x, valid, eps_sq, per_block, combine, init, block_q, block_i,
+               prec, x_items=None, valid_items=None):
     """Generic blocked sweep over the epsilon graph.
 
     For every query block, scans all item blocks; ``per_block(adj, j0)``
     maps the (Bq, Bi) boolean adjacency (already masked to valid items,
     self-pairs INCLUDED) to a partial result, folded with ``combine`` from
     ``init``. Returns the per-query results concatenated to the padded
-    query count.
+    query count. ``x_items``/``valid_items`` default to the query set; a
+    distinct item set is the distributed case (local query shard against
+    the replicated full point set).
     """
+    if x_items is None:
+        x_items, valid_items = x, valid
     xp, n_qblocks = _pad_rows(x, block_q)
-    xi, n_iblocks = _pad_rows(x, block_i)
+    xi, n_iblocks = _pad_rows(x_items, block_i)
     validp = jnp.pad(valid, (0, xp.shape[0] - valid.shape[0]))
-    validi = jnp.pad(valid, (0, xi.shape[0] - valid.shape[0]))
+    validi = jnp.pad(valid_items, (0, xi.shape[0] - valid_items.shape[0]))
     item_blocks = xi.reshape(n_iblocks, block_i, -1)
     item_valid = validi.reshape(n_iblocks, block_i)
     j_starts = jnp.arange(n_iblocks, dtype=jnp.int32) * block_i
@@ -103,8 +108,17 @@ def core_point_mask(
     n = x.shape[0]
     valid = jnp.ones(n, bool) if row_mask is None else row_mask.astype(bool)
     eps_sq = jnp.asarray(eps, x.dtype) ** 2
+    counts = _eps_neighbor_counts(
+        x, valid, eps_sq, block_q, block_i, _dot_precision(precision)
+    )[:n]
+    return (counts >= min_pts) & valid
 
-    counts = _eps_sweep(
+
+def _eps_neighbor_counts(x, valid, eps_sq, block_q, block_i, prec,
+                         x_items=None, valid_items=None):
+    """(padded_n,) eps-neighbor counts — the one home of the counting sweep
+    (shared by the single-device and sharded paths)."""
+    return _eps_sweep(
         x,
         valid,
         eps_sq,
@@ -113,14 +127,17 @@ def core_point_mask(
         init=jnp.zeros(block_q, jnp.int32),
         block_q=block_q,
         block_i=block_i,
-        prec=_dot_precision(precision),
-    )[:n]
-    return (counts >= min_pts) & valid
+        prec=prec,
+        x_items=x_items,
+        valid_items=valid_items,
+    )
 
 
-def _min_core_neighbor_label(x, valid, core, labels, eps_sq, block_q, block_i, prec):
+def _min_core_neighbor_label(x, valid, core, labels, eps_sq, block_q, block_i,
+                             prec, x_items=None, valid_items=None):
     """For every point, min label over its CORE eps-neighbors (incl. itself
-    when core). _INT_MAX where it has none."""
+    when core). _INT_MAX where it has none. ``core``/``labels`` describe
+    the ITEM set (= the query set in the single-device case)."""
     n = x.shape[0]
     labels_i, _ = _pad_rows(labels, block_i)
     core_i, _ = _pad_rows(core, block_i)
@@ -141,6 +158,8 @@ def _min_core_neighbor_label(x, valid, core, labels, eps_sq, block_q, block_i, p
         block_q=block_q,
         block_i=block_i,
         prec=prec,
+        x_items=x_items,
+        valid_items=valid_items,
     )[:n]
 
 
@@ -218,3 +237,112 @@ def relabel_consecutive(labels: np.ndarray) -> np.ndarray:
     rank[np.argsort(first_row, kind="stable")] = np.arange(reps.size)
     out[pos] = rank[inverse]
     return out
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_dbscan_fn(mesh, n_tot: int, n_loc: int, block_q: int,
+                       block_i: int, precision: str):
+    """Build (and cache) the jitted shard_map DBSCAN program for one
+    (mesh, shape, block, precision) combination — jit's cache is keyed on
+    the function object, so the closure must not be rebuilt per call (same
+    discipline as ops.knn._sharded_knn_fn). eps/min_pts are traced
+    arguments: a parameter sweep reuses one compiled program."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    prec = _dot_precision(precision)
+
+    def local(xq, vq, x_all, v_all, eps_sq, min_pts):
+        offset = lax.axis_index(DATA_AXIS) * n_loc
+
+        counts = _eps_neighbor_counts(
+            xq, vq, eps_sq, block_q, block_i, prec,
+            x_items=x_all, valid_items=v_all,
+        )[:n_loc]
+        core_loc = (counts >= min_pts) & vq
+        core = lax.all_gather(core_loc, DATA_AXIS).reshape(n_tot)
+
+        labels0 = jnp.where(core, jnp.arange(n_tot, dtype=jnp.int32), _INT_MAX)
+
+        def cond(state):
+            _, changed = state
+            return changed
+
+        def body(state):
+            labels, _ = state
+            neigh_loc = _min_core_neighbor_label(
+                xq, vq, core, labels, eps_sq, block_q, block_i, prec,
+                x_items=x_all, valid_items=v_all,
+            )
+            lab_loc = lax.dynamic_slice(labels, (offset,), (n_loc,))
+            new_loc = jnp.where(core_loc, jnp.minimum(lab_loc, neigh_loc), lab_loc)
+            new = lax.all_gather(new_loc, DATA_AXIS).reshape(n_tot)
+            # Pointer-jumping on the replicated vector (identical everywhere).
+            safe = jnp.clip(new, 0, n_tot - 1)
+            jumped = jnp.where(core, jnp.minimum(new, new[safe]), new)
+            return (jumped, jnp.any(jumped != labels))
+
+        labels, _ = lax.while_loop(cond, body, (labels0, jnp.asarray(True)))
+
+        neigh_loc = _min_core_neighbor_label(
+            xq, vq, core, labels, eps_sq, block_q, block_i, prec,
+            x_items=x_all, valid_items=v_all,
+        )
+        lab_loc = lax.dynamic_slice(labels, (offset,), (n_loc,))
+        border = (~core_loc) & (neigh_loc < _INT_MAX) & vq
+        lab_loc = jnp.where(border, neigh_loc, lab_loc)
+        lab_loc = jnp.where(lab_loc == _INT_MAX, -1, lab_loc)
+        lab_loc = jnp.where(vq, lab_loc, -1)
+        labels_out = lax.all_gather(lab_loc, DATA_AXIS).reshape(n_tot)
+        return labels_out, core
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(), P()),
+        out_specs=(P(), P()),
+        # all_gather results are identical on every device; replication
+        # holds but the vma checker cannot prove it (as in ops.knn).
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def dbscan_labels_sharded(
+    mesh,
+    x: np.ndarray,
+    eps: float,
+    min_pts: int,
+    block_q: int = 2048,
+    block_i: int = 8192,
+    precision: str = "highest",
+) -> Tuple[jax.Array, jax.Array]:
+    """Mesh DBSCAN: query rows shard over the data axis, the point set is
+    replicated (the epsilon sweeps are compute-bound at O(n^2 d); splitting
+    the query dimension divides that by the device count while the
+    all-gathered label vector — 4n bytes — rides ICI once per diffusion
+    round). Returns replicated (labels, core_mask), identical semantics to
+    :func:`dbscan_labels`.
+    """
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    x = np.asarray(x)
+    n, _ = x.shape
+    dp = mesh.shape[DATA_AXIS]
+    pad = (-n) % dp
+    xp = np.pad(x, ((0, pad), (0, 0)))
+    validp = np.zeros(n + pad, dtype=bool)
+    validp[:n] = True
+    n_tot = n + pad
+    fn = _sharded_dbscan_fn(mesh, n_tot, n_tot // dp, block_q, block_i, precision)
+    xj = jnp.asarray(xp)
+    labels, core = fn(
+        xj, jnp.asarray(validp), xj, jnp.asarray(validp),
+        jnp.asarray(eps, xj.dtype) ** 2, jnp.asarray(min_pts, jnp.int32),
+    )
+    return labels[:n], core[:n]

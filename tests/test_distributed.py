@@ -165,3 +165,19 @@ class TestDistributedKnnMetrics:
         d_s, i_s = m_single.kneighbors(q)
         np.testing.assert_array_equal(i_m, i_s)
         np.testing.assert_allclose(d_m, d_s, atol=1e-6)
+
+
+class TestDistributedDBSCAN:
+    def test_sharded_matches_single(self, rng, mesh_8x1):
+        from spark_rapids_ml_tpu.clustering import DBSCAN
+
+        # Three blobs + scattered noise; n not divisible by 8.
+        x = np.concatenate(
+            [rng.normal(size=(45, 3)) * 0.2 + c for c in ([0, 0, 0], [3, 3, 0], [0, 3, 3])]
+            + [rng.uniform(-2, 5, size=(10, 3))]
+        )
+        m_single = DBSCAN().setEps(0.7).setMinSamples(4).fit(x)
+        m_mesh = DBSCAN(mesh=mesh_8x1).setEps(0.7).setMinSamples(4).fit(x)
+        np.testing.assert_array_equal(m_single.labels_, m_mesh.labels_)
+        np.testing.assert_array_equal(m_single.core_mask_, m_mesh.core_mask_)
+        assert len(set(m_single.labels_[m_single.labels_ >= 0])) == 3
