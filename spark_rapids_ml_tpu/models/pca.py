@@ -21,9 +21,9 @@ from typing import Any, Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_partitions, extract_column
+from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, as_partitions, extract_column
 from spark_rapids_ml_tpu.core.estimator import Estimator, HasInputCol, HasOutputCol, Model
-from spark_rapids_ml_tpu.core.params import Param, gt, toBoolean, toInt
+from spark_rapids_ml_tpu.core.params import Param, gt, toBoolean, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
     MLReadable,
     get_and_set_params,
@@ -47,10 +47,16 @@ class _PCAParams(HasInputCol, HasOutputCol):
         "_", "useCuSolverSVD", "use the accelerated (XLA) eigensolver instead of host SVD", toBoolean
     )
     gpuId = Param("_", "gpuId", "accelerator chip ordinal; -1 = runtime-assigned", toInt)
+    solver = Param(
+        "_", "solver", "auto | covariance | randomized (wide-feature sketch)", toString
+    )
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
-        self._setDefault(meanCentering=True, useGemm=True, useCuSolverSVD=True, gpuId=-1)
+        self._setDefault(
+            meanCentering=True, useGemm=True, useCuSolverSVD=True, gpuId=-1,
+            solver="auto",
+        )
 
     def getK(self) -> int:
         return self.getOrDefault(self.k)
@@ -66,6 +72,9 @@ class _PCAParams(HasInputCol, HasOutputCol):
 
     def getGpuId(self) -> int:
         return self.getOrDefault(self.gpuId)
+
+    def getSolver(self) -> str:
+        return self.getOrDefault(self.solver)
 
 
 class PCA(_PCAParams, Estimator, MLReadable):
@@ -100,9 +109,37 @@ class PCA(_PCAParams, Estimator, MLReadable):
         self.mesh = mesh
         return self
 
+    def setSolver(self, value: str) -> "PCA":
+        if value not in ("auto", "covariance", "randomized"):
+            raise ValueError(
+                f"solver must be auto/covariance/randomized, got {value!r}"
+            )
+        self.set(self.solver, value)
+        return self
+
+    # Above this many features, "auto" switches to the randomized sketch:
+    # the (d, d) covariance + full eigh grow as d^2 / d^3 while the sketch
+    # stays O(n d l) with l = k + oversample.
+    _RANDOMIZED_AUTO_DIM = 4096
+
     def fit(self, dataset: Any) -> "PCAModel":
         """RapidsPCA.fit (RapidsPCA.scala:111-125)."""
         rows = extract_column(dataset, self.getInputCol())
+        solver = self.getSolver()
+        if solver == "randomized" and self.mesh is not None:
+            raise ValueError(
+                "the randomized solver is single-device; unset the mesh or "
+                "use solver='covariance' (mesh-distributed)"
+            )
+        # Feature count from the first partition only — the covariance path
+        # streams partitions, so 'auto' must not force a full densify.
+        n_features = as_partitions(rows)[0].shape[1]
+        if solver == "randomized" or (
+            solver == "auto"
+            and self.mesh is None
+            and n_features >= self._RANDOMIZED_AUTO_DIM
+        ):
+            return self._fit_randomized(rows)
         mat = RowMatrix(
             rows,
             mean_centering=self.getMeanCentering(),
@@ -113,6 +150,32 @@ class PCA(_PCAParams, Estimator, MLReadable):
         )
         pc, explained = mat.compute_principal_components_and_explained_variance(self.getK())
         model = PCAModel(self.uid, np.asarray(pc), np.asarray(explained))
+        return self._copyValues(model)
+
+    def _fit_randomized(self, rows) -> "PCAModel":
+        """Wide-feature path: subspace sketch, no (d, d) covariance."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.randomized import randomized_pca
+
+        x_host = as_matrix(rows)
+        n, d = x_host.shape
+        k = self.getK()
+        if not 1 <= k <= min(n, d):
+            raise ValueError(f"k must be in [1, {min(n, d)}], got {k}")
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        x = jnp.asarray(x_host, dtype=dtype)
+        # Fixed sketch seed: the fitted model must not depend on device
+        # placement (gpuId) or any other runtime assignment.
+        comps, ratio, _ = randomized_pca(
+            x, k, jax.random.key(0), center=self.getMeanCentering()
+        )
+        model = PCAModel(
+            self.uid,
+            np.asarray(comps, dtype=np.float64),
+            np.asarray(ratio, dtype=np.float64),
+        )
         return self._copyValues(model)
 
 class PCAModel(_PCAParams, Model):

@@ -1,0 +1,114 @@
+"""Randomized PCA — range-finder GEMMs for the wide-feature regime.
+
+The reference's scaling axis is the feature dimension n (SURVEY.md §5: its
+packed spr path caps at n <= 65535 columns, and the GEMM path requires the
+(d, d) covariance to fit on one device). The covariance route is O(n d^2)
+FLOPs and O(d^2) memory — at d ~ 10^5 the d x d Gram alone is 40 GB.
+Randomized subspace iteration (Halko-Martinsson-Tropp) sidesteps both: two
+streaming GEMM passes over X per power iteration with an (n, l) sketch,
+l = k + oversample << d, and a final small SVD.
+
+TPU-first details:
+  - Orthonormalization is Cholesky-QR2 — two (l, l) Gram matmuls + two
+    triangular solves — instead of Householder QR, which XLA would run as
+    a sequential panel algorithm. CQR2's second pass restores the
+    orthogonality CQR1 loses at fp32 (condition-squaring), and everything
+    is MXU work.
+  - Mean centering is FOLDED into the GEMMs (rank-one corrections), so the
+    centered matrix is never materialized.
+  - The total variance (denominator of explainedVariance) is exact — the
+    trace of the covariance from column moments — so the ratios match the
+    covariance path, not just the top-l approximation of it.
+  - Deterministic: fixed PRNG key, sign-flip on the components.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.ops.eigh import sign_flip
+from spark_rapids_ml_tpu.ops.linalg import _dot_precision
+
+
+def _chol_qr2(y: jax.Array, prec) -> jax.Array:
+    """Orthonormalize the columns of (n, l) via two Cholesky-QR passes."""
+    eps = jnp.finfo(y.dtype).eps
+
+    def once(y):
+        g = jnp.matmul(y.T, y, precision=prec)
+        # Tiny ridge: guards the Cholesky when the sketch is near-rank-
+        # deficient (e.g. data with fewer than l independent directions).
+        g = g + (eps * jnp.trace(g)) * jnp.eye(g.shape[0], dtype=y.dtype)
+        r = jnp.linalg.cholesky(g).T  # upper
+        return jax.scipy.linalg.solve_triangular(r.T, y.T, lower=True).T
+
+    return once(once(y))
+
+
+@partial(
+    jax.jit, static_argnames=("k", "oversample", "power_iters", "precision", "center")
+)
+def randomized_pca(
+    x: jax.Array,
+    k: int,
+    key: jax.Array,
+    oversample: int = 10,
+    power_iters: int = 2,
+    precision: str = "highest",
+    center: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k principal components without forming the covariance.
+
+    Returns (components (d, k), explained_variance_ratio (k,), mean (d,)).
+    ``power_iters`` subspace iterations sharpen the spectrum (q=2 is the
+    standard accuracy/cost point); each costs two GEMM passes over x.
+    ``center=False`` runs second-moment PCA (the meanCentering=False
+    semantics of the covariance path).
+    """
+    n, d = x.shape
+    if k > min(n, d):
+        raise ValueError(
+            f"randomized PCA needs k <= min(n_rows, n_features) = "
+            f"{min(n, d)}, got k={k}"
+        )
+    l = min(k + oversample, d, n)
+    prec = _dot_precision(precision)
+    dtype = x.dtype
+
+    mean = jnp.mean(x, axis=0) if center else jnp.zeros((d,), dtype)
+
+    def center_matmul(v):  # Xc @ v without materializing Xc
+        return jnp.matmul(x, v, precision=prec) - jnp.outer(
+            jnp.ones((n,), dtype), mean @ v
+        )
+
+    def center_rmatmul(u):  # Xc^T @ u
+        return jnp.matmul(x.T, u, precision=prec) - jnp.outer(
+            mean, jnp.sum(u, axis=0)
+        )
+
+    omega = jax.random.normal(key, (d, l), dtype=dtype)
+    y = center_matmul(omega)  # (n, l)
+    q = _chol_qr2(y, prec)
+    for _ in range(power_iters):  # static unroll; q small
+        z = _chol_qr2(center_rmatmul(q), prec)  # (d, l)
+        q = _chol_qr2(center_matmul(z), prec)
+
+    b = center_rmatmul(q).T  # (l, d): Q^T Xc
+    # SVD of the small projected matrix: right singular vectors approximate
+    # the top principal directions.
+    _, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    comps = sign_flip(vt[:k].T)  # (d, k)
+
+    # Exact total variance from a centered two-pass trace (the
+    # explainedVariance denominator must cover ALL directions, not just the
+    # sketched l). E[x^2] - mean^2 would cancel catastrophically in fp32
+    # for large-offset features; the centered sum does not.
+    total_var = jnp.sum((x - mean) ** 2) / jnp.maximum(n - 1, 1)
+    explained = (s[:k] ** 2) / jnp.maximum(n - 1, 1)
+    ratio = explained / jnp.maximum(total_var, jnp.finfo(dtype).tiny)
+    return comps, ratio, mean

@@ -236,3 +236,97 @@ class TestTransform:
         whole = model.transform(x)
         parts = model.transform([x[:15], x[15:]])
         np.testing.assert_allclose(whole, parts, atol=1e-10)
+
+
+class TestRandomizedSolver:
+    """Randomized (sketch) PCA must agree with the covariance path on the
+    dominant subspace and the explained-variance ratios."""
+
+    def test_matches_covariance_path(self, rng):
+        from spark_rapids_ml_tpu.feature import PCA
+
+        # Strong spectral decay so the sketch captures the subspace exactly.
+        n, d, k = 500, 60, 5
+        basis, _ = np.linalg.qr(rng.normal(size=(d, d)))
+        scales = np.concatenate([[20, 15, 10, 6, 4], np.full(d - 5, 0.3)])
+        x = rng.normal(size=(n, d)) @ (basis * scales).T
+
+        full = PCA().setK(k).setSolver("covariance").fit(x)
+        rand = PCA().setK(k).setSolver("randomized").fit(x)
+        # Component-wise agreement up to sign (both sign-flip, so exact).
+        for j in range(k):
+            dot = abs(np.dot(full.pc[:, j], rand.pc[:, j]))
+            assert dot > 0.999, (j, dot)
+        np.testing.assert_allclose(
+            rand.explainedVariance, full.explainedVariance, rtol=1e-3
+        )
+
+    def test_auto_routes_wide_features(self, rng):
+        from spark_rapids_ml_tpu.feature import PCA
+
+        # d >= the auto threshold: fit must succeed quickly without the
+        # (d, d) eigh (n tiny, so the covariance would be rank-deficient
+        # anyway — the sketch handles that via the CQR ridge).
+        n, d = 300, 4096
+        x = rng.normal(size=(n, d))
+        model = PCA().setK(3).fit(x)
+        assert model.pc.shape == (d, 3)
+        assert np.all(np.isfinite(model.pc))
+        assert float(np.sum(model.explainedVariance)) <= 1.0 + 1e-6
+
+    def test_determinism(self, rng):
+        from spark_rapids_ml_tpu.feature import PCA
+
+        x = rng.normal(size=(200, 40))
+        a = PCA().setK(4).setSolver("randomized").fit(x)
+        b = PCA().setK(4).setSolver("randomized").fit(x)
+        np.testing.assert_array_equal(a.pc, b.pc)
+
+    def test_uncentered_variant(self, rng):
+        from spark_rapids_ml_tpu.feature import PCA
+
+        x = rng.normal(size=(300, 30)) + 5.0  # large mean
+        cov = PCA().setK(3).setSolver("covariance").setMeanCentering(False).fit(x)
+        rnd = PCA().setK(3).setSolver("randomized").setMeanCentering(False).fit(x)
+        # Without centering the mean direction dominates; both paths must
+        # agree on it.
+        dot = abs(np.dot(cov.pc[:, 0], rnd.pc[:, 0]))
+        assert dot > 0.999
+
+    def test_solver_validation(self):
+        from spark_rapids_ml_tpu.feature import PCA
+
+        with pytest.raises(ValueError):
+            PCA().setSolver("lanczos")
+
+    def test_k_exceeds_rank_raises(self, rng):
+        from spark_rapids_ml_tpu.feature import PCA
+
+        x = rng.normal(size=(8, 50))
+        with pytest.raises(ValueError, match="k must be in"):
+            PCA().setK(10).setSolver("randomized").fit(x)
+
+    def test_large_offset_total_variance(self, rng):
+        from spark_rapids_ml_tpu.feature import PCA
+
+        # Means ~1e4, std ~1: the ratio denominator must come from the
+        # centered trace, not E[x^2] - mean^2 (fp32 cancellation).
+        x = rng.normal(size=(300, 20)) + 1e4
+        full = PCA().setK(3).setSolver("covariance").fit(x)
+        rand = PCA().setK(3).setSolver("randomized").fit(x)
+        # Flat spectra make the sketched singular values a slight
+        # underestimate (~2%); the cancellation bug this guards against
+        # produced order-of-magnitude-wrong or negative ratios.
+        np.testing.assert_allclose(
+            rand.explainedVariance, full.explainedVariance, rtol=5e-2
+        )
+        assert np.all(rand.explainedVariance > 0)
+        assert float(np.sum(rand.explainedVariance)) <= 1.0
+
+    def test_mesh_rejects_randomized(self, rng):
+        from spark_rapids_ml_tpu.feature import PCA
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        x = rng.normal(size=(40, 8))
+        with pytest.raises(ValueError, match="single-device"):
+            PCA(mesh=make_mesh((8, 1))).setK(2).setSolver("randomized").fit(x)
