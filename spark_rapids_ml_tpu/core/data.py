@@ -272,3 +272,18 @@ def extract_weights(dataset: Any, weight_col: Optional[str]) -> Optional[np.ndar
     if not np.any(w > 0):
         raise ValueError("at least one weight must be positive")
     return w
+
+
+def num_features(data: Any) -> int:
+    """Feature count by PEEKING at the first partition/row only — never
+    densifies the dataset (used for cheap routing decisions)."""
+    if isinstance(data, np.ndarray):
+        return data.shape[1] if data.ndim == 2 else data.shape[0]
+    if _sp is not None and _sp.issparse(data):
+        return data.shape[1]
+    if isinstance(data, (list, tuple)) and data:
+        first = data[0]
+        if _is_block(first):
+            return first.shape[1]
+        return len(_row_to_array(first))
+    return as_partitions(data)[0].shape[1]

@@ -21,7 +21,13 @@ from typing import Any, Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, as_partitions, extract_column
+from spark_rapids_ml_tpu.core.data import (
+    DataFrame,
+    as_matrix,
+    as_partitions,
+    extract_column,
+    num_features,
+)
 from spark_rapids_ml_tpu.core.estimator import Estimator, HasInputCol, HasOutputCol, Model
 from spark_rapids_ml_tpu.core.params import Param, gt, toBoolean, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -131,13 +137,12 @@ class PCA(_PCAParams, Estimator, MLReadable):
                 "the randomized solver is single-device; unset the mesh or "
                 "use solver='covariance' (mesh-distributed)"
             )
-        # Feature count from the first partition only — the covariance path
-        # streams partitions, so 'auto' must not force a full densify.
-        n_features = as_partitions(rows)[0].shape[1]
+        # 'auto' peeks at the first partition/row only — the covariance
+        # path streams partitions, so routing must not force a densify.
         if solver == "randomized" or (
             solver == "auto"
             and self.mesh is None
-            and n_features >= self._RANDOMIZED_AUTO_DIM
+            and num_features(rows) >= self._RANDOMIZED_AUTO_DIM
         ):
             return self._fit_randomized(rows)
         mat = RowMatrix(
@@ -165,9 +170,12 @@ class PCA(_PCAParams, Estimator, MLReadable):
         if not 1 <= k <= min(n, d):
             raise ValueError(f"k must be in [1, {min(n, d)}], got {k}")
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        x = jnp.asarray(x_host, dtype=dtype)
-        # Fixed sketch seed: the fitted model must not depend on device
-        # placement (gpuId) or any other runtime assignment.
+        # Honor the chip-ordinal param the way the covariance path does
+        # (RowMatrix._device); the sketch SEED stays fixed so the fitted
+        # model never depends on placement.
+        gpu_id = self.getGpuId()
+        device = jax.devices()[gpu_id] if gpu_id >= 0 else jax.devices()[0]
+        x = jax.device_put(jnp.asarray(x_host, dtype=dtype), device)
         comps, ratio, _ = randomized_pca(
             x, k, jax.random.key(0), center=self.getMeanCentering()
         )

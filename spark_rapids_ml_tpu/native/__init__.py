@@ -92,6 +92,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.tpuml_trace_push.argtypes = [ctypes.c_char_p]
     lib.tpuml_trace_pop.argtypes = []
+    try:
+        _bind_npy(lib)
+        lib._tpuml_has_npy = True
+    except AttributeError:  # stale library predating the npy loader
+        lib._tpuml_has_npy = False
     return lib
 
 
@@ -247,3 +252,95 @@ def trace_pop() -> None:
     lib = get_lib()
     if lib is not None:
         lib.tpuml_trace_pop()
+
+
+class NpyBlockReader:
+    """Streaming block reader over a ``.npy`` file — the native data loader.
+
+    The mmap + madvise readahead lives in C++ (``tpuml_npy_*``): the OS page
+    cache double-buffers, :meth:`iter_blocks` warms the NEXT block while
+    yielding the current one, and each read is one memcpy out of the
+    mapping. Blocks are plain ``(rows, d)`` ndarrays, so a reader feeds any
+    estimator as the list-of-partitions (RDD-analogue) input:
+
+        reader = NpyBlockReader("data.npy", block_rows=1 << 20)
+        PCA().setK(8).fit(list(reader.iter_blocks()))
+    """
+
+    def __init__(self, path: str, block_rows: int = 1 << 20):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable (no toolchain?)")
+        if not getattr(lib, "_tpuml_has_npy", False):
+            raise RuntimeError(
+                "native library predates the npy loader; rebuild via "
+                "`make -C native` (or delete the stale .so)"
+            )
+        self._lib = lib
+        self._handle = lib.tpuml_npy_open(path.encode())
+        if not self._handle:
+            raise ValueError(
+                f"cannot open {path!r}: not a C-order float32/float64 .npy"
+            )
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        dtype = ctypes.c_int32()
+        lib.tpuml_npy_info(
+            self._handle, ctypes.byref(rows), ctypes.byref(cols), ctypes.byref(dtype)
+        )
+        self.shape = (rows.value, cols.value)
+        self.dtype = np.float32 if dtype.value == 0 else np.float64
+        self.block_rows = int(block_rows)
+
+    def read_block(self, start: int, n_rows: int) -> np.ndarray:
+        n_rows = min(n_rows, self.shape[0] - start)
+        out = np.empty((n_rows, self.shape[1]), dtype=self.dtype)
+        rc = self._lib.tpuml_npy_read_block(
+            self._handle, start, n_rows, out.ctypes.data_as(ctypes.c_void_p)
+        )
+        if rc != 0:
+            raise ValueError(f"read_block({start}, {n_rows}) failed: {rc}")
+        return out
+
+    def iter_blocks(self):
+        n = self.shape[0]
+        b = self.block_rows
+        for start in range(0, n, b):
+            if start + b < n:  # warm the next block while this one is used
+                self._lib.tpuml_npy_prefetch(self._handle, start + b, b)
+            yield self.read_block(start, b)
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.tpuml_npy_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "NpyBlockReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _bind_npy(lib: ctypes.CDLL) -> None:
+    i32, i64 = ctypes.c_int32, ctypes.c_int64
+    lib.tpuml_npy_open.restype = ctypes.c_void_p
+    lib.tpuml_npy_open.argtypes = [ctypes.c_char_p]
+    lib.tpuml_npy_info.restype = i32
+    lib.tpuml_npy_info.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(i64),
+        ctypes.POINTER(i64),
+        ctypes.POINTER(i32),
+    ]
+    lib.tpuml_npy_prefetch.restype = i32
+    lib.tpuml_npy_prefetch.argtypes = [ctypes.c_void_p, i64, i64]
+    lib.tpuml_npy_read_block.restype = i32
+    lib.tpuml_npy_read_block.argtypes = [ctypes.c_void_p, i64, i64, ctypes.c_void_p]
+    lib.tpuml_npy_close.argtypes = [ctypes.c_void_p]

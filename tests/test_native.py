@@ -113,3 +113,68 @@ def test_trace_push_pop_no_crash():
     native.trace_push("native range")
     native.trace_pop()
     native.trace_pop()  # underflow is a no-op, not a crash
+
+
+class TestNpyBlockReader:
+    def test_roundtrip_f32_and_f64(self, tmp_path, rng):
+        from spark_rapids_ml_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        for dtype in (np.float32, np.float64):
+            x = rng.normal(size=(1003, 7)).astype(dtype)
+            path = str(tmp_path / f"x_{dtype.__name__}.npy")
+            np.save(path, x)
+            with native.NpyBlockReader(path, block_rows=256) as r:
+                assert r.shape == (1003, 7)
+                assert r.dtype == dtype
+                blocks = list(r.iter_blocks())
+            assert [b.shape[0] for b in blocks] == [256, 256, 256, 235]
+            np.testing.assert_array_equal(np.concatenate(blocks), x)
+
+    def test_feeds_estimator_as_partitions(self, tmp_path, rng):
+        from spark_rapids_ml_tpu import native
+        from spark_rapids_ml_tpu.feature import PCA
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        x = rng.normal(size=(600, 12))
+        path = str(tmp_path / "x.npy")
+        np.save(path, x)
+        with native.NpyBlockReader(path, block_rows=200) as r:
+            model = PCA().setK(3).fit(list(r.iter_blocks()))
+        ref = PCA().setK(3).fit(x)
+        np.testing.assert_allclose(model.pc, ref.pc, atol=1e-8)
+
+    def test_1d_file(self, tmp_path, rng):
+        from spark_rapids_ml_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        v = rng.normal(size=500).astype(np.float64)
+        path = str(tmp_path / "v.npy")
+        np.save(path, v)
+        with native.NpyBlockReader(path) as r:
+            assert r.shape == (500, 1)
+            np.testing.assert_array_equal(
+                np.concatenate(list(r.iter_blocks())).ravel(), v
+            )
+
+    def test_rejects_bad_inputs(self, tmp_path, rng):
+        from spark_rapids_ml_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        with pytest.raises(ValueError):
+            native.NpyBlockReader(str(tmp_path / "missing.npy"))
+        # Fortran-order and unsupported dtypes must be refused.
+        xf = np.asfortranarray(rng.normal(size=(10, 4)))
+        pf = str(tmp_path / "f.npy")
+        np.save(pf, xf)
+        with pytest.raises(ValueError):
+            native.NpyBlockReader(pf)
+        xi = rng.integers(0, 5, size=(10, 4))
+        pi = str(tmp_path / "i.npy")
+        np.save(pi, xi)
+        with pytest.raises(ValueError):
+            native.NpyBlockReader(pi)
