@@ -40,12 +40,12 @@ from spark_rapids_ml_tpu.core.persistence import (
 from spark_rapids_ml_tpu.ops.ann import (
     IVFIndex,
     IVFPQIndex,
+    ann_search_sharded,
     build_ivf_index,
     build_ivfpq_index,
-    ivf_search,
-    ivfpq_search,
+    dispatch_search,
 )
-from spark_rapids_ml_tpu.ops.knn import knn
+from spark_rapids_ml_tpu.ops.knn import knn, knn_sharded, shard_items
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 _ALGORITHMS = ("ivfflat", "ivfpq", "brute")
@@ -136,8 +136,13 @@ class ApproximateNearestNeighbors(_ANNParams, Estimator, MLReadable):
     """``ApproximateNearestNeighbors().setK(8).setAlgoParams({"nlist": 64,
     "nprobe": 8}).fit(items).kneighbors(queries)``."""
 
-    def __init__(self, uid: Optional[str] = None):
+    def __init__(self, uid: Optional[str] = None, mesh=None):
         super().__init__(uid)
+        self.mesh = mesh
+
+    def setMesh(self, mesh) -> "ApproximateNearestNeighbors":
+        self.mesh = mesh
+        return self
 
     def setK(self, value: int) -> "ApproximateNearestNeighbors":
         self.set(self.k, value)
@@ -206,7 +211,9 @@ class ApproximateNearestNeighbors(_ANNParams, Estimator, MLReadable):
                     )
         if self.getK() > items.shape[0]:
             raise ValueError(f"k={self.getK()} exceeds item count {items.shape[0]}")
-        model = ApproximateNearestNeighborsModel(self.uid, np.asarray(items), ids)
+        model = ApproximateNearestNeighborsModel(
+            self.uid, np.asarray(items), ids, mesh=self.mesh
+        )
         model = self._copyValues(model)
         if model.getAlgorithm() in ("ivfflat", "ivfpq"):
             with TraceRange("ann build index", TraceColor.YELLOW):
@@ -215,19 +222,30 @@ class ApproximateNearestNeighbors(_ANNParams, Estimator, MLReadable):
 
 
 class ApproximateNearestNeighborsModel(_ANNParams, Model):
-    """Indexed item set; ``kneighbors`` probes the IVF lists."""
+    """Indexed item set; ``kneighbors`` probes the IVF lists.
+
+    With a mesh, queries shard over the data axis against the replicated
+    index (:func:`ops.ann.ann_search_sharded`)."""
 
     def __init__(
         self,
         uid: Optional[str] = None,
         items: Optional[np.ndarray] = None,
         ids: Optional[np.ndarray] = None,
+        mesh=None,
     ):
         super().__init__(uid)
+        self.mesh = mesh
         self.items = None if items is None else np.asarray(items)
         self.ids = None if ids is None else np.asarray(ids)
         self._index: Optional[IVFIndex | IVFPQIndex] = None
         self._items_dev = None  # cached device copy of _search_items()
+        self._sharded_brute = None  # cached (items_sharded, mask) for brute+mesh
+
+    def setMesh(self, mesh) -> "ApproximateNearestNeighborsModel":
+        self.mesh = mesh
+        self._sharded_brute = None
+        return self
 
     def _effective_nlist(self) -> int:
         n = self.items.shape[0]
@@ -310,14 +328,42 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
             if self.getAlgorithm() == "brute":
                 # knn's sqeuclidean output matches ivf_search's; the shared
                 # metric post-processing below then applies to both paths.
-                d2_j, idx = knn(
-                    jnp.asarray(q), self._search_items_device(), k=k,
-                    metric="sqeuclidean",
-                )
+                if self.mesh is not None:
+                    # Items shard over the mesh (memory / device count),
+                    # exactly as NearestNeighborsModel does.
+                    if self._sharded_brute is None:
+                        self._sharded_brute = shard_items(
+                            self._search_items(), self.mesh
+                        )
+                    xs, mask = self._sharded_brute
+                    d2_j, idx = knn_sharded(
+                        jnp.asarray(q, dtype=xs.dtype), xs, mask, self.mesh,
+                        k=k,
+                    )
+                else:
+                    d2_j, idx = knn(
+                        jnp.asarray(q), self._search_items_device(), k=k,
+                        metric="sqeuclidean",
+                    )
                 d2 = np.asarray(d2_j)
             else:
                 if self._index is None:
                     self._build_index()
+                n_probe = self._effective_nprobe(self._index.n_lists)
+
+                def _fetch(k_fetch: int):
+                    if self.mesh is not None:
+                        # Queries shard over the mesh against the
+                        # replicated index; results are per-query, so no
+                        # cross-device merge is needed.
+                        return ann_search_sharded(
+                            self.mesh, self._index, jnp.asarray(q),
+                            k=k_fetch, n_probe=n_probe,
+                        )
+                    return dispatch_search(self._index)(
+                        self._index, jnp.asarray(q), k=k_fetch, n_probe=n_probe
+                    )
+
                 if isinstance(self._index, IVFPQIndex):
                     # Refine (FAISS IndexRefineFlat / cuML refine_ratio):
                     # over-fetch candidates under the quantized metric, then
@@ -326,10 +372,7 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
                     # distance computations per query.
                     ratio = int(self.getAlgoParams().get("refine_ratio", 1))
                     k_fetch = min(max(k * max(ratio, 1), k), self.items.shape[0])
-                    d2_j, idx_j = ivfpq_search(
-                        self._index, jnp.asarray(q), k=k_fetch,
-                        n_probe=self._effective_nprobe(self._index.n_lists),
-                    )
+                    d2_j, idx_j = _fetch(k_fetch)
                     if k_fetch > k:
                         d2_j, idx_j = _refine_exact(
                             jnp.asarray(q),
@@ -339,10 +382,7 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
                         )
                     d2, idx = np.asarray(d2_j), np.asarray(idx_j)
                 else:
-                    d2_j, idx_j = ivf_search(
-                        self._index, jnp.asarray(q), k=k,
-                        n_probe=self._effective_nprobe(self._index.n_lists),
-                    )
+                    d2_j, idx_j = _fetch(k)
                     d2, idx = np.asarray(d2_j), np.asarray(idx_j)
 
         idx = np.asarray(idx)

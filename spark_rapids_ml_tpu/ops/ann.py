@@ -33,6 +33,7 @@ analogue of cuML's shared-memory LUT walk.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import NamedTuple, Tuple
 
@@ -305,3 +306,71 @@ def ivfpq_search(
         return d2
 
     return _probe_scaffold(index, queries, k, n_probe, block_q, prec, list_d2)
+
+
+def dispatch_search(index):
+    """The one home of the index-type -> search-kernel dispatch."""
+    return ivfpq_search if isinstance(index, IVFPQIndex) else ivf_search
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_ann_fn(mesh, is_pq: bool, n_fields: int, k: int, n_probe: int,
+                    block_q: int, precision: str):
+    """Build (and cache) the jitted shard_map search for one configuration —
+    jit's cache is keyed on the function object, so the closure must not be
+    rebuilt per call (same discipline as ops.knn._sharded_knn_fn)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    search = ivfpq_search if is_pq else ivf_search
+    index_cls = IVFPQIndex if is_pq else IVFIndex
+
+    def local(q, *fields):
+        return search(
+            index_cls(*fields), q, k=k, n_probe=n_probe, block_q=block_q,
+            precision=precision,
+        )
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS),) + (P(),) * n_fields,
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def ann_search_sharded(
+    mesh,
+    index,
+    queries: jax.Array,
+    k: int,
+    n_probe: int,
+    block_q: int = 1024,
+    precision: str = "highest",
+) -> Tuple[jax.Array, jax.Array]:
+    """Mesh ANN search: QUERIES shard over the data axis, the index is
+    replicated — each device probes its query shard independently (per-query
+    results need no cross-device merge), dividing search compute by the
+    device count. Works for both IVF-Flat and IVF-PQ indexes.
+
+    (The complementary layout — lists sharded, queries replicated — would
+    divide index MEMORY instead but leave every device doing the full probe
+    compute; query sharding is the right default for the search-throughput
+    regime the estimator serves.)
+    """
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    dp = mesh.shape[DATA_AXIS]
+    nq = queries.shape[0]
+    pad = (-nq) % dp
+    qp = jnp.pad(queries, ((0, pad), (0, 0)))
+    fn = _sharded_ann_fn(
+        mesh, isinstance(index, IVFPQIndex), len(index), k, n_probe, block_q,
+        precision,
+    )
+    d2, ids = fn(qp, *index)
+    return d2[:nq], ids[:nq]

@@ -181,3 +181,69 @@ class TestDistributedDBSCAN:
         np.testing.assert_array_equal(m_single.labels_, m_mesh.labels_)
         np.testing.assert_array_equal(m_single.core_mask_, m_mesh.core_mask_)
         assert len(set(m_single.labels_[m_single.labels_ >= 0])) == 3
+
+
+class TestDistributedANN:
+    def test_sharded_search_matches_single(self, rng, mesh_8x1):
+        from spark_rapids_ml_tpu.neighbors import ApproximateNearestNeighbors
+
+        items = rng.normal(size=(300, 10))
+        queries = rng.normal(size=(21, 10))  # deliberately not divisible by 8
+        m = (
+            ApproximateNearestNeighbors()
+            .setAlgorithm("ivfflat")
+            .setAlgoParams({"nlist": 8, "nprobe": 8})
+            .setK(5)
+            .setSeed(0)
+            .fit(items)
+        )
+        d_single, i_single = m.kneighbors(queries)
+        m.setMesh(mesh_8x1)
+        d_mesh, i_mesh = m.kneighbors(queries)
+        np.testing.assert_array_equal(i_single, i_mesh)
+        np.testing.assert_allclose(d_single, d_mesh, atol=1e-6)
+
+    def test_sharded_ivfpq_with_refine(self, rng, mesh_8x1):
+        from spark_rapids_ml_tpu.neighbors import ApproximateNearestNeighbors
+
+        items = rng.normal(size=(240, 8))
+        queries = rng.normal(size=(13, 8))
+        kwargs = dict(
+            algorithm="ivfpq",
+            algoParams={"nlist": 6, "nprobe": 6, "M": 4, "n_bits": 6,
+                        "refine_ratio": 4},
+            k=5, seed=1,
+        )
+        m = ApproximateNearestNeighbors()._set(**kwargs).fit(items)
+        d_single, i_single = m.kneighbors(queries)
+        m.setMesh(mesh_8x1)
+        d_mesh, i_mesh = m.kneighbors(queries)
+        np.testing.assert_array_equal(i_single, i_mesh)
+        np.testing.assert_allclose(d_single, d_mesh, atol=1e-6)
+
+    def test_sharded_brute_matches_single(self, rng, mesh_8x1):
+        from spark_rapids_ml_tpu.neighbors import ApproximateNearestNeighbors
+
+        items = rng.normal(size=(150, 6))
+        queries = rng.normal(size=(9, 6))
+        m = ApproximateNearestNeighbors().setAlgorithm("brute").setK(4).fit(items)
+        d_single, i_single = m.kneighbors(queries)
+        m.setMesh(mesh_8x1)
+        d_mesh, i_mesh = m.kneighbors(queries)
+        np.testing.assert_array_equal(i_single, i_mesh)
+        np.testing.assert_allclose(d_single, d_mesh, atol=1e-6)
+
+    def test_estimator_mesh_propagates(self, rng, mesh_8x1):
+        from spark_rapids_ml_tpu.neighbors import ApproximateNearestNeighbors
+
+        items = rng.normal(size=(100, 5))
+        m = (
+            ApproximateNearestNeighbors(mesh=mesh_8x1)
+            .setAlgorithm("ivfflat")
+            .setAlgoParams({"nlist": 4, "nprobe": 4})
+            .setK(3)
+            .fit(items)
+        )
+        assert m.mesh is mesh_8x1
+        d, i = m.kneighbors(rng.normal(size=(7, 5)))
+        assert d.shape == (7, 3)
