@@ -30,6 +30,7 @@ from spark_rapids_ml_tpu.core.persistence import (
 )
 from spark_rapids_ml_tpu.ops.linear import (
     normal_eq_stats,
+    normal_eq_stats_streaming,
     predict_linear,
     regression_metrics,
     solve_elastic_net,
@@ -162,6 +163,23 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
                 "solver='normal' supports only L2 (elasticNetParam must "
                 "be 0); use solver='auto' for elastic net"
             )
+        streaming = None
+        if self.mesh is None and self.getWeightCol() is None:
+            streaming = _streaming_blocks(dataset)
+        if streaming is not None:
+            # Blocks (list or generator of (rows_i, d) arrays) accumulate
+            # their sufficient statistics one block at a time — every solver
+            # below consumes only the O(d^2) moments, so device memory is
+            # bounded by one block (pairs with native.NpyBlockReader).
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            with TraceRange("linreg fit", TraceColor.DARK_GREEN):
+                stats = normal_eq_stats_streaming(streaming, dtype=dtype)
+                coef, intercept = self._solve_from_stats(stats, stats[0].shape[0])
+            model = LinearRegressionModel(
+                self.uid, np.asarray(coef, dtype=np.float64), float(intercept)
+            )
+            return self._copyValues(model)
+
         x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         w_host = extract_weights(dataset, self.getWeightCol())
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -182,42 +200,101 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
             if w_host is not None:
                 # The row mask doubles as the per-row weight (padding = 0).
                 mask = weights_as_mask(w_host, xs.shape[0], np.dtype(dtype), self.mesh)
-            xtx, xty, x_sum, y_sum, yty, count = normal_eq_stats(xs, ys, mask)
-            d = x_host.shape[1]
-            enet = self.getElasticNetParam()
-            if enet == 0.0 or self.getRegParam() == 0.0:
-                # Zero effective penalty: the exact (Cholesky) solve, not a
-                # fixed-step proximal approximation of the same objective.
-                coef, intercept = solve_normal(
-                    xtx[:d, :d],
-                    xty[:d],
-                    x_sum[:d],
-                    y_sum,
-                    count,
-                    reg_param=self.getRegParam(),
-                    fit_intercept=self.getFitIntercept(),
-                    standardization=self.getStandardization(),
-                )
-            else:
-                # L1/elastic net: FISTA on the same sufficient statistics —
-                # one data GEMM pass, then O(d^2) proximal iterations
-                # (Spark reaches this case via OWL-QN over the data).
-                coef, intercept, _ = solve_elastic_net(
-                    xtx[:d, :d],
-                    xty[:d],
-                    x_sum[:d],
-                    y_sum,
-                    count,
-                    reg_param=self.getRegParam(),
-                    elastic_net_param=enet,
-                    fit_intercept=self.getFitIntercept(),
-                    standardization=self.getStandardization(),
-                )
+            stats = normal_eq_stats(xs, ys, mask)
+            coef, intercept = self._solve_from_stats(stats, x_host.shape[1])
 
         model = LinearRegressionModel(
             self.uid, np.asarray(coef, dtype=np.float64), float(intercept)
         )
         return self._copyValues(model)
+
+    def _solve_from_stats(self, stats, d: int):
+        """Dispatch the solver on the accumulated sufficient statistics —
+        the one home of the exact-vs-proximal routing (shared by the
+        in-memory, mesh, and streaming fit paths)."""
+        xtx, xty, x_sum, y_sum, yty, count = stats
+        enet = self.getElasticNetParam()
+        if enet == 0.0 or self.getRegParam() == 0.0:
+            # Zero effective penalty: the exact (Cholesky) solve, not a
+            # fixed-step proximal approximation of the same objective.
+            return solve_normal(
+                xtx[:d, :d],
+                xty[:d],
+                x_sum[:d],
+                y_sum,
+                count,
+                reg_param=self.getRegParam(),
+                fit_intercept=self.getFitIntercept(),
+                standardization=self.getStandardization(),
+            )
+        # L1/elastic net: FISTA on the same sufficient statistics — one
+        # data GEMM pass, then O(d^2) proximal iterations (Spark reaches
+        # this case via OWL-QN over the data).
+        coef, intercept, _ = solve_elastic_net(
+            xtx[:d, :d],
+            xty[:d],
+            x_sum[:d],
+            y_sum,
+            count,
+            reg_param=self.getRegParam(),
+            elastic_net_param=enet,
+            fit_intercept=self.getFitIntercept(),
+            standardization=self.getStandardization(),
+        )
+        return coef, intercept
+
+
+def _streaming_blocks(dataset):
+    """Detect the streaming input form: ``(X, y)`` where X is a list of 2-D
+    blocks (dense or scipy-sparse) or any iterator of them (e.g.
+    ``NpyBlockReader.iter_blocks()``). Returns an iterator of
+    (X_block, y_block) pairs, or None when the input is not block-shaped.
+
+    A single y array is sliced along the block boundaries and must match
+    the total row count exactly; a list of per-block label arrays must have
+    one entry per block — both mismatches raise instead of silently
+    truncating.
+    """
+    from collections.abc import Iterator
+
+    from spark_rapids_ml_tpu.core.data import _block_to_dense, _is_block
+
+    if not (isinstance(dataset, tuple) and len(dataset) == 2):
+        return None
+    x, y = dataset
+    if isinstance(x, (list, tuple)) and x and _is_block(x[0]):
+        blocks = iter(x)
+    elif isinstance(x, Iterator):
+        blocks = x
+    else:
+        return None
+
+    def pairs():
+        if isinstance(y, (list, tuple)):
+            sentinel = object()
+            from itertools import zip_longest
+
+            for xb, yb in zip_longest(blocks, y, fillvalue=sentinel):
+                if xb is sentinel or yb is sentinel:
+                    raise ValueError(
+                        "streaming fit: X blocks and per-block label lists "
+                        "have different lengths"
+                    )
+                yield _block_to_dense(xb), yb
+            return
+        y_arr = np.asarray(y).ravel()
+        start = 0
+        for xb in blocks:
+            xb = _block_to_dense(xb)
+            yield xb, y_arr[start : start + xb.shape[0]]
+            start += xb.shape[0]
+        if start != y_arr.shape[0]:
+            raise ValueError(
+                f"streaming fit: blocks supplied {start} rows but y has "
+                f"{y_arr.shape[0]}"
+            )
+
+    return pairs()
 
 
 def _extract_xy(dataset: Any, features_col: str, label_col: str):

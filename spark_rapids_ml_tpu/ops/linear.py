@@ -196,3 +196,40 @@ def solve_elastic_net(
     coef, _, _, n_iter, _ = jax.lax.while_loop(cond, body, init)
     intercept = jnp.where(fit_intercept, y_mean - jnp.dot(x_mean, coef), 0.0)
     return coef, intercept, n_iter
+
+
+def normal_eq_stats_streaming(block_pairs, dtype=None, precision: str = "highest"):
+    """Accumulate the sufficient statistics over an ITERABLE of (X, y)
+    blocks — the streaming form of :func:`normal_eq_stats`.
+
+    Every downstream solver (normal equations, ridge, elastic-net FISTA)
+    consumes only these O(d^2) moments, so a dataset of any length fits in
+    one block of device memory at a time. Blocks may come from a generator
+    (e.g. ``native.NpyBlockReader.iter_blocks``) and are consumed lazily —
+    nothing is concatenated on the host.
+
+    Returns the same (xtx, xty, x_sum, y_sum, yty, count) tuple.
+    """
+    import numpy as np
+
+    acc = None
+    d = None
+    for xb, yb in block_pairs:
+        xj = jnp.asarray(np.ascontiguousarray(xb), dtype=dtype)
+        yj = jnp.asarray(np.ascontiguousarray(yb), dtype=dtype)
+        if d is None:
+            d = xj.shape[1]
+        elif xj.shape[1] != d:
+            raise ValueError(
+                f"inconsistent feature dims across blocks: {xj.shape[1]} vs {d}"
+            )
+        if xj.shape[0] != yj.shape[0]:
+            raise ValueError(
+                f"block rows mismatch: X has {xj.shape[0]}, y has {yj.shape[0]}"
+            )
+        mask = jnp.ones(xj.shape[0], dtype=xj.dtype)
+        stats = normal_eq_stats(xj, yj, mask, precision=precision)
+        acc = stats if acc is None else tuple(a + s for a, s in zip(acc, stats))
+    if acc is None:
+        raise ValueError("no blocks to accumulate")
+    return acc

@@ -170,3 +170,102 @@ class TestReviewRegressions:
         var = x.var(axis=0, ddof=1)
         ref = np.linalg.solve(x.T @ x + n * reg * np.diag(var), x.T @ y)
         np.testing.assert_allclose(model.coefficients, ref, rtol=1e-6)
+
+
+class TestStreamingBlocks:
+    """Block-streamed sufficient statistics: list or generator of 2-D blocks
+    must fit identically to the in-memory path, without concatenation."""
+
+    def test_list_of_blocks_matches_dense(self, rng):
+        x = rng.normal(size=(500, 6))
+        y = x @ np.arange(1.0, 7.0) + 0.1 * rng.normal(size=500)
+        blocks = list(np.array_split(x, 4))
+        m_stream = LinearRegression().setRegParam(0.1).fit((blocks, y))
+        m_dense = LinearRegression().setRegParam(0.1).fit((x, y))
+        np.testing.assert_allclose(m_stream.coefficients, m_dense.coefficients, atol=1e-10)
+        assert abs(m_stream.intercept - m_dense.intercept) < 1e-10
+
+    def test_generator_consumed_lazily(self, rng):
+        x = rng.normal(size=(300, 4))
+        y = x @ np.array([1.0, -1.0, 2.0, 0.5])
+        consumed = []
+
+        def gen():
+            for b in np.array_split(x, 3):
+                consumed.append(len(b))
+                yield b
+
+        m = LinearRegression().fit((gen(), y))
+        assert consumed == [100, 100, 100]
+        ref = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(m.coefficients, ref.coefficients, atol=1e-10)
+
+    def test_per_block_labels_and_elastic_net(self, rng):
+        x = rng.normal(size=(400, 8))
+        beta = np.zeros(8); beta[:2] = [3.0, -2.0]
+        y = x @ beta + 0.05 * rng.normal(size=400)
+        xb = list(np.array_split(x, 5))
+        yb = list(np.array_split(y, 5))
+        m = (
+            LinearRegression()
+            .setRegParam(0.2)
+            .setElasticNetParam(1.0)
+            .setStandardization(False)
+            .fit((xb, yb))
+        )
+        ref = (
+            LinearRegression()
+            .setRegParam(0.2)
+            .setElasticNetParam(1.0)
+            .setStandardization(False)
+            .fit((x, y))
+        )
+        np.testing.assert_allclose(m.coefficients, ref.coefficients, atol=1e-8)
+        assert np.sum(np.abs(m.coefficients) > 1e-6) <= 4
+
+    def test_npy_reader_integration(self, tmp_path, rng):
+        from spark_rapids_ml_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        x = rng.normal(size=(600, 5))
+        y = x @ np.arange(1.0, 6.0)
+        path = str(tmp_path / "x.npy")
+        np.save(path, x)
+        with native.NpyBlockReader(path, block_rows=128) as r:
+            m = LinearRegression().fit((r.iter_blocks(), y))
+        ref = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(m.coefficients, ref.coefficients, atol=1e-8)
+
+    def test_block_row_mismatch_raises(self, rng):
+        x = rng.normal(size=(100, 3))
+        with pytest.raises(ValueError, match="mismatch"):
+            LinearRegression().fit((list(np.array_split(x, 2)), np.zeros(80)))
+
+    def test_sparse_blocks_stream(self, rng):
+        import scipy.sparse as sp
+
+        x = rng.normal(size=(200, 5)) * (rng.uniform(size=(200, 5)) > 0.6)
+        y = x @ np.arange(1.0, 6.0)
+        blocks = [sp.csr_matrix(b) for b in np.array_split(x, 4)]
+        m = LinearRegression().fit((blocks, y))
+        ref = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(m.coefficients, ref.coefficients, atol=1e-10)
+
+    def test_iterator_input(self, rng):
+        x = rng.normal(size=(120, 3))
+        y = x @ np.array([1.0, 2.0, 3.0])
+        m = LinearRegression().fit((map(np.asarray, np.array_split(x, 3)), y))
+        ref = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(m.coefficients, ref.coefficients, atol=1e-10)
+
+    def test_mismatches_raise(self, rng):
+        x = rng.normal(size=(100, 3))
+        blocks = list(np.array_split(x, 4))
+        with pytest.raises(ValueError, match="different lengths"):
+            LinearRegression().fit((blocks, [np.zeros(25)] * 3))
+        with pytest.raises(ValueError, match="blocks supplied"):
+            LinearRegression().fit((blocks, np.zeros(120)))
+        bad = [np.ones((10, 3)), np.ones((10, 4))]
+        with pytest.raises(ValueError, match="inconsistent feature dims"):
+            LinearRegression().fit((bad, np.zeros(20)))
