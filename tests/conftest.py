@@ -75,3 +75,24 @@ def numpy_pca_oracle(x: np.ndarray, k: int):
     total = np.clip(w, 0, None).sum()
     explained = np.clip(w, 0, None) / total if total > 0 else w
     return v[:, :k], explained[:k]
+
+
+# File-logging analogue of the reference's log4j.properties (SURVEY.md §2:
+# tests append to target/unit-tests.log): jax/absl and framework loggers
+# write to target/unit-tests.log so failing CI runs keep a artifact trail.
+import logging as _logging
+import pathlib as _pathlib
+
+_log_dir = _pathlib.Path(__file__).resolve().parent.parent / "target"
+_log_dir.mkdir(exist_ok=True)
+_handler = _logging.FileHandler(_log_dir / "unit-tests.log")
+_handler.setFormatter(
+    _logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s")
+)
+_root = _logging.getLogger()
+if not any(
+    isinstance(h, _logging.FileHandler)
+    and getattr(h, "baseFilename", "").endswith("unit-tests.log")
+    for h in _root.handlers
+):
+    _root.addHandler(_handler)
