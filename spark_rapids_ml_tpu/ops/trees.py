@@ -207,6 +207,24 @@ def _node_totals(
     return tot
 
 
+def _select_feature(x: jax.Array, f_r: jax.Array) -> jax.Array:
+    """out[t, r] = x[r, f_r[t, r]] without a 2-D gather.
+
+    TPU lowers the gather to a scalar loop (~7x slower than this even at
+    d = 28); an unrolled where-select streams x once per feature, which the
+    fusion turns into d vectorized passes. Falls back to the gather above
+    ~256 features, where d passes over (T, n) would cost more.
+    """
+    d = x.shape[1]
+    if d > 256:
+        rows = jnp.arange(x.shape[0])
+        return jax.vmap(lambda fr: x[rows, fr])(f_r)
+    out = jnp.zeros(f_r.shape, x.dtype)
+    for f in range(d):
+        out = jnp.where(f_r == f, x[:, f][None, :], out)
+    return out
+
+
 def _leaf_prediction(stats: jax.Array, kind: str) -> jax.Array:
     """Per-node prediction from stats: class distribution or [mean]."""
     if kind in ("gini", "entropy"):
@@ -276,7 +294,6 @@ def grow_forest(
     node_gain = jnp.zeros((T, n_total), dtype=jnp.float32)
 
     node_idx = jnp.zeros((T, n), dtype=jnp.int32)  # all rows at the root
-    row_ids = jnp.arange(n)
 
     for level in range(max_depth):
         offset = 2**level - 1
@@ -340,14 +357,20 @@ def grow_forest(
             jnp.where(split_ok, best_gain, 0.0)
         )
 
-        # Route rows: leaf rows retire (-1); split rows descend.
+        # Route rows: leaf rows retire (-1); split rows descend. TPU gathers
+        # are scalarized and slow (~0.5 s per (T, n) take_along_axis at 2M
+        # rows), so the three per-node lookups are PACKED into one int32
+        # table gather, and the per-row feature-value lookup becomes an
+        # unrolled select over the (static, small) feature axis.
         local = node_idx - offset
         active = (local >= 0) & (local < m_nodes)
         lc = jnp.clip(local, 0, m_nodes - 1)
-        f_r = jnp.take_along_axis(best_f, lc, axis=1)  # (T, n)
-        b_r = jnp.take_along_axis(best_b, lc, axis=1)
-        ok_r = jnp.take_along_axis(split_ok, lc, axis=1)
-        xb_r = jax.vmap(lambda fr: x_binned[row_ids, fr])(f_r)  # (T, n)
+        packed = best_f * (2 * n_bins) + best_b * 2 + split_ok.astype(jnp.int32)
+        packed_r = jnp.take_along_axis(packed, lc, axis=1)  # (T, n): ONE gather
+        f_r = packed_r // (2 * n_bins)
+        b_r = (packed_r % (2 * n_bins)) // 2
+        ok_r = (packed_r % 2) == 1
+        xb_r = _select_feature(x_binned, f_r)  # (T, n)
         child = 2 * node_idx + 1 + (xb_r > b_r)
         node_idx = jnp.where(active & ok_r, child, jnp.where(active, -1, node_idx))
 
@@ -420,17 +443,21 @@ def grow_forest_sharded(
 def forest_apply(
     x: jax.Array, forest: Forest, max_depth: int
 ) -> jax.Array:
-    """Leaf index per (tree, row): parallel root-to-leaf walk, (T, n) int32."""
-    T = forest.feature.shape[0]
-    n = x.shape[0]
-    row_ids = jnp.arange(n)
-    idx = jnp.zeros((T, n), dtype=jnp.int32)
+    """Leaf index per (tree, row): parallel root-to-leaf walk, (T, n) int32.
+
+    Per step: feature id and leaf flag ride ONE packed int gather (TPU
+    gathers are scalarized — see the routing note in :func:`grow_forest`),
+    the threshold a second; the feature value is an unrolled select.
+    """
+    idx = jnp.zeros((forest.feature.shape[0], x.shape[0]), dtype=jnp.int32)
+    packed = jnp.maximum(forest.feature, 0) * 2 + forest.is_leaf.astype(jnp.int32)
 
     def body(_, idx):
-        f = jnp.take_along_axis(forest.feature, idx, axis=1)
+        p = jnp.take_along_axis(packed, idx, axis=1)
+        f = p // 2
+        leaf = (p % 2) == 1
         thr = jnp.take_along_axis(forest.threshold, idx, axis=1)
-        leaf = jnp.take_along_axis(forest.is_leaf, idx, axis=1)
-        xv = jax.vmap(lambda fr: x[row_ids, jnp.maximum(fr, 0)])(f)
+        xv = _select_feature(x, f)
         child = 2 * idx + 1 + (xv > thr)
         return jnp.where(leaf, idx, child.astype(jnp.int32))
 
@@ -439,12 +466,19 @@ def forest_apply(
 
 @partial(jax.jit, static_argnames=("max_depth",))
 def forest_predict_proba(x: jax.Array, forest: Forest, max_depth: int) -> jax.Array:
-    """(n, C) mean of per-tree leaf class distributions."""
+    """(n, C) mean of per-tree leaf class distributions.
+
+    Gathered one class at a time: a (T, n, C) take_along_axis would tile-pad
+    the tiny class axis to the 128-lane register width on TPU (a 64x memory
+    blowup at C=2 — 20 GB at 2M rows x 20 trees).
+    """
     idx = forest_apply(x, forest, max_depth)  # (T, n)
-    lv = jnp.take_along_axis(
-        forest.leaf_value, idx[:, :, None], axis=1
-    )  # (T, n, C)
-    return jnp.mean(lv, axis=0)
+    n_classes = forest.leaf_value.shape[2]
+    per_class = [
+        jnp.mean(jnp.take_along_axis(forest.leaf_value[:, :, c], idx, axis=1), axis=0)
+        for c in range(n_classes)
+    ]
+    return jnp.stack(per_class, axis=1)
 
 
 @partial(jax.jit, static_argnames=("max_depth",))

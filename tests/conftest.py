@@ -85,14 +85,19 @@ import pathlib as _pathlib
 
 _log_dir = _pathlib.Path(__file__).resolve().parent.parent / "target"
 _log_dir.mkdir(exist_ok=True)
-_handler = _logging.FileHandler(_log_dir / "unit-tests.log")
-_handler.setFormatter(
-    _logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s")
-)
 _root = _logging.getLogger()
 if not any(
     isinstance(h, _logging.FileHandler)
     and getattr(h, "baseFilename", "").endswith("unit-tests.log")
     for h in _root.handlers
 ):
+    _handler = _logging.FileHandler(_log_dir / "unit-tests.log")
+    _handler.setFormatter(
+        _logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    )
     _root.addHandler(_handler)
+    if _root.level in (_logging.NOTSET, _logging.WARNING):
+        # INFO so the jax/absl trail actually reaches the file (the
+        # default WARNING threshold would filter the records this
+        # artifact exists to keep); pytest still captures console output.
+        _root.setLevel(_logging.INFO)
