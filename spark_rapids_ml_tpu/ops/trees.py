@@ -123,6 +123,7 @@ def _level_histogram(
     n_nodes: int,
     n_bins: int,
     block_rows: int,
+    prec=lax.Precision.HIGHEST,
 ) -> jax.Array:
     """(T, n_nodes, d, n_bins, S) histogram via blocked one-hot GEMMs."""
     T, n = node_idx.shape
@@ -158,9 +159,7 @@ def _level_histogram(
             coef = w_b * rs_b[None, :, s]  # (T, bs)
             a = node_oh * coef[:, :, None]  # (T, bs, M)
             per_s.append(
-                jnp.einsum(
-                    "tbm,bq->tmq", a, bin_oh, precision=lax.Precision.HIGHEST
-                )
+                jnp.einsum("tbm,bq->tmq", a, bin_oh, precision=prec)
             )
         return hist + jnp.stack(per_s, axis=-1), None
 
@@ -176,6 +175,7 @@ def _node_totals(
     offset: int,
     n_nodes: int,
     block_rows: int,
+    prec=lax.Precision.HIGHEST,
 ) -> jax.Array:
     """(T, n_nodes, S) per-node stat totals (no feature/bin split)."""
     T, n = node_idx.shape
@@ -198,9 +198,7 @@ def _node_totals(
             (local[:, :, None] == jnp.arange(n_nodes, dtype=jnp.int32))
             & in_level[:, :, None]
         ).astype(jnp.float32) * w_b[:, :, None]
-        return tot + jnp.einsum(
-            "tbm,bs->tms", node_oh, rs_b, precision=lax.Precision.HIGHEST
-        ), None
+        return tot + jnp.einsum("tbm,bs->tms", node_oh, rs_b, precision=prec), None
 
     init = jnp.zeros((T, n_nodes, S), dtype=jnp.float32)
     tot, _ = lax.scan(step, init, (ni, w, rs))
@@ -285,6 +283,15 @@ def grow_forest(
     n_total = 2 ** (max_depth + 1) - 1
     s_out = S if impurity in ("gini", "entropy") else 1
     min_w = float(min_instances)
+    # Classification histogram entries are small-integer counts (one-hot x
+    # Poisson weights <= ~hundreds): EXACT even under one-pass bf16
+    # multiplies with fp32 accumulation, so the 6-pass HIGHEST route would
+    # buy nothing. Regression stats carry real-valued label channels that
+    # bf16 would round at 8 mantissa bits — keep those at HIGHEST.
+    hist_prec = (
+        lax.Precision.DEFAULT if impurity in ("gini", "entropy")
+        else lax.Precision.HIGHEST
+    )
 
     feature = jnp.full((T, n_total), -1, dtype=jnp.int32)
     threshold = jnp.zeros((T, n_total), dtype=jnp.float32)
@@ -300,7 +307,7 @@ def grow_forest(
         m_nodes = 2**level
         hist = _level_histogram(
             node_idx, weights, x_binned, row_stats, offset, m_nodes, n_bins,
-            block_rows,
+            block_rows, hist_prec,
         )  # (T, M, d, B, S)
         if axis_name is not None:
             hist = lax.psum(hist, axis_name)
@@ -377,7 +384,9 @@ def grow_forest(
     # Bottom level: every surviving node is a leaf.
     offset = 2**max_depth - 1
     m_nodes = 2**max_depth
-    total = _node_totals(node_idx, weights, row_stats, offset, m_nodes, block_rows)
+    total = _node_totals(
+        node_idx, weights, row_stats, offset, m_nodes, block_rows, hist_prec
+    )
     if axis_name is not None:
         total = lax.psum(total, axis_name)
     sl = slice(offset, offset + m_nodes)
