@@ -1,0 +1,98 @@
+"""Kitchen-sink integration: every estimator family in one scenario.
+
+Guards cross-component wiring (shared data dispatch, persistence layer,
+param system, namespaces) rather than per-model numerics — each model's
+own suite covers those.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import LogisticRegression, RandomForestClassifier
+from spark_rapids_ml_tpu.clustering import DBSCAN, KMeans
+from spark_rapids_ml_tpu.core.data import DataFrame
+from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.manifold import UMAP
+from spark_rapids_ml_tpu.neighbors import ApproximateNearestNeighbors, NearestNeighbors
+from spark_rapids_ml_tpu.pipeline import Pipeline, PipelineModel
+from spark_rapids_ml_tpu.regression import LinearRegression, RandomForestRegressor
+from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+
+@pytest.fixture(scope="module")
+def scenario():
+    rng = np.random.default_rng(7)
+    centers = np.zeros((3, 12))
+    centers[0, 0] = centers[1, 1] = centers[2, 2] = 8.0
+    x = np.concatenate([rng.normal(size=(60, 12)) + c for c in centers])
+    labels = np.repeat(np.arange(3), 60).astype(float)
+    return x, labels
+
+
+def test_every_family_round_trips(scenario, tmp_path):
+    x, labels = scenario
+    df = DataFrame({"features": list(x), "label": list(labels)})
+
+    # Feature reduction -> clustering pipeline, persisted and reloaded.
+    pipe_model = Pipeline(
+        stages=[
+            PCA().setK(4).setInputCol("features").setOutputCol("pca"),
+            KMeans().setK(3).setFeaturesCol("pca").setSeed(0),
+        ]
+    ).fit(df)
+    pipe_model.save(str(tmp_path / "pipe"))
+    out = PipelineModel.load(str(tmp_path / "pipe")).transform(df)
+    preds = np.asarray(out.select("prediction"))
+    # 3 blobs recovered (up to relabeling).
+    for c in range(3):
+        blok = preds[labels == c]
+        assert np.mean(blok == np.bincount(blok).argmax()) > 0.9
+
+    # Supervised: CV selects a logistic model that classifies the blobs.
+    lr = LogisticRegression()
+    cv = (
+        CrossValidator()
+        .setEstimator(lr)
+        .setEstimatorParamMaps(ParamGridBuilder().addGrid(lr.regParam, [0.0, 1.0]).build())
+        .setEvaluator(MulticlassClassificationEvaluator())
+        .setNumFolds(3)
+        .fit(df)
+    )
+    acc = np.mean(np.asarray(cv.transform(df).select("prediction")) == labels)
+    assert acc > 0.95
+
+    # Forests, both flavors.
+    assert np.mean(
+        RandomForestClassifier().setNumTrees(8).setSeed(1).fit((x, labels)).predict(x)
+        == labels
+    ) > 0.95
+    y_reg = x[:, 0] - x[:, 1]
+    rf_reg = RandomForestRegressor().setNumTrees(8).setFeatureSubsetStrategy("all").setSeed(2)
+    assert np.sqrt(np.mean((rf_reg.fit((x, y_reg)).predict(x) - y_reg) ** 2)) < 1.5
+
+    # Regression + streaming blocks.
+    lin = LinearRegression().fit((list(np.array_split(x, 4)), y_reg))
+    assert np.sqrt(np.mean((lin.predict(x) - y_reg) ** 2)) < 1e-6
+
+    # Neighbors: exact and approximate agree on the nearest neighbor.
+    d_nn, i_nn = NearestNeighbors().setK(3).fit(x).kneighbors(x[:10])
+    d_ann, i_ann = (
+        ApproximateNearestNeighbors()
+        .setAlgoParams({"nlist": 4, "nprobe": 4})
+        .setK(3)
+        .fit(x)
+        .kneighbors(x[:10])
+    )
+    np.testing.assert_array_equal(i_nn[:, 0], i_ann[:, 0])
+
+    # Density clustering finds the 3 blobs (eps ~ the 12-d intra-blob
+    # pairwise distance scale, sqrt(2d) ~ 4.9).
+    db = DBSCAN().setEps(4.5).setMinSamples(5).fit(x)
+    assert len(set(db.labels_[db.labels_ >= 0])) == 3
+
+    # Manifold embedding separates them.
+    emb = UMAP().setNNeighbors(10).setNEpochs(60).setSeed(3).fit(x).embedding
+    cents = np.stack([emb[labels == c].mean(0) for c in range(3)])
+    spread = np.mean(np.linalg.norm(emb[labels == 0] - cents[0], axis=1))
+    assert np.linalg.norm(cents[0] - cents[1]) > 2 * spread
