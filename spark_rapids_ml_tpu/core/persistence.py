@@ -134,6 +134,33 @@ def load_metadata(path: str, expected_class: Optional[str] = None) -> Dict[str, 
     return metadata
 
 
+def resolve_persisted_class(class_path: str):
+    """Import the class named in on-disk metadata, restricted to this
+    package: model directories are data, and letting them name arbitrary
+    modules would turn ``load`` into an import-side-effect gadget."""
+    module_name, _, class_name = class_path.rpartition(".")
+    root = module_name.split(".", 1)[0]
+    if root != "spark_rapids_ml_tpu":
+        raise ValueError(
+            f"refusing to import {class_path!r} from model metadata: only "
+            "spark_rapids_ml_tpu classes are loadable"
+        )
+    import importlib
+
+    obj = getattr(importlib.import_module(module_name), class_name)
+    # The attribute itself must be a class DEFINED in this package —
+    # modules re-export numpy etc., whose `.load` is not a model loader.
+    if not (
+        isinstance(obj, type)
+        and getattr(obj, "__module__", "").split(".", 1)[0] == "spark_rapids_ml_tpu"
+    ):
+        raise ValueError(
+            f"refusing to load {class_path!r} from model metadata: not a "
+            "spark_rapids_ml_tpu class"
+        )
+    return obj
+
+
 def get_and_set_params(instance, metadata: Dict[str, Any]) -> None:
     """metadata.getAndSetParams equivalent (RapidsPCA.scala:251)."""
     for name, value in metadata.get("defaultParamMap", {}).items():

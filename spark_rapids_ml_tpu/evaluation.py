@@ -111,7 +111,8 @@ class RegressionEvaluator(Evaluator):
 
 
 class MulticlassClassificationEvaluator(Evaluator):
-    """metricName: accuracy (default) | f1 | weightedPrecision | weightedRecall."""
+    """metricName: f1 (default, matching Spark) | accuracy | weightedPrecision |
+    weightedRecall."""
 
     metricName = Param(
         "_", "metricName", "accuracy|f1|weightedPrecision|weightedRecall", toString
@@ -121,8 +122,10 @@ class MulticlassClassificationEvaluator(Evaluator):
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
+        # Spark's MulticlassClassificationEvaluator defaults to "f1" —
+        # keep that, so ported tuning code optimizes the same metric.
         self._setDefault(
-            metricName="accuracy", labelCol="label", predictionCol="prediction"
+            metricName="f1", labelCol="label", predictionCol="prediction"
         )
 
     def setMetricName(self, v: str):

@@ -227,6 +227,25 @@ class _RandomForestParams(Params):
         return self._chain(self.weightCol, v)
 
 
+def _hist_exact_in_bf16(row_stats: np.ndarray, sample_w) -> bool:
+    """True when every histogram operand survives bf16 rounding. The
+    one-pass DEFAULT-precision histogram feeds ``sample_weight * stat``
+    to the MXU as bf16 (fp32 accumulation), so exactness needs the
+    *product* — integer and <= 256 — not just the raw stats: an integer
+    weightCol of 129 drawn 3 times by the bootstrap contributes 387,
+    which bf16 rounds."""
+    rs = np.asarray(row_stats, dtype=np.float32)
+    if rs.size == 0 or not np.array_equal(rs, np.rint(rs)):
+        return False
+    # sample_w may be device-resident (T, n): reduce on device, pull scalars.
+    # Bootstrap draws are integral today (Poisson/Bernoulli), but the guard
+    # verifies that rather than assume it.
+    if not bool(jnp.all(sample_w == jnp.rint(sample_w))):
+        return False
+    max_prod = float(np.abs(rs).max()) * float(jnp.max(sample_w))
+    return max_prod <= 256.0
+
+
 def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarray,
                 impurity: str, classification: bool, mesh=None) -> Forest:
     """Shared fit: quantize, sample, grow. Returns the Forest arrays.
@@ -256,6 +275,7 @@ def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarra
         feat_subset=m,
         min_instances=params.getMinInstancesPerNode(),
         min_info_gain=params.getMinInfoGain(),
+        exact_counts=classification and _hist_exact_in_bf16(row_stats, w),
     )
     rs = jnp.asarray(row_stats, dtype=jnp.float32)
     e = edges.astype(jnp.float32)

@@ -245,6 +245,7 @@ def _leaf_prediction(stats: jax.Array, kind: str) -> jax.Array:
         "min_info_gain",
         "block_rows",
         "axis_name",
+        "exact_counts",
     ),
 )
 def grow_forest(
@@ -262,6 +263,7 @@ def grow_forest(
     min_info_gain: float = 0.0,
     block_rows: int = 4096,
     axis_name: str | None = None,
+    exact_counts: bool = True,
 ) -> Forest:
     """Grow T trees level-synchronously; all shapes static, one XLA program.
 
@@ -287,9 +289,13 @@ def grow_forest(
     # Poisson weights <= ~hundreds): EXACT even under one-pass bf16
     # multiplies with fp32 accumulation, so the 6-pass HIGHEST route would
     # buy nothing. Regression stats carry real-valued label channels that
-    # bf16 would round at 8 mantissa bits — keep those at HIGHEST.
+    # bf16 would round at 8 mantissa bits — keep those at HIGHEST. The same
+    # rounding hazard applies to classification when a fractional weightCol
+    # has been multiplied into row_stats (~2^-9 relative error can flip
+    # near-tie splits), so the caller clears ``exact_counts`` in that case.
     hist_prec = (
-        lax.Precision.DEFAULT if impurity in ("gini", "entropy")
+        lax.Precision.DEFAULT
+        if impurity in ("gini", "entropy") and exact_counts
         else lax.Precision.HIGHEST
     )
 

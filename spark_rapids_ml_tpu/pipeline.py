@@ -9,12 +9,16 @@ with its import path, so heterogeneous stage types round-trip.
 
 from __future__ import annotations
 
-import importlib
 import os
 from typing import Any, List, Optional
 
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model, Transformer
-from spark_rapids_ml_tpu.core.persistence import MLReadable, load_metadata, save_metadata
+from spark_rapids_ml_tpu.core.persistence import (
+    MLReadable,
+    load_metadata,
+    resolve_persisted_class,
+    save_metadata,
+)
 
 
 def save_stages(owner, path: str, stages: List[Any], class_name: str) -> None:
@@ -46,8 +50,7 @@ def load_stages(path: str, expected_class: str):
     for i, (uid, class_path) in enumerate(
         zip(metadata.get("stageUids", []), metadata.get("stageClasses", []))
     ):
-        module_name, _, class_name = class_path.rpartition(".")
-        klass = getattr(importlib.import_module(module_name), class_name)
+        klass = resolve_persisted_class(class_path)
         stages.append(klass.load(os.path.join(path, "stages", f"{i}_{uid}")))
     return metadata, stages
 

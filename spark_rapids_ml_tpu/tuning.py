@@ -15,14 +15,17 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-import importlib
 import os
 
 from spark_rapids_ml_tpu.core.data import DataFrame
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, toFloat, toInt
-from spark_rapids_ml_tpu.core.persistence import load_metadata, save_metadata
-from spark_rapids_ml_tpu.evaluation import Evaluator
+from spark_rapids_ml_tpu.core.persistence import (
+    load_metadata,
+    resolve_persisted_class,
+    save_metadata,
+)
+from spark_rapids_ml_tpu.evaluation import BinaryClassificationEvaluator, Evaluator
 
 
 def _save_best_model(owner, path: str, class_name: str, extra: dict) -> None:
@@ -37,8 +40,7 @@ def _save_best_model(owner, path: str, class_name: str, extra: dict) -> None:
 
 def _load_best_model(path: str, expected_class: str):
     metadata = load_metadata(path, expected_class=expected_class)
-    module_name, _, class_name = metadata["bestModelClass"].rpartition(".")
-    klass = getattr(importlib.import_module(module_name), class_name)
+    klass = resolve_persisted_class(metadata["bestModelClass"])
     return metadata, klass.load(os.path.join(path, "bestModel"))
 
 
@@ -95,11 +97,24 @@ def _num_rows(dataset: Any) -> int:
 def _eval_dataset(model: Model, val: Any, evaluator: Evaluator) -> Any:
     """Transform the validation subset and hand the result to the evaluator.
 
-    Tuple datasets have no named columns, so the transform output (a
-    prediction array) is paired with the held-out labels directly.
+    Tuple datasets have no named columns, so the transform output is paired
+    with the held-out labels directly. Score-based evaluators (AUC) must see
+    continuous scores, not hard class labels — for those the model's
+    ``predictProbability`` positive-class column stands in for the
+    ``rawPrediction`` column a named-column dataset would carry.
     """
     if isinstance(val, tuple):
         x_val, y_val = val
+        if isinstance(evaluator, BinaryClassificationEvaluator):
+            if not hasattr(model, "predictProbability"):
+                raise TypeError(
+                    f"{type(evaluator).__name__} ranks by continuous scores, "
+                    f"but {type(model).__name__} exposes no predictProbability; "
+                    "pass a named-column dataset so rawPredictionCol applies"
+                )
+            probs = np.asarray(model.predictProbability(x_val))
+            scores = probs[:, -1] if probs.ndim == 2 else probs
+            return (y_val, scores)
         preds = model.transform(x_val)
         return (y_val, preds)
     return model.transform(val)

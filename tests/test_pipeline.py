@@ -87,3 +87,24 @@ class TestPipeline:
             np.asarray(out_a.select("prediction")),
             np.asarray(out_b.select("prediction")),
         )
+
+    def test_load_rejects_foreign_class(self, tmp_path):
+        """Metadata naming a class outside this package must not be imported
+        (ADVICE r1: untrusted model dirs as import gadgets)."""
+        import json
+
+        pipe = Pipeline(stages=[PCA().setK(2)])
+        path = str(tmp_path / "pipe_evil")
+        pipe.save(path)
+        meta_file = tmp_path / "pipe_evil" / "metadata" / "part-00000"
+        meta = json.loads(meta_file.read_text())
+        meta["stageClasses"] = ["os.system"]
+        meta_file.write_text(json.dumps(meta) + "\n")
+        with pytest.raises(ValueError, match="refusing to import"):
+            Pipeline.load(path)
+        # A path inside the package that resolves to a re-exported foreign
+        # attribute (e.g. a numpy module alias) must be rejected too.
+        meta["stageClasses"] = ["spark_rapids_ml_tpu.tuning.np"]
+        meta_file.write_text(json.dumps(meta) + "\n")
+        with pytest.raises(ValueError, match="refusing to load"):
+            Pipeline.load(path)

@@ -40,7 +40,14 @@ class TestEvaluators:
         y = rng.integers(0, 3, 200).astype(float)
         p = np.where(rng.uniform(size=200) < 0.7, y, rng.integers(0, 3, 200)).astype(float)
         ev = MulticlassClassificationEvaluator()
-        assert ev.evaluate((y, p)) == pytest.approx(np.mean(y == p))
+        # Spark's default metric is f1 (weighted), not accuracy.
+        assert ev.getMetricName() == "f1"
+        assert ev.evaluate((y, p)) == pytest.approx(
+            sklearn_metrics.f1_score(y, p, average="weighted")
+        )
+        assert ev.setMetricName("accuracy").evaluate((y, p)) == pytest.approx(
+            np.mean(y == p)
+        )
         assert ev.setMetricName("f1").evaluate((y, p)) == pytest.approx(
             sklearn_metrics.f1_score(y, p, average="weighted")
         )
@@ -186,6 +193,34 @@ class TestCrossValidator:
         assert loaded.bestIndex == model.bestIndex
         np.testing.assert_allclose(loaded.avgMetrics, model.avgMetrics)
         np.testing.assert_allclose(loaded.transform(x), model.transform(x), atol=1e-10)
+
+    def test_binary_evaluator_gets_scores_not_labels(self, rng):
+        """AUC on a tuple dataset must rank by continuous probabilities —
+        hard 0/1 labels would tie whole grid cells (ADVICE r1, medium)."""
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+        from spark_rapids_ml_tpu.tuning import _eval_dataset
+
+        x = rng.normal(size=(200, 4))
+        y = (x[:, 0] + 0.5 * x[:, 1] + 0.3 * rng.normal(size=200) > 0).astype(float)
+        model = LogisticRegression().setMaxIter(50).fit((x, y))
+        ev = BinaryClassificationEvaluator()
+        y_out, scores = _eval_dataset(model, (x, y), ev)
+        # Scores are continuous probabilities, not a handful of hard labels.
+        assert len(np.unique(scores)) > 10
+        np.testing.assert_array_equal(y_out, y)
+        auc_scores = ev.evaluate((y_out, scores))
+        auc_labels = ev.evaluate((y, model.predict(x).astype(float)))
+        # Probability ranking must dominate the degenerate two-point ROC.
+        assert auc_scores >= auc_labels
+        assert auc_scores > 0.9
+
+    def test_binary_evaluator_rejects_scoreless_model(self, rng):
+        from spark_rapids_ml_tpu.tuning import _eval_dataset
+
+        x, y = _ridge_data(rng)
+        model = LinearRegression().fit((x, y))
+        with pytest.raises(TypeError, match="predictProbability"):
+            _eval_dataset(model, (x, y), BinaryClassificationEvaluator())
 
     def test_copy_preserves_mesh(self):
         rf = RandomForestClassifier(mesh="sentinel-mesh")

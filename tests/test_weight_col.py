@@ -172,3 +172,20 @@ class TestForestWeights:
         )
         preds = m_w.predict(x)
         assert np.sqrt(np.mean((preds - y) ** 2)) < 0.3  # poison ignored
+
+    def test_fractional_weights_route_to_exact_histograms(self):
+        """bf16 one-pass histograms are only used when the full histogram
+        operand — sample_weight * stat — survives bf16 rounding (ADVICE r1:
+        fractional weightCol could flip near-tie splits under DEFAULT
+        precision; the bound must cover the bootstrap multiplicity too)."""
+        from spark_rapids_ml_tpu.models.random_forest import _hist_exact_in_bf16
+
+        onehot = np.eye(3, dtype=np.float32)[np.array([0, 1, 2, 1])]
+        assert _hist_exact_in_bf16(onehot, np.ones(4))  # integer counts: exact
+        assert _hist_exact_in_bf16(onehot * 8.0, np.full(4, 4.0))  # 32 <= 256
+        assert not _hist_exact_in_bf16(onehot * 0.3, np.ones(4))  # fractional
+        # bf16-exact stats whose product with a bootstrap draw of 3 exceeds
+        # the bf16 odd-integer range (129 * 3 = 387 > 256): lossy.
+        assert not _hist_exact_in_bf16(onehot * 129.0, np.full(4, 3.0))
+        # fractional sample weights (not produced today) must also disqualify
+        assert not _hist_exact_in_bf16(onehot, np.full(4, 0.3))
