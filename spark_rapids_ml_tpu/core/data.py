@@ -98,6 +98,58 @@ def _row_to_array(row: Any) -> np.ndarray:
     return np.asarray(row, dtype=np.float64).ravel()
 
 
+def infer_input_dtype(data: Any):
+    """Best-effort dtype of the USER's raw feature container, inspected
+    BEFORE the densification pipeline (``as_partitions``/``as_matrix``)
+    coerces everything to float64.
+
+    Drives ``precision="auto"`` routing: only genuinely-fp64 sources should
+    pay for fp64 emulation on fp32 hardware. Python floats and the Vectors
+    types report float64 (they ARE double, matching Spark's all-``double``
+    vectors); numpy / scipy / pandas containers report their own floating
+    dtype; integer/bool containers and opaque iterators report None (not
+    double data — undeterminable or never worth emulation).
+    """
+    if isinstance(data, np.ndarray):
+        return data.dtype if np.issubdtype(data.dtype, np.floating) else None
+    if _sp is not None and _sp.issparse(data):
+        return data.dtype if np.issubdtype(data.dtype, np.floating) else None
+    if isinstance(data, (SparseVector, DenseVector)):
+        return np.float64
+    if isinstance(data, float):
+        return np.float64
+    try:
+        import pandas as pd
+
+        def _np_dtype(d):
+            # Extension dtypes (Float64Dtype, Categorical, ...) are not
+            # numpy dtypes; most float-like ones expose numpy_dtype.
+            try:
+                return np.dtype(d)
+            except TypeError:
+                return getattr(d, "numpy_dtype", None)
+
+        if isinstance(data, (pd.DataFrame, pd.Series)):
+            if isinstance(data, pd.Series):
+                first = data.iloc[0] if len(data) else None
+                if first is not None and not np.isscalar(first):
+                    return infer_input_dtype(first)
+                dts = [data.dtype]
+            else:
+                dts = list(data.dtypes)
+            mapped = [_np_dtype(d) for d in dts]
+            if any(d == np.float64 for d in mapped if d is not None):
+                return np.float64
+            if any(d == np.float32 for d in mapped if d is not None):
+                return np.float32
+            return None
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(data, (list, tuple)):
+        return infer_input_dtype(data[0]) if len(data) else None
+    return None
+
+
 def _block_to_dense(block: Any) -> np.ndarray:
     """Convert one partition-like object to a dense (rows, d) float array."""
     if isinstance(block, np.ndarray):

@@ -134,29 +134,52 @@ def load_metadata(path: str, expected_class: Optional[str] = None) -> Dict[str, 
     return metadata
 
 
+# Root packages whose classes on-disk metadata may name. User libraries
+# with custom pipeline stages opt in via allow_persisted_package().
+_LOADABLE_PACKAGES = {"spark_rapids_ml_tpu"}
+
+
+def allow_persisted_package(package_root: str) -> None:
+    """Opt a root package into model-directory loading.
+
+    Custom Estimator/Model/Transformer classes defined outside this package
+    round-trip through Pipeline/CrossValidator persistence only after their
+    root package is registered here — loading is restricted by default
+    because model directories are data and may be untrusted.
+    """
+    if not package_root or "." in package_root:
+        raise ValueError(
+            f"package root must be a bare top-level name, got {package_root!r}"
+        )
+    _LOADABLE_PACKAGES.add(package_root)
+
+
 def resolve_persisted_class(class_path: str):
-    """Import the class named in on-disk metadata, restricted to this
-    package: model directories are data, and letting them name arbitrary
-    modules would turn ``load`` into an import-side-effect gadget."""
+    """Import the class named in on-disk metadata, restricted to registered
+    packages (this one by default): model directories are data, and letting
+    them name arbitrary modules would turn ``load`` into an
+    import-side-effect gadget. See :func:`allow_persisted_package` for
+    extending to user stage libraries."""
     module_name, _, class_name = class_path.rpartition(".")
     root = module_name.split(".", 1)[0]
-    if root != "spark_rapids_ml_tpu":
+    if root not in _LOADABLE_PACKAGES:
         raise ValueError(
             f"refusing to import {class_path!r} from model metadata: only "
-            "spark_rapids_ml_tpu classes are loadable"
+            f"classes under {sorted(_LOADABLE_PACKAGES)} are loadable "
+            "(register yours via allow_persisted_package)"
         )
     import importlib
 
     obj = getattr(importlib.import_module(module_name), class_name)
-    # The attribute itself must be a class DEFINED in this package —
+    # The attribute itself must be a class DEFINED in a registered package —
     # modules re-export numpy etc., whose `.load` is not a model loader.
     if not (
         isinstance(obj, type)
-        and getattr(obj, "__module__", "").split(".", 1)[0] == "spark_rapids_ml_tpu"
+        and getattr(obj, "__module__", "").split(".", 1)[0] in _LOADABLE_PACKAGES
     ):
         raise ValueError(
             f"refusing to load {class_path!r} from model metadata: not a "
-            "spark_rapids_ml_tpu class"
+            "class from a registered package"
         )
     return obj
 

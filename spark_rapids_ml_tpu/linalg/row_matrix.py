@@ -28,8 +28,8 @@ from spark_rapids_ml_tpu.ops.covariance import (
     welford_add_block,
     welford_init,
 )
-from spark_rapids_ml_tpu.ops.eigh import eigh_descending, sign_flip
-from spark_rapids_ml_tpu.ops.linalg import triu_to_full
+from spark_rapids_ml_tpu.ops.eigh import eigh_descending, eigh_descending_host, sign_flip
+from spark_rapids_ml_tpu.ops.linalg import resolve_precision, triu_to_full
 from spark_rapids_ml_tpu.parallel.distributed_cov import distributed_mean_and_covariance
 from spark_rapids_ml_tpu.parallel.mesh import shard_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
@@ -55,6 +55,7 @@ class RowMatrix:
         mesh=None,
         precision: str = "highest",
         dtype=None,
+        input_dtype=None,
     ):
         self.partitions: List[np.ndarray] = as_partitions(rows)
         self.mean_centering = mean_centering
@@ -62,9 +63,29 @@ class RowMatrix:
         self.use_accel_svd = use_accel_svd
         self.device_id = device_id
         self.mesh = mesh
-        self.precision = precision
+        self.precision = self.resolve(precision, mesh=mesh, input_dtype=input_dtype)
+        if self.precision == "dd" and mesh is not None:
+            raise ValueError(
+                "precision='dd' is single-device; unset the mesh or use "
+                "precision='highest' (the mesh covariance path)"
+            )
         self._dtype = dtype
         self._num_rows: Optional[int] = None
+
+    @staticmethod
+    def resolve(precision: str, mesh=None, input_dtype=None) -> str:
+        """THE home of precision-request resolution (PCA calls this too —
+        keep the policy in one place). ``input_dtype`` is the dtype of the
+        RAW user container, probed by the caller before as_partitions
+        coerced blocks to float64 (core.data.infer_input_dtype). Without
+        it, "auto" must not trust partitions[0].dtype (always float64
+        post-coercion) — it resolves to "highest" rather than silently
+        routing every fit through the slow dd emulation. With a mesh,
+        "auto" defers to the mesh covariance path (dd has no mesh route).
+        """
+        if precision == "auto" and mesh is not None:
+            return "highest"
+        return resolve_precision(precision, input_dtype=input_dtype)
 
     # --- shape (lazy, like numRows/numCols via count()/first(), :48-57) ---
 
@@ -108,6 +129,8 @@ class RowMatrix:
         with TraceRange("compute cov", TraceColor.RED):
             if self.mesh is not None:
                 return self._covariance_mesh()[1]  # honors mean_centering
+            if self.precision == "dd":
+                return self._covariance_dd()
             if self.use_gemm:
                 mean = (
                     self.column_means()
@@ -164,6 +187,20 @@ class RowMatrix:
         full = triu_to_full(acc)
         return full / (self.num_rows - 1)
 
+    def _covariance_dd(self) -> np.ndarray:
+        """Double-float fp64-emulated covariance (ops.doubledouble): the
+        reference's ``double[]`` numerics (JniRAPIDSML.java:64-69) on fp32
+        hardware. ONE streaming pass over the partitions (shifted
+        accumulation); fp64 host accumulation of per-block
+        extended-precision Gram partials."""
+        from spark_rapids_ml_tpu.ops.doubledouble import covariance_dd_blocks
+
+        with TraceRange("dd gemm", TraceColor.GREEN):
+            _, cov, _ = covariance_dd_blocks(
+                self.partitions, center=self.mean_centering
+            )
+        return cov
+
     def _covariance_mesh(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Whole-fit-as-one-XLA-program path over a device mesh."""
         x = np.concatenate(self.partitions, axis=0).astype(np.dtype(self.dtype))
@@ -184,7 +221,14 @@ class RowMatrix:
         if not 1 <= k <= n_cols:
             raise ValueError(f"k must be in [1, {n_cols}], got {k}")
         cov = self.compute_covariance()
-        if self.use_accel_svd:
+        if self.precision == "dd":
+            # The covariance is exact-fp64 host data; a device eigensolve
+            # would round it to fp32 on a no-x64 platform. Host LAPACK
+            # keeps the dd accuracy end to end (d x d only — O(d^3) off
+            # the critical data path).
+            with TraceRange("host fp64 SVD", TraceColor.BLUE):
+                w, u = eigh_descending_host(np.asarray(cov))
+        elif self.use_accel_svd:
             with TraceRange("xla SVD", TraceColor.BLUE):
                 w, u = eigh_descending(cov)
                 u, w = np.asarray(u), np.asarray(w)

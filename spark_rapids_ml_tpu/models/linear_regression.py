@@ -35,6 +35,7 @@ from spark_rapids_ml_tpu.ops.linear import (
     regression_metrics,
     solve_elastic_net,
     solve_normal,
+    solve_normal_host,
 )
 from spark_rapids_ml_tpu.parallel.mesh import shard_rows, weights_as_mask
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
@@ -52,6 +53,12 @@ class _LinearRegressionParams(Params):
     )
     solver = Param("_", "solver", "normal or auto", toString)
     weightCol = Param("_", "weightCol", "per-row weight column name", toString)
+    precision = Param(
+        "_",
+        "precision",
+        "auto | default | high | highest | dd (double-float fp64 emulation)",
+        toString,
+    )
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
@@ -64,6 +71,7 @@ class _LinearRegressionParams(Params):
             elasticNetParam=0.0,
             standardization=True,
             solver="auto",
+            precision="auto",
         )
 
     def getFeaturesCol(self) -> str:
@@ -96,6 +104,9 @@ class _LinearRegressionParams(Params):
             if self.isDefined(self.weightCol)
             else None
         )
+
+    def getPrecision(self) -> str:
+        return self.getOrDefault(self.precision)
 
 
 class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
@@ -151,9 +162,104 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         self.set(self.weightCol, value)
         return self
 
+    def setPrecision(self, value: str) -> "LinearRegression":
+        """Matmul precision for the sufficient-statistics GEMMs. ``"dd"``
+        emulates fp64 via double-float MXU GEMMs (ops.doubledouble) and
+        solves the normal equations in host fp64 — the reference's
+        ``double[]`` numerics (JniRAPIDSML.java:64-69) on fp32-only
+        hardware; ``"auto"`` selects it for float64 input without x64."""
+        from spark_rapids_ml_tpu.ops.linalg import validate_precision
+
+        self.set(self.precision, validate_precision(value))
+        return self
+
     def setMesh(self, mesh) -> "LinearRegression":
         self.mesh = mesh
         return self
+
+    def _uses_fista(self) -> bool:
+        """True when the fit routes to the proximal (FISTA) solver rather
+        than the exact normal-equation solve (see _solve_from_stats)."""
+        return self.getElasticNetParam() > 0.0 and self.getRegParam() > 0.0
+
+    def _raw_features_dtype(self, dataset):
+        """Dtype of the raw user feature container, probed before any
+        float64 coercion (core.data.infer_input_dtype) — the gate for
+        precision='auto' dd routing."""
+        from spark_rapids_ml_tpu.core.data import infer_input_dtype
+
+        if isinstance(dataset, tuple) and len(dataset) == 2:
+            return infer_input_dtype(dataset[0])
+        if isinstance(dataset, DataFrame):
+            return infer_input_dtype(dataset.select(self.getFeaturesCol()))
+        try:
+            import pandas as pd
+
+            if isinstance(dataset, pd.DataFrame):
+                fc = self.getFeaturesCol()
+                if fc in dataset.columns:
+                    return infer_input_dtype(dataset[fc])
+                return infer_input_dtype(
+                    dataset.drop(columns=[self.getLabelCol()], errors="ignore")
+                )
+        except ImportError:  # pragma: no cover
+            pass
+        return infer_input_dtype(dataset)
+
+    def _resolved_precision(self, dataset) -> str:
+        """Resolve the precision request to a concrete mode for this fit.
+        Resolution policy lives in :meth:`RowMatrix.resolve` (the single
+        home); this adds only the estimator-specific dd blockers: explicit
+        ``precision='dd'`` raises on combinations that have no dd route
+        (mesh, weightCol, FISTA); ``'auto'`` quietly falls back to
+        ``'highest'`` for those."""
+        from spark_rapids_ml_tpu.linalg.row_matrix import RowMatrix
+
+        requested = self.getPrecision()
+        # Only "auto" needs the dtype probe; explicit values pass through.
+        input_dtype = (
+            self._raw_features_dtype(dataset) if requested == "auto" else None
+        )
+        resolved = RowMatrix.resolve(
+            requested, mesh=self.mesh, input_dtype=input_dtype
+        )
+        if resolved != "dd":
+            return resolved
+        blockers = []
+        if self.mesh is not None:
+            blockers.append("a mesh (dd is single-device)")
+        if self.getWeightCol() is not None:
+            blockers.append("weightCol")
+        if self._uses_fista():
+            blockers.append("elastic net (FISTA)")
+        if blockers:
+            if requested == "dd":
+                raise ValueError(
+                    "precision='dd' does not support " + ", ".join(blockers)
+                )
+            return "highest"
+        return "dd"
+
+    def _fit_dd(self, block_pairs) -> "LinearRegressionModel":
+        """Extended-precision fit: dd GEMM moments + host fp64 solve."""
+        from spark_rapids_ml_tpu.ops.doubledouble import normal_eq_stats_dd
+
+        with TraceRange("linreg dd fit", TraceColor.DARK_GREEN):
+            xtx, xty, x_sum, y_sum, _, count = normal_eq_stats_dd(block_pairs)
+            coef, intercept = solve_normal_host(
+                xtx,
+                xty,
+                x_sum,
+                y_sum,
+                count,
+                reg_param=self.getRegParam(),
+                fit_intercept=self.getFitIntercept(),
+                standardization=self.getStandardization(),
+            )
+        model = LinearRegressionModel(
+            self.uid, np.asarray(coef, dtype=np.float64), float(intercept)
+        )
+        return self._copyValues(model)
 
     def fit(self, dataset: Any) -> "LinearRegressionModel":
         if self.getElasticNetParam() > 0.0 and self.getSolver() == "normal":
@@ -171,9 +277,18 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
             # their sufficient statistics one block at a time — every solver
             # below consumes only the O(d^2) moments, so device memory is
             # bounded by one block (pairs with native.NpyBlockReader).
+            from itertools import chain
+
+            first = next(iter(streaming), None)
+            if first is None:
+                raise ValueError("no blocks to accumulate")
+            pairs = chain([first], streaming)
+            prec = self._resolved_precision(dataset)
+            if prec == "dd":
+                return self._fit_dd(pairs)
             dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
             with TraceRange("linreg fit", TraceColor.DARK_GREEN):
-                stats = normal_eq_stats_streaming(streaming, dtype=dtype)
+                stats = normal_eq_stats_streaming(pairs, dtype=dtype, precision=prec)
                 coef, intercept = self._solve_from_stats(stats, stats[0].shape[0])
             model = LinearRegressionModel(
                 self.uid, np.asarray(coef, dtype=np.float64), float(intercept)
@@ -182,6 +297,9 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
 
         x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         w_host = extract_weights(dataset, self.getWeightCol())
+        prec = self._resolved_precision(dataset)
+        if prec == "dd":
+            return self._fit_dd([(x_host, y_host)])
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
         with TraceRange("linreg fit", TraceColor.DARK_GREEN):
@@ -200,7 +318,7 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
             if w_host is not None:
                 # The row mask doubles as the per-row weight (padding = 0).
                 mask = weights_as_mask(w_host, xs.shape[0], np.dtype(dtype), self.mesh)
-            stats = normal_eq_stats(xs, ys, mask)
+            stats = normal_eq_stats(xs, ys, mask, precision=prec)
             coef, intercept = self._solve_from_stats(stats, x_host.shape[1])
 
         model = LinearRegressionModel(
@@ -213,8 +331,7 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         the one home of the exact-vs-proximal routing (shared by the
         in-memory, mesh, and streaming fit paths)."""
         xtx, xty, x_sum, y_sum, yty, count = stats
-        enet = self.getElasticNetParam()
-        if enet == 0.0 or self.getRegParam() == 0.0:
+        if not self._uses_fista():
             # Zero effective penalty: the exact (Cholesky) solve, not a
             # fixed-step proximal approximation of the same objective.
             return solve_normal(
@@ -237,7 +354,7 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
             y_sum,
             count,
             reg_param=self.getRegParam(),
-            elastic_net_param=enet,
+            elastic_net_param=self.getElasticNetParam(),
             fit_intercept=self.getFitIntercept(),
             standardization=self.getStandardization(),
         )

@@ -56,12 +56,18 @@ class _PCAParams(HasInputCol, HasOutputCol):
     solver = Param(
         "_", "solver", "auto | covariance | randomized (wide-feature sketch)", toString
     )
+    precision = Param(
+        "_",
+        "precision",
+        "auto | default | high | highest | dd (double-float fp64 emulation)",
+        toString,
+    )
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
         self._setDefault(
             meanCentering=True, useGemm=True, useCuSolverSVD=True, gpuId=-1,
-            solver="auto",
+            solver="auto", precision="auto",
         )
 
     def getK(self) -> int:
@@ -81,6 +87,9 @@ class _PCAParams(HasInputCol, HasOutputCol):
 
     def getSolver(self) -> str:
         return self.getOrDefault(self.solver)
+
+    def getPrecision(self) -> str:
+        return self.getOrDefault(self.precision)
 
 
 class PCA(_PCAParams, Estimator, MLReadable):
@@ -123,6 +132,17 @@ class PCA(_PCAParams, Estimator, MLReadable):
         self.set(self.solver, value)
         return self
 
+    def setPrecision(self, value: str) -> "PCA":
+        """Matmul precision for the covariance path. ``"dd"`` emulates fp64
+        with double-float MXU GEMMs (ops.doubledouble) — the reference's
+        ``double[]`` numerics bar (JniRAPIDSML.java:64-69) on fp32-only
+        hardware; ``"auto"`` selects it when fitting float64 input without
+        x64 support."""
+        from spark_rapids_ml_tpu.ops.linalg import validate_precision
+
+        self.set(self.precision, validate_precision(value))
+        return self
+
     # Above this many features, "auto" switches to the randomized sketch:
     # the (d, d) covariance + full eigh grow as d^2 / d^3 while the sketch
     # stays O(n d l) with l = k + oversample.
@@ -130,6 +150,8 @@ class PCA(_PCAParams, Estimator, MLReadable):
 
     def fit(self, dataset: Any) -> "PCAModel":
         """RapidsPCA.fit (RapidsPCA.scala:111-125)."""
+        from spark_rapids_ml_tpu.core.data import infer_input_dtype
+
         rows = extract_column(dataset, self.getInputCol())
         solver = self.getSolver()
         if solver == "randomized" and self.mesh is not None:
@@ -137,11 +159,43 @@ class PCA(_PCAParams, Estimator, MLReadable):
                 "the randomized solver is single-device; unset the mesh or "
                 "use solver='covariance' (mesh-distributed)"
             )
+        if solver == "randomized" and self.getPrecision() == "dd":
+            raise ValueError(
+                "the randomized solver has no dd path; use "
+                "solver='covariance' with precision='dd'"
+            )
+        # Resolve "auto" against the RAW input dtype (before densification
+        # coerces to float64) so only genuinely-fp64 sources route to dd —
+        # RowMatrix.resolve is the single home of this policy.
+        requested_prec = self.getPrecision()
+        # Probe the container extract_column did NOT already coerce: for a
+        # pandas frame with no inputCol, extract_column densified to
+        # float64, so the probe must look at the original frame.
+        probe_source = rows
+        if requested_prec == "auto" and self.getInputCol() is None:
+            try:
+                import pandas as pd
+
+                if isinstance(dataset, pd.DataFrame):
+                    probe_source = dataset
+            except ImportError:  # pragma: no cover
+                pass
+        resolved_prec = RowMatrix.resolve(
+            requested_prec,
+            mesh=self.mesh,
+            # Only "auto" needs the raw-dtype probe.
+            input_dtype=(
+                infer_input_dtype(probe_source) if requested_prec == "auto" else None
+            ),
+        )
         # 'auto' peeks at the first partition/row only — the covariance
         # path streams partitions, so routing must not force a densify.
+        # An auto-resolved dd forces the covariance path (the sketch is
+        # fp32-only), same as explicit precision='dd'.
         if solver == "randomized" or (
             solver == "auto"
             and self.mesh is None
+            and resolved_prec != "dd"
             and num_features(rows) >= self._RANDOMIZED_AUTO_DIM
         ):
             return self._fit_randomized(rows)
@@ -152,6 +206,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
             use_accel_svd=self.getUseCuSolverSVD(),
             device_id=self.getGpuId(),
             mesh=self.mesh,
+            precision=resolved_prec,
         )
         pc, explained = mat.compute_principal_components_and_explained_variance(self.getK())
         model = PCAModel(self.uid, np.asarray(pc), np.asarray(explained))

@@ -131,8 +131,8 @@ def covariance_dd(x: np.ndarray, chunk: int = 2048) -> Tuple[np.ndarray, np.ndar
     """fp64-emulated sample covariance: returns (mean, cov) as fp64 arrays.
 
     The fp64-on-TPU answer for callers that need the reference's ``double[]``
-    numerics on fp32 hardware (set ``PCA(...).setUseGemm(True)`` paths can
-    route here via ops selection when x64 inputs demand it).
+    numerics on fp32 hardware — PCA and RowMatrix route here when
+    ``precision="dd"`` is requested or auto-selected for float64 input.
     """
     x = np.asarray(x, dtype=np.float64)
     if x.shape[0] < 2:
@@ -140,3 +140,129 @@ def covariance_dd(x: np.ndarray, chunk: int = 2048) -> Tuple[np.ndarray, np.ndar
     mean = x.mean(axis=0)
     gram = centered_gram_dd(x, mean, chunk=chunk)
     return mean, gram / (x.shape[0] - 1)
+
+
+def covariance_dd_blocks(
+    partitions, center: bool = True, chunk: int = 2048
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """ONE-pass streaming dd covariance over host blocks (list, tuple, or
+    generator — each block is visited exactly once, so device and host
+    memory stay bounded by one block).
+
+    The exact column mean is not known until the stream ends, so blocks are
+    centered on the FIRST block's column means (exact host-fp64 subtract —
+    the shifted-accumulation scheme of the native Kahan runtime,
+    native/src/tpuml_host.cpp), the shifted Gram accumulates through
+    extended-precision GEMMs, and the closed-form correction
+    ``Σx̃ᵀx̃ − n·δδᵀ`` (δ = mean of shifted values) recovers the true
+    centered Gram. Shift error never touches the large raw magnitudes, so
+    the dd error floor holds even for means ≫ stddevs. Returns
+    ``(mean, cov, n)`` with cov normalized by (n − 1) — the RowMatrix
+    contract (RapidsRowMatrix.scala:168-201, per-partition compute +
+    cross-partition reduce).
+    """
+    shift = None
+    gram = s = None
+    n = 0
+    for part in partitions:
+        p = np.asarray(part, dtype=np.float64)
+        if p.shape[0] == 0:
+            continue
+        if shift is None:
+            shift = p.mean(axis=0) if center else np.zeros(p.shape[1])
+        ps = p - shift
+        partial = centered_gram_dd(ps, np.zeros_like(shift), chunk=chunk)
+        gram = partial if gram is None else gram + partial
+        sb = ps.sum(axis=0)
+        s = sb if s is None else s + sb
+        n += p.shape[0]
+    if n < 2:
+        raise ValueError(f"need at least 2 rows to compute a covariance, got {n}")
+    delta = s / n  # true mean in shifted coordinates
+    mean = shift + delta
+    if center:
+        gram = gram - n * np.outer(delta, delta)
+    else:
+        # Raw second moment: undo the shift in exact fp64 closed form.
+        gram = (
+            gram
+            + np.outer(s, shift)
+            + np.outer(shift, s)
+            + n * np.outer(shift, shift)
+        )
+    return mean, gram / (n - 1), n
+
+
+def normal_eq_stats_dd(block_pairs, chunk: int = 2048):
+    """Extended-precision normal-equation sufficient statistics over an
+    iterable of (X, y) host blocks, in ONE streaming pass.
+
+    Returns ``(xtx, xty, x_sum, y_sum, yty, count)`` as fp64 arrays — the
+    same raw-moment tuple contract as ``ops.linear.normal_eq_stats``, at the
+    reference's ``double[]`` numerics bar (JniRAPIDSML.java:64-69).
+
+    The accelerator GEMMs run on SHIFTED values (x − shift, with shift = the
+    first block's column means, subtracted in exact host fp64): a dd GEMM of
+    raw ill-conditioned data (means ≫ stddevs) would put its f32-eps
+    *relative* error on the huge raw moments, which the solver's centering
+    subtraction then amplifies catastrophically. Shifting keeps the GEMM
+    operands O(std), and the raw moments are reconstructed from the shifted
+    ones by closed-form fp64 outer-product corrections (the shifted-
+    accumulation scheme of the native Kahan ``spr`` runtime,
+    native/src/tpuml_host.cpp).
+    """
+    shift = None  # (d,) first-block column means
+    y_shift = 0.0
+    g = v = s = None  # shifted: Σx̃ᵀx̃ (dd), Σx̃ᵀỹ (dd), Σx̃ (fp64)
+    sy = syy = 0.0  # Σỹ, Σỹ²
+    count = 0
+    for xb, yb in block_pairs:
+        x = np.asarray(xb, dtype=np.float64)
+        y = np.asarray(yb, dtype=np.float64).ravel()
+        if x.shape[0] != y.shape[0]:
+            raise ValueError(
+                f"block rows mismatch: X has {x.shape[0]}, y has {y.shape[0]}"
+            )
+        if x.shape[0] == 0:
+            continue
+        if shift is None:
+            shift = x.mean(axis=0)
+            y_shift = float(y.mean())
+        # One dd Gram of [x̃ | ỹ] per block: its top-left d x d is Σx̃ᵀx̃,
+        # last column Σx̃ᵀỹ, corner Σỹ² — one device dispatch instead of
+        # separate XᵀX / Xᵀy scans (and one jit specialization per shape).
+        z = np.concatenate([x - shift, (y - y_shift)[:, None]], axis=1)
+        z_hi, z_lo = split_f64(z)
+        zt_hi = np.ascontiguousarray(z_hi.T)
+        zt_lo = np.ascontiguousarray(z_lo.T)
+        g_hi, g_lo = matmul_dd(
+            jnp.asarray(zt_hi), jnp.asarray(zt_lo),
+            jnp.asarray(z_hi), jnp.asarray(z_lo), chunk=chunk,
+        )
+        g_blk = dd_to_f64(g_hi, g_lo)
+        d = z.shape[1] - 1
+        g = g_blk[:d, :d] if g is None else g + g_blk[:d, :d]
+        v = g_blk[:d, d] if v is None else v + g_blk[:d, d]
+        s_blk = z[:, :d].sum(axis=0)
+        s = s_blk if s is None else s + s_blk
+        sy += float(z[:, d].sum())
+        # Σỹ² stays exact host fp64 (O(n) — no reason to take the dd floor).
+        syy += float(np.dot(z[:, d], z[:, d]))
+        count += x.shape[0]
+    if count == 0:
+        raise ValueError("no rows to accumulate")
+    n = float(count)
+    # Undo the shift in closed form (exact fp64 outer products; the shift
+    # terms cancel identically when the solver re-centers, so no f32-level
+    # error ever lands on the large raw magnitudes).
+    xtx = (
+        g
+        + np.outer(s, shift)
+        + np.outer(shift, s)
+        + n * np.outer(shift, shift)
+    )
+    xty = v + y_shift * s + sy * shift + n * y_shift * shift
+    x_sum = s + n * shift
+    y_sum = sy + n * y_shift
+    yty = syy + 2.0 * y_shift * sy + n * y_shift * y_shift
+    return xtx, xty, x_sum, np.float64(y_sum), np.float64(yty), np.float64(count)

@@ -33,6 +33,39 @@ def _dot_precision(precision: str):
     }[precision]
 
 
+PRECISIONS = ("auto", "default", "high", "highest", "dd")
+
+
+def validate_precision(value: str) -> str:
+    """Shared setter-side validation for the user-facing precision params."""
+    if value not in PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {'/'.join(PRECISIONS)}, got {value!r}"
+        )
+    return value
+
+
+def resolve_precision(requested: str, input_dtype=None, x64_enabled=None) -> str:
+    """Resolve a user-facing precision request to a concrete mode.
+
+    ``"auto"`` picks ``"dd"`` (double-float fp64 emulation,
+    ops.doubledouble) when the input carries fp64 data but the platform
+    cannot compute in fp64 (x64 disabled — the real-TPU case), matching the
+    reference's all-``double[]`` JNI numerics (JniRAPIDSML.java:64-69);
+    otherwise ``"highest"``. Explicit requests pass through unchanged.
+    """
+    if requested not in PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {'/'.join(PRECISIONS)}, got {requested!r}"
+        )
+    if requested != "auto":
+        return requested
+    if x64_enabled is None:
+        x64_enabled = bool(jax.config.jax_enable_x64)
+    wants_f64 = input_dtype is not None and np.dtype(input_dtype) == np.float64
+    return "dd" if (wants_f64 and not x64_enabled) else "highest"
+
+
 @partial(jax.jit, static_argnames=("precision",))
 def gemm_syrk(b: jax.Array, precision: str = "highest") -> jax.Array:
     """C = BᵀB for row-major B (rows, cols) -> (cols, cols).

@@ -198,6 +198,58 @@ def solve_elastic_net(
     return coef, intercept, n_iter
 
 
+def solve_normal_host(
+    xtx,
+    xty,
+    x_sum,
+    y_sum,
+    count,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+):
+    """Host fp64 twin of :func:`solve_normal` — same math, NumPy/LAPACK.
+
+    The dd precision path accumulates its sufficient statistics as exact
+    fp64 (ops.doubledouble.normal_eq_stats_dd); solving them through the
+    jitted fp32 path would throw that precision away on a no-x64 platform,
+    so the O(d^3) solve runs on the host in fp64 (the reference's
+    driver-side breeze/LAPACK position, RapidsRowMatrix.scala:110-123).
+    """
+    import numpy as np
+
+    xtx = np.asarray(xtx, dtype=np.float64)
+    xty = np.asarray(xty, dtype=np.float64)
+    x_sum = np.asarray(x_sum, dtype=np.float64)
+    n = float(count)
+    x_mean = x_sum / n
+    y_mean = float(y_sum) / n
+    if fit_intercept:
+        a = xtx - n * np.outer(x_mean, x_mean)
+        b = xty - n * x_mean * y_mean
+    else:
+        a = xtx
+        b = xty
+    if standardization:
+        var = np.maximum(
+            (np.diag(xtx) - n * x_mean * x_mean) / max(n - 1.0, 1.0), 0.0
+        )
+    else:
+        var = np.ones(a.shape[0], dtype=np.float64)
+    a_reg = a + (n * reg_param) * np.diag(var)
+    try:
+        coef = np.linalg.solve(a_reg, b)
+        if not np.all(np.isfinite(coef)):
+            raise np.linalg.LinAlgError
+    except np.linalg.LinAlgError:
+        w, v = np.linalg.eigh(a_reg)
+        tol = np.max(np.abs(w)) * a.shape[0] * np.finfo(np.float64).eps
+        w_inv = np.where(w > tol, 1.0 / np.where(w > tol, w, 1.0), 0.0)
+        coef = v @ (w_inv * (v.T @ b))
+    intercept = (y_mean - float(np.dot(x_mean, coef))) if fit_intercept else 0.0
+    return coef, intercept
+
+
 def normal_eq_stats_streaming(block_pairs, dtype=None, precision: str = "highest"):
     """Accumulate the sufficient statistics over an ITERABLE of (X, y)
     blocks — the streaming form of :func:`normal_eq_stats`.

@@ -108,3 +108,24 @@ class TestPipeline:
         meta_file.write_text(json.dumps(meta) + "\n")
         with pytest.raises(ValueError, match="refusing to load"):
             Pipeline.load(path)
+
+    def test_allow_persisted_package_escape_hatch(self):
+        """Extension libraries register their root package to make their
+        custom stages loadable (the restriction is a default, not a wall)."""
+        from spark_rapids_ml_tpu.core.persistence import (
+            _LOADABLE_PACKAGES,
+            allow_persisted_package,
+            resolve_persisted_class,
+        )
+
+        with pytest.raises(ValueError, match="refusing to import"):
+            resolve_persisted_class("collections.OrderedDict")
+        allow_persisted_package("collections")
+        try:
+            import collections
+
+            assert resolve_persisted_class("collections.OrderedDict") is collections.OrderedDict
+        finally:
+            _LOADABLE_PACKAGES.discard("collections")
+        with pytest.raises(ValueError, match="bare top-level"):
+            allow_persisted_package("a.b")

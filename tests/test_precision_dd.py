@@ -1,0 +1,291 @@
+"""Precision-routing tests: the ``precision`` param on PCA/LinearRegression
+and the dd (double-float fp64-emulation) fit paths.
+
+The accuracy bar is the reference's all-``double[]`` JNI numerics
+(JniRAPIDSML.java:64-69) checked at the PCASuite 1e-5 absolute tolerance
+(PCASuite.scala:71), on ILL-CONDITIONED input (column means >> stddevs) where
+a plain fp32 pipeline visibly fails: casting x to f32 before centering rounds
+away the signal that dd centering (host fp64) + double-float GEMM keep.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.linalg.row_matrix import RowMatrix
+from spark_rapids_ml_tpu.ops.doubledouble import (
+    covariance_dd_blocks,
+    normal_eq_stats_dd,
+)
+from spark_rapids_ml_tpu.ops.linalg import resolve_precision
+from spark_rapids_ml_tpu.regression import LinearRegression
+
+
+def _ill_conditioned(rng, n=20_000, d=8, mean_scale=1e4):
+    """Columns with huge means and O(1) signal — fp32's nemesis."""
+    stds = np.linspace(1.0, 2.0, d)
+    means = mean_scale * (1.0 + np.arange(d, dtype=np.float64))
+    return means + stds * rng.normal(size=(n, d))
+
+
+class TestResolvePrecision:
+    def test_auto_routes_dd_only_for_f64_without_x64(self):
+        assert resolve_precision("auto", np.float64, x64_enabled=False) == "dd"
+        assert resolve_precision("auto", np.float64, x64_enabled=True) == "highest"
+        assert resolve_precision("auto", np.float32, x64_enabled=False) == "highest"
+        assert resolve_precision("auto", None, x64_enabled=False) == "highest"
+
+    def test_explicit_passthrough(self):
+        for p in ("default", "high", "highest", "dd"):
+            assert resolve_precision(p, np.float32, x64_enabled=False) == p
+
+    def test_invalid_rejected(self):
+        with pytest.raises(ValueError, match="precision"):
+            resolve_precision("fp64", np.float64)
+
+    def test_infer_input_dtype_sees_raw_container(self, rng):
+        """The auto gate must observe the dtype BEFORE densification
+        coerces to float64 (r2 review: the gate was dead code otherwise)."""
+        from spark_rapids_ml_tpu.core.data import Vectors, infer_input_dtype
+
+        x32 = rng.normal(size=(4, 3)).astype(np.float32)
+        assert infer_input_dtype(x32) == np.float32
+        assert infer_input_dtype(x32.astype(np.float64)) == np.float64
+        assert infer_input_dtype(list(x32)) == np.float32  # list of f32 rows
+        assert infer_input_dtype([x32, x32]) == np.float32  # list of blocks
+        assert infer_input_dtype(Vectors.dense(1.0, 2.0)) == np.float64
+        assert infer_input_dtype([0.5, 1.5]) == np.float64  # python floats
+        assert infer_input_dtype(iter([x32])) is None  # opaque iterator
+        # Integer/bool data is never "genuinely double" — must not route dd.
+        assert infer_input_dtype(np.ones((3, 2), dtype=np.int32)) is None
+        assert infer_input_dtype(np.ones((3, 2), dtype=bool)) is None
+
+    def test_pandas_extension_dtypes_do_not_crash(self, rng):
+        """pandas extension dtypes (Float64Dtype etc.) are not numpy dtypes
+        — the probe must classify, not crash (r2 review)."""
+        pd = pytest.importorskip("pandas")
+        from spark_rapids_ml_tpu.core.data import infer_input_dtype
+
+        df = pd.DataFrame(
+            {"a": pd.array([1.0, 2.0], dtype="Float64"), "label": [1.0, 2.0]}
+        )
+        assert infer_input_dtype(df) == np.float64
+        assert infer_input_dtype(df["a"]) == np.float64
+        f32 = pd.DataFrame({"a": np.ones(3, dtype=np.float32)})
+        assert infer_input_dtype(f32) == np.float32
+        # End to end: a fit on an extension-dtype frame must not crash.
+        big = pd.DataFrame(
+            {
+                "label": rng.normal(size=50),
+                "f0": pd.array(rng.normal(size=50), dtype="Float64"),
+                "f1": rng.normal(size=50),
+            }
+        )
+        model = LinearRegression().setLabelCol("label").fit(big)
+        assert np.all(np.isfinite(model.coefficients))
+
+    def test_rowmatrix_auto_with_mesh_defers_to_mesh_path(self, rng):
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        x = rng.normal(size=(64, 4))
+        rm = RowMatrix([x], mesh=make_mesh(), precision="auto")
+        assert rm.precision == "highest"  # no raise, no dd
+
+    def test_rowmatrix_auto_without_hint_stays_highest(self, rng):
+        # partitions are float64 post-coercion; without a raw-input dtype
+        # hint, auto must NOT take that as evidence for dd routing.
+        x = rng.normal(size=(16, 3)).astype(np.float32)
+        assert RowMatrix([x], precision="auto").precision == "highest"
+
+
+class TestCovarianceDD:
+    def test_blocks_match_fp64_oracle_where_fp32_fails(self, rng):
+        x = _ill_conditioned(rng)
+        oracle = np.cov(x, rowvar=False)
+        blocks = [x[:7000], x[7000:15000], x[15000:]]
+
+        _, cov_dd, n = covariance_dd_blocks(blocks)
+        assert n == x.shape[0]
+        err_dd = np.max(np.abs(cov_dd - oracle))
+        assert err_dd < 1e-5  # the PCASuite absTol bar
+
+        # The same computation at fp32 (cast-then-center, what a no-x64
+        # device pipeline does) misses the bar — dd is necessary, not
+        # decorative.
+        cov_f32 = np.asarray(
+            RowMatrix(blocks, dtype=jnp.float32).compute_covariance()
+        )
+        err_f32 = np.max(np.abs(cov_f32 - oracle))
+        assert err_f32 > 10 * err_dd
+
+    def test_no_centering(self, rng):
+        x = rng.normal(size=(500, 4)) + 50.0
+        _, second_moment, _ = covariance_dd_blocks([x], center=False)
+        oracle = x.T @ x / (x.shape[0] - 1)
+        # dd's floor is a few f32 eps RELATIVE (the intra-chunk matmul
+        # rounding) — on O(2500) second moments that is ~1e-3 absolute.
+        np.testing.assert_allclose(second_moment, oracle, rtol=1e-6)
+
+    def test_too_few_rows(self):
+        with pytest.raises(ValueError, match="at least 2 rows"):
+            covariance_dd_blocks([np.ones((1, 3))])
+
+    def test_generator_input_single_pass(self, rng):
+        """Blocks may come from a one-shot generator (NpyBlockReader
+        style) — the covariance is a single streaming pass."""
+        x = _ill_conditioned(rng, n=6_000, d=5)
+        oracle = np.cov(x, rowvar=False)
+        gen = (x[i : i + 1024] for i in range(0, 6_000, 1024))
+        mean, cov, n = covariance_dd_blocks(gen)
+        assert n == 6_000
+        np.testing.assert_allclose(mean, x.mean(axis=0), rtol=1e-12)
+        assert np.max(np.abs(cov - oracle)) < 1e-5
+
+
+class TestPCAPrecisionDD:
+    def test_ill_conditioned_fit_matches_fp64_oracle(self, rng):
+        x = _ill_conditioned(rng, n=10_000)
+        model = PCA().setK(3).setPrecision("dd").fit(x)
+
+        cov = np.cov(x, rowvar=False)
+        w, v = np.linalg.eigh(cov)
+        w, v = w[::-1], v[:, ::-1]
+        for j in range(3):
+            ref = v[:, j] * np.sign(v[np.argmax(np.abs(v[:, j])), j])
+            np.testing.assert_allclose(model.pc[:, j], ref, atol=1e-5)
+        np.testing.assert_allclose(
+            model.explainedVariance[:3], (w / w.sum())[:3], atol=1e-5
+        )
+
+    def test_dd_rejects_randomized_solver(self):
+        with pytest.raises(ValueError, match="dd"):
+            PCA().setK(2).setPrecision("dd").setSolver("randomized")\
+                .fit(np.ones((10, 4)))
+
+    def test_dd_rejects_mesh(self, rng):
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        x = rng.normal(size=(64, 4))
+        with pytest.raises(ValueError, match="single-device"):
+            PCA(mesh=make_mesh()).setK(2).setPrecision("dd").fit(x)
+
+    def test_invalid_precision_rejected(self):
+        with pytest.raises(ValueError, match="precision"):
+            PCA().setPrecision("quad")
+
+    def test_pandas_frame_without_inputcol_probes_raw_frame(self, rng):
+        """extract_column coerces a no-inputCol pandas frame to float64;
+        the auto probe must look at the ORIGINAL frame (r2 review)."""
+        pd = pytest.importorskip("pandas")
+        df32 = pd.DataFrame(rng.normal(size=(64, 4)).astype(np.float32))
+        model = PCA().setK(2).fit(df32)  # must not crash, auto-resolve runs
+        assert model.pc.shape == (4, 2)
+
+
+class TestLinearRegressionDD:
+    def test_ill_conditioned_fit_matches_lstsq(self, rng):
+        n, d = 20_000, 6
+        x = _ill_conditioned(rng, n=n, d=d)
+        beta = np.linspace(-1.0, 1.0, d)
+        y = x @ beta + 3.0 + 0.01 * rng.normal(size=n)
+
+        model = LinearRegression().setPrecision("dd").fit((x, y))
+
+        xi = np.concatenate([x, np.ones((n, 1))], axis=1)
+        ref = np.linalg.lstsq(xi, y, rcond=None)[0]
+        np.testing.assert_allclose(model.coefficients, ref[:d], atol=1e-5)
+        # The intercept absorbs mean_scale * beta errors; at column means of
+        # ~1e4 a 1e-5 coefficient bar corresponds to ~1e-1 here.
+        np.testing.assert_allclose(model.intercept, ref[d], atol=1e-1)
+
+    def test_streaming_blocks_equal_dense(self, rng):
+        x = _ill_conditioned(rng, n=5_000, d=5)
+        beta = np.arange(1.0, 6.0)
+        y = x @ beta + 0.1 * rng.normal(size=5_000)
+        dense = LinearRegression().setPrecision("dd").fit((x, y))
+        blocks = [x[:2000], x[2000:3500], x[3500:]]
+        streamed = LinearRegression().setPrecision("dd").fit((blocks, y))
+        # Different block splits shift by different first-block means, so
+        # the two fits agree to the dd error floor, not bit-exactly.
+        np.testing.assert_allclose(
+            streamed.coefficients, dense.coefficients, atol=1e-5
+        )
+        assert streamed.intercept == pytest.approx(dense.intercept, abs=1e-1)
+
+    def test_ridge_dd(self, rng):
+        """dd covers the exact normal solve including L2."""
+        x = _ill_conditioned(rng, n=3_000, d=4, mean_scale=1e3)
+        y = x @ np.ones(4) + rng.normal(size=3_000)
+        m_dd = LinearRegression().setPrecision("dd").setRegParam(0.1).fit((x, y))
+        m_hi = LinearRegression().setPrecision("highest").setRegParam(0.1).fit((x, y))
+        # x64 is on in tests, so "highest" computes in true fp64 — the dd
+        # emulation must land on the same ridge solution.
+        np.testing.assert_allclose(m_dd.coefficients, m_hi.coefficients, atol=1e-5)
+
+    def test_explicit_dd_rejects_unsupported(self, rng):
+        x = rng.normal(size=(50, 3))
+        y = x.sum(axis=1)
+        from spark_rapids_ml_tpu.core.data import DataFrame
+
+        df = DataFrame(
+            {"features": list(x), "label": list(y), "w": [1.0] * 50}
+        )
+        with pytest.raises(ValueError, match="weightCol"):
+            LinearRegression().setPrecision("dd").setWeightCol("w").fit(df)
+        with pytest.raises(ValueError, match="FISTA|elastic"):
+            LinearRegression().setPrecision("dd").setRegParam(0.1)\
+                .setElasticNetParam(0.5).fit((x, y))
+
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        with pytest.raises(ValueError, match="mesh"):
+            LinearRegression(mesh=make_mesh()).setPrecision("dd").fit((x, y))
+
+    def test_invalid_precision_rejected(self):
+        with pytest.raises(ValueError, match="precision"):
+            LinearRegression().setPrecision("exact")
+
+    def test_non_dd_precision_reaches_the_gemm(self, rng, monkeypatch):
+        """setPrecision('default'/'high') must thread into the stats GEMMs,
+        not be validated-then-ignored (r2 review)."""
+        import spark_rapids_ml_tpu.models.linear_regression as lr_mod
+
+        seen = {}
+        real = lr_mod.normal_eq_stats
+
+        def spy(x, y, mask, precision="highest"):
+            seen["precision"] = precision
+            return real(x, y, mask, precision=precision)
+
+        monkeypatch.setattr(lr_mod, "normal_eq_stats", spy)
+        x = rng.normal(size=(100, 3))
+        y = x.sum(axis=1)
+        LinearRegression().setPrecision("default").fit((x, y))
+        assert seen["precision"] == "default"
+        LinearRegression().setPrecision("high").fit((x, y))
+        assert seen["precision"] == "high"
+
+
+class TestNormalEqStatsDD:
+    def test_moments_match_fp64(self, rng):
+        x = _ill_conditioned(rng, n=4_000, d=5)
+        y = rng.normal(size=4_000) + 100.0
+        xtx, xty, x_sum, y_sum, yty, count = normal_eq_stats_dd(
+            [(x[:1500], y[:1500]), (x[1500:], y[1500:])]
+        )
+        assert count == 4_000
+        np.testing.assert_allclose(xtx, x.T @ x, rtol=1e-7)
+        np.testing.assert_allclose(xty, x.T @ y, rtol=1e-7)
+        np.testing.assert_allclose(x_sum, x.sum(axis=0), rtol=1e-12)
+        assert y_sum == pytest.approx(y.sum(), rel=1e-12)
+        assert yty == pytest.approx(np.dot(y, y), rel=1e-12)
+
+    def test_row_mismatch_raises(self, rng):
+        with pytest.raises(ValueError, match="mismatch"):
+            normal_eq_stats_dd([(np.ones((4, 2)), np.ones(3))])
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError, match="no rows"):
+            normal_eq_stats_dd([])
