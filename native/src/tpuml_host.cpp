@@ -429,6 +429,25 @@ int32_t tpuml_npy_read_block(void* handle, int64_t start_row, int64_t n_rows,
   return 0;
 }
 
+int32_t tpuml_npy_release(void* handle, int64_t start_row, int64_t n_rows) {
+  // Drop consumed pages from this mapping (MADV_DONTNEED) so a full-file
+  // streaming pass keeps RESIDENT memory bounded by ~one block instead of
+  // accreting the whole file: the constant-memory contract of the block
+  // reader. Rounded INWARD so pages shared with a neighboring block that
+  // may still be in flight are never dropped.
+  if (!handle) return -1;
+  auto* f = static_cast<NpyFile*>(handle);
+  if (start_row < 0 || n_rows <= 0 || start_row >= f->rows) return -1;
+  n_rows = std::min<int64_t>(n_rows, f->rows - start_row);
+  size_t off = f->data_off + static_cast<size_t>(start_row) * f->row_bytes;
+  size_t end = off + static_cast<size_t>(n_rows) * f->row_bytes;
+  size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
+  size_t lo = ((off + page - 1) / page) * page;
+  size_t hi = (end / page) * page;
+  if (hi > lo) madvise(f->map + lo, hi - lo, MADV_DONTNEED);
+  return 0;
+}
+
 void tpuml_npy_close(void* handle) {
   if (!handle) return;
   auto* f = static_cast<NpyFile*>(handle);

@@ -118,6 +118,13 @@ def infer_input_dtype(data: Any):
         return np.float64
     if isinstance(data, float):
         return np.float64
+    if callable(getattr(data, "iter_blocks", None)) and hasattr(data, "dtype"):
+        # Block-reader objects (e.g. native.NpyBlockReader) know their dtype.
+        try:
+            dt = np.dtype(data.dtype)
+        except TypeError:
+            return None
+        return dt if np.issubdtype(dt, np.floating) else None
     try:
         import pandas as pd
 
@@ -278,6 +285,38 @@ def _is_block(obj: Any) -> bool:
     if _sp is not None and _sp.issparse(obj):
         return True
     return False
+
+
+def is_streaming_source(data: Any) -> bool:
+    """True for inputs that stream blocks instead of materializing: a block
+    iterator/generator (one-shot), a block-reader object exposing
+    ``iter_blocks`` (re-iterable, e.g. ``native.NpyBlockReader``), or a
+    zero-arg callable returning a block iterator (an iterator factory).
+    These fit at constant memory — one block resident at a time — via the
+    estimators' one-pass shifted accumulation paths."""
+    from collections.abc import Iterator
+
+    if isinstance(data, Iterator):
+        return True
+    if callable(getattr(data, "iter_blocks", None)):
+        return True
+    if callable(data) and not isinstance(data, type):
+        return True
+    return False
+
+
+def iter_stream_blocks(data: Any):
+    """Normalize a streaming source (see :func:`is_streaming_source`) to a
+    fresh iterator of raw blocks."""
+    from collections.abc import Iterator
+
+    if isinstance(data, Iterator):
+        return data
+    if callable(getattr(data, "iter_blocks", None)):
+        return data.iter_blocks()
+    if callable(data):
+        return iter(data())
+    raise TypeError(f"not a streaming block source: {type(data).__name__}")
 
 
 def as_matrix(data: Any) -> np.ndarray:

@@ -21,10 +21,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import as_partitions
+from spark_rapids_ml_tpu.core.data import (
+    as_partitions,
+    is_streaming_source,
+    iter_stream_blocks,
+)
 from spark_rapids_ml_tpu.ops.covariance import (
     centered_gram,
     centered_gram_packed,
+    streaming_mean_and_covariance,
     welford_add_block,
     welford_init,
 )
@@ -57,7 +62,17 @@ class RowMatrix:
         dtype=None,
         input_dtype=None,
     ):
-        self.partitions: List[np.ndarray] = as_partitions(rows)
+        # Streaming sources (block iterators / readers / iterator
+        # factories) are never materialized: the covariance runs as a
+        # one-pass shifted accumulation at constant memory — the
+        # reference's streamed mapPartitions contract
+        # (RapidsRowMatrix.scala:170).
+        if is_streaming_source(rows):
+            self.partitions: Optional[List[np.ndarray]] = None
+            self._stream = rows
+        else:
+            self.partitions = as_partitions(rows)
+            self._stream = None
         self.mean_centering = mean_centering
         self.use_gemm = use_gemm
         self.use_accel_svd = use_accel_svd
@@ -71,6 +86,7 @@ class RowMatrix:
             )
         self._dtype = dtype
         self._num_rows: Optional[int] = None
+        self._num_cols: Optional[int] = None
 
     @staticmethod
     def resolve(precision: str, mesh=None, input_dtype=None) -> str:
@@ -92,11 +108,21 @@ class RowMatrix:
     @property
     def num_rows(self) -> int:
         if self._num_rows is None:
+            if self.partitions is None:
+                raise RuntimeError(
+                    "streaming input: shape is unknown until a fit pass runs"
+                )
             self._num_rows = sum(p.shape[0] for p in self.partitions)
         return self._num_rows
 
     @property
     def num_cols(self) -> int:
+        if self.partitions is None:
+            if self._num_cols is None:
+                raise RuntimeError(
+                    "streaming input: shape is unknown until a fit pass runs"
+                )
+            return self._num_cols
         return self.partitions[0].shape[1]
 
     @property
@@ -123,6 +149,8 @@ class RowMatrix:
     # --- covariance (computeCovariance, :149-257) ---
 
     def compute_covariance(self) -> jnp.ndarray:
+        if self.partitions is None:
+            return self._covariance_streaming()
         n = self.num_rows
         if n < 2:
             raise ValueError(f"need at least 2 rows, got {n}")
@@ -187,6 +215,41 @@ class RowMatrix:
         full = triu_to_full(acc)
         return full / (self.num_rows - 1)
 
+    def _covariance_streaming(self) -> jnp.ndarray:
+        """Constant-memory covariance over a streaming block source: one
+        pass, one block resident at a time (shifted accumulation). Records
+        the shape discovered during the pass."""
+        if self.mesh is not None:
+            raise ValueError(
+                "streaming input has no mesh path; pass materialized "
+                "blocks for a mesh-distributed fit"
+            )
+        blocks = iter_stream_blocks(self._stream)
+        with TraceRange("compute cov (stream)", TraceColor.RED):
+            if self.precision == "dd":
+                from spark_rapids_ml_tpu.ops.doubledouble import (
+                    covariance_dd_blocks,
+                )
+
+                _, cov, n = covariance_dd_blocks(
+                    blocks, center=self.mean_centering
+                )
+                self._num_rows = int(n)
+                self._num_cols = int(cov.shape[0])
+                # Keep the exact-fp64 host array: casting to the device
+                # dtype (fp32 on no-x64 platforms) before the host
+                # eigensolve would throw away the dd accuracy.
+                return cov
+            _, cov, n = streaming_mean_and_covariance(
+                blocks,
+                center=self.mean_centering,
+                dtype=self.dtype,
+                precision=self.precision,
+            )
+        self._num_rows = int(n)
+        self._num_cols = int(cov.shape[0])
+        return jnp.asarray(cov, dtype=self.dtype)
+
     def _covariance_dd(self) -> np.ndarray:
         """Double-float fp64-emulated covariance (ops.doubledouble): the
         reference's ``double[]`` numerics (JniRAPIDSML.java:64-69) on fp32
@@ -217,10 +280,17 @@ class RowMatrix:
     def compute_principal_components_and_explained_variance(
         self, k: int
     ) -> Tuple[np.ndarray, np.ndarray]:
-        n_cols = self.num_cols
-        if not 1 <= k <= n_cols:
-            raise ValueError(f"k must be in [1, {n_cols}], got {k}")
+        if self.partitions is not None:
+            # Validate k before the expensive pass when the shape is known;
+            # a streaming source only learns d during the pass itself.
+            n_cols = self.num_cols
+            if not 1 <= k <= n_cols:
+                raise ValueError(f"k must be in [1, {n_cols}], got {k}")
         cov = self.compute_covariance()
+        n_cols = self.num_cols
+        if self.partitions is None and not 1 <= k <= n_cols:
+            # Streaming sources only learn d during the pass itself.
+            raise ValueError(f"k must be in [1, {n_cols}], got {k}")
         if self.precision == "dd":
             # The covariance is exact-fp64 host data; a device eigensolve
             # would round it to fp32 on a no-x64 platform. Host LAPACK

@@ -277,18 +277,16 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
             # their sufficient statistics one block at a time — every solver
             # below consumes only the O(d^2) moments, so device memory is
             # bounded by one block (pairs with native.NpyBlockReader).
-            from itertools import chain
-
-            first = next(iter(streaming), None)
-            if first is None:
-                raise ValueError("no blocks to accumulate")
-            pairs = chain([first], streaming)
+            # Precision resolution probes the dataset container, never the
+            # stream, so the generator passes through unconsumed.
             prec = self._resolved_precision(dataset)
             if prec == "dd":
-                return self._fit_dd(pairs)
+                return self._fit_dd(streaming)
             dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
             with TraceRange("linreg fit", TraceColor.DARK_GREEN):
-                stats = normal_eq_stats_streaming(pairs, dtype=dtype, precision=prec)
+                stats = normal_eq_stats_streaming(
+                    streaming, dtype=dtype, precision=prec
+                )
                 coef, intercept = self._solve_from_stats(stats, stats[0].shape[0])
             model = LinearRegressionModel(
                 self.uid, np.asarray(coef, dtype=np.float64), float(intercept)
@@ -372,17 +370,20 @@ def _streaming_blocks(dataset):
     one entry per block — both mismatches raise instead of silently
     truncating.
     """
-    from collections.abc import Iterator
-
-    from spark_rapids_ml_tpu.core.data import _block_to_dense, _is_block
+    from spark_rapids_ml_tpu.core.data import (
+        _block_to_dense,
+        _is_block,
+        is_streaming_source,
+        iter_stream_blocks,
+    )
 
     if not (isinstance(dataset, tuple) and len(dataset) == 2):
         return None
     x, y = dataset
     if isinstance(x, (list, tuple)) and x and _is_block(x[0]):
         blocks = iter(x)
-    elif isinstance(x, Iterator):
-        blocks = x
+    elif is_streaming_source(x):
+        blocks = iter_stream_blocks(x)
     else:
         return None
 

@@ -150,10 +150,16 @@ class PCA(_PCAParams, Estimator, MLReadable):
 
     def fit(self, dataset: Any) -> "PCAModel":
         """RapidsPCA.fit (RapidsPCA.scala:111-125)."""
-        from spark_rapids_ml_tpu.core.data import infer_input_dtype
+        from spark_rapids_ml_tpu.core.data import infer_input_dtype, is_streaming_source
 
         rows = extract_column(dataset, self.getInputCol())
         solver = self.getSolver()
+        streaming = is_streaming_source(rows)
+        if solver == "randomized" and streaming:
+            raise ValueError(
+                "the randomized solver needs materialized input; use "
+                "solver='covariance' for streaming block sources"
+            )
         if solver == "randomized" and self.mesh is not None:
             raise ValueError(
                 "the randomized solver is single-device; unset the mesh or "
@@ -196,6 +202,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
             solver == "auto"
             and self.mesh is None
             and resolved_prec != "dd"
+            and not streaming  # a stream cannot be peeked or materialized
             and num_features(rows) >= self._RANDOMIZED_AUTO_DIM
         ):
             return self._fit_randomized(rows)

@@ -260,11 +260,13 @@ class NpyBlockReader:
     The mmap + madvise readahead lives in C++ (``tpuml_npy_*``): the OS page
     cache double-buffers, :meth:`iter_blocks` warms the NEXT block while
     yielding the current one, and each read is one memcpy out of the
-    mapping. Blocks are plain ``(rows, d)`` ndarrays, so a reader feeds any
-    estimator as the list-of-partitions (RDD-analogue) input:
+    mapping. Blocks are plain ``(rows, d)`` ndarrays. Pass the READER (or
+    its block generator) straight to an estimator for a constant-memory
+    fit — one block resident at a time, never the whole file:
 
         reader = NpyBlockReader("data.npy", block_rows=1 << 20)
-        PCA().setK(8).fit(list(reader.iter_blocks()))
+        PCA().setK(8).fit(reader)                       # constant memory
+        LinearRegression().fit((reader.iter_blocks(), y))
     """
 
     def __init__(self, path: str, block_rows: int = 1 << 20):
@@ -305,10 +307,15 @@ class NpyBlockReader:
     def iter_blocks(self):
         n = self.shape[0]
         b = self.block_rows
+        release = getattr(self._lib, "_tpuml_has_npy_release", False)
         for start in range(0, n, b):
             if start + b < n:  # warm the next block while this one is used
                 self._lib.tpuml_npy_prefetch(self._handle, start + b, b)
             yield self.read_block(start, b)
+            if release and self._handle:
+                # The block was memcpy'd out; drop its mapped pages so a
+                # full-file pass stays resident-bounded by ~one block.
+                self._lib.tpuml_npy_release(self._handle, start, b)
 
     def close(self) -> None:
         if getattr(self, "_handle", None):
@@ -344,3 +351,11 @@ def _bind_npy(lib: ctypes.CDLL) -> None:
     lib.tpuml_npy_read_block.restype = i32
     lib.tpuml_npy_read_block.argtypes = [ctypes.c_void_p, i64, i64, ctypes.c_void_p]
     lib.tpuml_npy_close.argtypes = [ctypes.c_void_p]
+    try:
+        # Added after the first npy ABI shipped; stale builds degrade to
+        # streaming without page release rather than losing the loader.
+        lib.tpuml_npy_release.restype = i32
+        lib.tpuml_npy_release.argtypes = [ctypes.c_void_p, i64, i64]
+        lib._tpuml_has_npy_release = True
+    except AttributeError:
+        lib._tpuml_has_npy_release = False

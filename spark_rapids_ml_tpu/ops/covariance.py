@@ -97,6 +97,75 @@ def centered_gram_packed(x: jax.Array, mean: jax.Array) -> jax.Array:
     return full[rows, cols]
 
 
+def shifted_block_scan(blocks, center: bool, gram_fn):
+    """Shared scaffold of the one-pass shifted covariance accumulations
+    (this module's fp32/HIGHEST path and ops.doubledouble's dd path — ONE
+    home for the streaming algebra).
+
+    The exact mean is unknown until the stream ends, so blocks are centered
+    on the FIRST block's column means (exact host-fp64 subtract — the
+    shifted-accumulation scheme of the native Kahan runtime,
+    native/src/tpuml_host.cpp, there for the reference's streamed
+    ``mapPartitions`` contract, RapidsRowMatrix.scala:170); ``gram_fn``
+    maps each shifted host block to its Gram contribution. Returns
+    ``(shift, gram, s, n)`` — finish with :func:`finalize_shifted_gram`.
+    """
+    from spark_rapids_ml_tpu.core.data import _block_to_dense
+
+    shift = gram = s = None
+    n = 0
+    for blk in blocks:
+        b = _block_to_dense(blk)
+        if b.shape[0] == 0:
+            continue
+        if shift is None:
+            shift = b.mean(axis=0) if center else np.zeros(b.shape[1])
+        bs = b - shift
+        g = gram_fn(bs)
+        gram = g if gram is None else gram + g
+        sb = bs.sum(axis=0)
+        s = sb if s is None else s + sb
+        n += b.shape[0]
+    if n < 2:
+        raise ValueError(f"need at least 2 rows to compute a covariance, got {n}")
+    return shift, gram, s, n
+
+
+def finalize_shifted_gram(shift, gram, s, n, center: bool):
+    """Recover (mean, cov, n) from a shifted scan: the closed-form
+    correction ``Σx̃ᵀx̃ − n·δδᵀ`` (δ = mean of shifted values) yields the
+    true centered Gram; with ``center=False`` the shift is identically zero
+    so the accumulated Gram already IS the raw second moment. Cov is
+    normalized by (n − 1)."""
+    delta = s / n
+    mean = shift + delta
+    gram = np.asarray(gram, dtype=np.float64)
+    if center:
+        gram = gram - n * np.outer(delta, delta)
+    return mean, gram / (n - 1), n
+
+
+def streaming_mean_and_covariance(
+    blocks, center: bool = True, dtype=None, precision: str = "highest"
+):
+    """ONE-pass covariance over an iterable of host blocks — the
+    constant-memory fit path (each block visited exactly once, device
+    memory bounded by one block + the (d, d) accumulator). Shifted Gram
+    accumulates on the accelerator; returns host fp64 ``(mean, cov, n)``.
+    """
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+    def gram_fn(bs):
+        return centered_gram(
+            jnp.asarray(bs, dtype=dtype),
+            jnp.zeros(bs.shape[1], dtype=dtype),
+            precision=precision,
+        )
+
+    return finalize_shifted_gram(*shifted_block_scan(blocks, center, gram_fn), center)
+
+
 def welford_init(d: int, dtype=jnp.float64) -> tuple:
     """(count, mean, M2) accumulator for streaming column stats.
 

@@ -161,36 +161,15 @@ def covariance_dd_blocks(
     contract (RapidsRowMatrix.scala:168-201, per-partition compute +
     cross-partition reduce).
     """
-    shift = None
-    gram = s = None
-    n = 0
-    for part in partitions:
-        p = np.asarray(part, dtype=np.float64)
-        if p.shape[0] == 0:
-            continue
-        if shift is None:
-            shift = p.mean(axis=0) if center else np.zeros(p.shape[1])
-        ps = p - shift
-        partial = centered_gram_dd(ps, np.zeros_like(shift), chunk=chunk)
-        gram = partial if gram is None else gram + partial
-        sb = ps.sum(axis=0)
-        s = sb if s is None else s + sb
-        n += p.shape[0]
-    if n < 2:
-        raise ValueError(f"need at least 2 rows to compute a covariance, got {n}")
-    delta = s / n  # true mean in shifted coordinates
-    mean = shift + delta
-    if center:
-        gram = gram - n * np.outer(delta, delta)
-    else:
-        # Raw second moment: undo the shift in exact fp64 closed form.
-        gram = (
-            gram
-            + np.outer(s, shift)
-            + np.outer(shift, s)
-            + n * np.outer(shift, shift)
-        )
-    return mean, gram / (n - 1), n
+    from spark_rapids_ml_tpu.ops.covariance import (
+        finalize_shifted_gram,
+        shifted_block_scan,
+    )
+
+    def gram_fn(bs):
+        return centered_gram_dd(bs, np.zeros(bs.shape[1]), chunk=chunk)
+
+    return finalize_shifted_gram(*shifted_block_scan(partitions, center, gram_fn), center)
 
 
 def normal_eq_stats_dd(block_pairs, chunk: int = 2048):
