@@ -36,7 +36,7 @@ from spark_rapids_ml_tpu.ops.covariance import (
 from spark_rapids_ml_tpu.ops.eigh import eigh_descending, eigh_descending_host, sign_flip
 from spark_rapids_ml_tpu.ops.linalg import resolve_precision, triu_to_full
 from spark_rapids_ml_tpu.parallel.distributed_cov import distributed_mean_and_covariance
-from spark_rapids_ml_tpu.parallel.mesh import shard_rows
+from spark_rapids_ml_tpu.parallel.mesh import shard_rows_from_partitions
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
@@ -140,6 +140,11 @@ class RowMatrix:
     # --- column stats (Statistics.colStats analogue, :156) ---
 
     def column_means(self) -> jnp.ndarray:
+        if self.partitions is None:
+            raise RuntimeError(
+                "streaming input: column means are computed inside the "
+                "one-pass covariance; use compute_covariance()"
+            )
         with TraceRange("mean center", TraceColor.ORANGE):
             state = welford_init(self.num_cols, dtype=self.dtype)
             for part in self.partitions:
@@ -151,9 +156,14 @@ class RowMatrix:
     def compute_covariance(self) -> jnp.ndarray:
         if self.partitions is None:
             return self._covariance_streaming()
-        n = self.num_rows
-        if n < 2:
-            raise ValueError(f"need at least 2 rows, got {n}")
+        if not (self.mesh is not None and jax.process_count() > 1):
+            # Multi-process fits validate the GLOBAL row count inside the
+            # mesh path (after the counts allgather): a local pre-check
+            # would kill a low-row executor while its peers deadlock in
+            # the collective waiting for it.
+            n = self.num_rows
+            if n < 2:
+                raise ValueError(f"need at least 2 rows, got {n}")
         with TraceRange("compute cov", TraceColor.RED):
             if self.mesh is not None:
                 return self._covariance_mesh()[1]  # honors mean_centering
@@ -265,10 +275,36 @@ class RowMatrix:
         return cov
 
     def _covariance_mesh(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """Whole-fit-as-one-XLA-program path over a device mesh."""
-        x = np.concatenate(self.partitions, axis=0).astype(np.dtype(self.dtype))
-        d = x.shape[1]
-        xs, mask, _ = shard_rows(x, self.mesh)
+        """Whole-fit-as-one-XLA-program path over a device mesh.
+
+        Placement is per-shard (shard_rows_from_partitions): the host never
+        materializes the concatenated dataset, only one device shard at a
+        time. In a multi-process deployment (one process per chip,
+        parallel.distributed.initialize), each process contributes its
+        LOCAL partitions and the global array is assembled across
+        processes — the reference's executor-local partitions + cross-
+        process reduce (RapidsRowMatrix.scala:170-201)."""
+        import jax as _jax
+
+        d = self.num_cols
+        if _jax.process_count() > 1:
+            from spark_rapids_ml_tpu.parallel.distributed import (
+                shard_rows_process_local,
+            )
+
+            xs, mask, n_global = shard_rows_process_local(
+                self.partitions, self.mesh, dtype=np.dtype(self.dtype)
+            )
+            # num_rows must report the GLOBAL count after a distributed
+            # fit, and the <2 check happens here — consistently on every
+            # process, after the allgather.
+            self._num_rows = int(n_global)
+            if n_global < 2:
+                raise ValueError(f"need at least 2 rows, got {n_global}")
+        else:
+            xs, mask, _ = shard_rows_from_partitions(
+                self.partitions, self.mesh, dtype=np.dtype(self.dtype)
+            )
         mean, cov = distributed_mean_and_covariance(
             xs, mask, self.mesh, precision=self.precision, center=self.mean_centering
         )

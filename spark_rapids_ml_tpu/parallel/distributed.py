@@ -1,0 +1,176 @@
+"""Multi-process distributed execution — the jax.distributed bring-up.
+
+The reference scales across hosts through Spark: one executor per GPU, RDD
+partitions as the local data, driver-side ``reduce`` as the fabric
+(RapidsRowMatrix.scala:170-201; README.md:74-87 spark-submit flow). The
+TPU-native equivalent is one PROCESS per chip (or per host), brought up
+with ``jax.distributed.initialize`` so every process sees the GLOBAL device
+set; a ``jax.sharding.Mesh`` over those devices is the fabric, and the
+covariance/Gram reductions ride XLA collectives (psum over ICI/DCN) instead
+of the driver network.
+
+Deployment shape (mirrors the reference's executor model):
+
+  - the launcher (Spark, SLURM, GKE, ...) starts N processes and hands each
+    a coordinator address + its process id — here via env vars
+    (``TPUML_COORDINATOR``/``TPUML_NUM_PROCESSES``/``TPUML_PROCESS_ID``) or
+    explicit arguments;
+  - each process pins itself to its chip (spark.resources.
+    pin_process_to_chip) BEFORE jax initializes, calls :func:`initialize`,
+    loads its LOCAL rows, and calls the ordinary estimator API with a
+    global mesh: ``PCA(mesh=global_mesh()).fit(local_blocks)``;
+  - every process gets the identical fitted model back (the reduced
+    moments are replicated by the collectives).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Bring up the jax.distributed runtime for this process (idempotent).
+
+    Arguments fall back to the ``TPUML_COORDINATOR`` /
+    ``TPUML_NUM_PROCESSES`` / ``TPUML_PROCESS_ID`` environment variables,
+    and from there to JAX's own auto-detection (which covers TPU pods,
+    where the runtime publishes the coordinator itself). Call BEFORE any
+    other JAX API touches the backend.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("TPUML_COORDINATOR")
+    if num_processes is None and "TPUML_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["TPUML_NUM_PROCESSES"])
+    if process_id is None and "TPUML_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["TPUML_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def bringup_executor(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    chip_ordinal: Optional[int] = None,
+) -> None:
+    """One-call executor entry for the one-process-per-chip deployment:
+    resolve this process's chip (explicit ordinal > Spark task resource >
+    0 — the reference's gpuId semantics, RapidsRowMatrix.scala:171-175),
+    pin PJRT to it BEFORE backend init, then bring up jax.distributed.
+
+    A Spark barrier task / SLURM step body reduces to::
+
+        bringup_executor()                       # env-driven
+        model = PCA(mesh=global_mesh()).fit(local_blocks)
+    """
+    from spark_rapids_ml_tpu.spark.resources import (
+        pin_process_to_chip,
+        resolve_device_ordinal,
+    )
+
+    ordinal = resolve_device_ordinal(
+        -1 if chip_ordinal is None else chip_ordinal
+    )
+    pin_process_to_chip(ordinal)
+    initialize(coordinator_address, num_processes, process_id)
+
+
+def global_mesh(shape: Optional[Tuple[int, int]] = None) -> Mesh:
+    """A (data × model) mesh over the GLOBAL device set — every process
+    builds the identical mesh (jax.devices() is globally consistent after
+    :func:`initialize`)."""
+    return make_mesh(shape)
+
+
+def shard_rows_process_local(
+    partitions: List[np.ndarray], mesh: Mesh, dtype=None
+) -> Tuple[jax.Array, jax.Array, int]:
+    """Assemble a GLOBAL row-sharded array from per-process LOCAL blocks.
+
+    Each process passes only the rows it loaded (its executor-local
+    partitions); no process ever sees the whole dataset. Per-process row
+    counts may differ: every process pads its local rows to the globally
+    agreed per-process maximum (one tiny allgather of the counts), and the
+    row mask zeroes the padding inside the compiled reductions, so results
+    are exact. Returns ``(x_sharded, row_mask_sharded, n_true_rows_global)``.
+    """
+    from jax.experimental import multihost_utils
+
+    parts = [np.asarray(p) for p in partitions]
+    if dtype is not None:
+        parts = [p.astype(dtype, copy=False) for p in parts]
+    n_local = sum(p.shape[0] for p in parts)
+    d = parts[0].shape[1]
+    np_dtype = parts[0].dtype
+
+    counts = multihost_utils.process_allgather(np.asarray([n_local]))
+    counts = np.asarray(counts).ravel()
+    n_true = int(counts.sum())
+
+    n_proc = jax.process_count()
+    local_dev = jax.local_device_count()
+    dp = mesh.shape[DATA_AXIS]
+    mp = mesh.shape[MODEL_AXIS]
+    if mp != 1:
+        raise ValueError(
+            "process-local sharding currently supports data-parallel meshes "
+            f"(model axis 1), got model={mp}"
+        )
+    if dp != n_proc * local_dev:
+        raise ValueError(
+            f"mesh data axis {dp} != process_count*local_devices "
+            f"{n_proc}*{local_dev}"
+        )
+    # Equal per-process row count, padded to the local device count, so the
+    # even GSPMD slicing of the global array lines up with what each
+    # process actually holds.
+    per_proc = int(counts.max())
+    per_proc += (-per_proc) % local_dev
+
+    x_local = np.zeros((per_proc, d), dtype=np_dtype)
+    off = 0
+    for p in parts:
+        x_local[off : off + p.shape[0]] = p
+        off += p.shape[0]
+    mask_local = np.zeros(per_proc, dtype=np_dtype)
+    mask_local[:n_local] = 1.0
+
+    x_sharding = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+    m_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    xs = jax.make_array_from_process_local_data(
+        x_sharding, x_local, (per_proc * n_proc, d)
+    )
+    ms = jax.make_array_from_process_local_data(
+        m_sharding, mask_local, (per_proc * n_proc,)
+    )
+    return xs, ms, n_true
+
+
+__all__ = [
+    "initialize",
+    "bringup_executor",
+    "global_mesh",
+    "shard_rows_process_local",
+]

@@ -1,0 +1,33 @@
+"""Test/validation helpers shared by the suite and user code.
+
+Principal components are defined up to a per-column sign (the reference's
+deterministic signFlip notwithstanding, two implementations may legally
+disagree on it), so comparisons must be sign-invariant — the PCASuite
+comparison convention (PCASuite.scala:60-75).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def assert_components_close(actual, expected, atol: float) -> None:
+    """Assert two (d, k) principal-component matrices match column-wise up
+    to sign, each column within ``atol``."""
+    actual = np.asarray(actual)
+    expected = np.asarray(expected)
+    if actual.shape != expected.shape:
+        raise AssertionError(
+            f"component shapes differ: {actual.shape} vs {expected.shape}"
+        )
+    for j in range(actual.shape[1]):
+        direct = np.max(np.abs(actual[:, j] - expected[:, j]))
+        flipped = np.max(np.abs(actual[:, j] + expected[:, j]))
+        if min(direct, flipped) >= atol:
+            raise AssertionError(
+                f"component {j} differs by {min(direct, flipped):.3e} "
+                f"(atol {atol:.0e})"
+            )
+
+
+__all__ = ["assert_components_close"]

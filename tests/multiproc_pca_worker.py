@@ -1,0 +1,62 @@
+"""Worker for the multi-process distributed PCA integration test.
+
+Launched N times by tests/test_multiprocess.py with TPUML_COORDINATOR /
+TPUML_NUM_PROCESSES / TPUML_PROCESS_ID in the environment — the same
+contract a Spark/SLURM/GKE launcher would use in production (one process
+per chip). Each worker loads only ITS slice of the dataset, fits through
+the ordinary library API with a global mesh, and checks the fitted model
+against the full-dataset numpy oracle.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# Interpreter-level site customization may have pre-imported jax and forced
+# a real-accelerator platform; override BOTH (env is inherited, config wins
+# over the captured env) before the distributed runtime comes up.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from spark_rapids_ml_tpu.parallel import distributed as dist
+
+dist.initialize()  # from TPUML_* env
+
+from spark_rapids_ml_tpu.feature import PCA
+
+
+def main() -> None:
+    pid = jax.process_index()
+    n_proc = jax.process_count()
+    assert n_proc == int(os.environ["TPUML_NUM_PROCESSES"]), n_proc
+
+    # Deterministic global dataset; every worker derives the same one and
+    # takes a DIFFERENT (deliberately uneven) slice as its local data.
+    rng = np.random.default_rng(0)
+    n, d = 1003, 12
+    x = rng.normal(size=(n, d)) * np.linspace(1.0, 2.0, d) + 100.0
+    bounds = np.linspace(0, n, n_proc + 1).astype(int)
+    local = x[bounds[pid] : bounds[pid + 1]]
+
+    mesh = dist.global_mesh()
+    model = PCA(mesh=mesh).setK(3).fit([local])
+
+    from spark_rapids_ml_tpu.utils.testing import assert_components_close
+
+    cov = np.cov(x, rowvar=False)
+    w, v = np.linalg.eigh(cov)
+    w, v = w[::-1], v[:, ::-1]
+    assert_components_close(model.pc, v[:, :3], 1e-6)
+    np.testing.assert_allclose(
+        model.explainedVariance, (w / w.sum())[:3], atol=1e-8
+    )
+    print(f"OK process {pid}/{n_proc}")
+
+
+if __name__ == "__main__":
+    main()

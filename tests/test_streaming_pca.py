@@ -16,12 +16,7 @@ from spark_rapids_ml_tpu.linalg.row_matrix import RowMatrix
 from spark_rapids_ml_tpu.ops.covariance import streaming_mean_and_covariance
 
 
-def _pc_close(a, b, atol):
-    """Sign-invariant principal-component comparison."""
-    for j in range(a.shape[1]):
-        d1 = np.max(np.abs(a[:, j] - b[:, j]))
-        d2 = np.max(np.abs(a[:, j] + b[:, j]))
-        assert min(d1, d2) < atol, (j, d1, d2)
+from spark_rapids_ml_tpu.utils.testing import assert_components_close as _pc_close
 
 
 class TestStreamingSourceDetection:
