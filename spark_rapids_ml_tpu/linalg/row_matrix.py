@@ -117,12 +117,15 @@ class RowMatrix:
 
     @property
     def num_cols(self) -> int:
-        if self.partitions is None:
-            if self._num_cols is None:
-                raise RuntimeError(
-                    "streaming input: shape is unknown until a fit pass runs"
-                )
+        # A fit pass may have recorded the authoritative (global) width —
+        # streaming sources discover it then, and multi-process fits must
+        # not report a zero-row process's local width.
+        if self._num_cols is not None:
             return self._num_cols
+        if self.partitions is None:
+            raise RuntimeError(
+                "streaming input: shape is unknown until a fit pass runs"
+            )
         return self.partitions[0].shape[1]
 
     @property
@@ -286,7 +289,6 @@ class RowMatrix:
         process reduce (RapidsRowMatrix.scala:170-201)."""
         import jax as _jax
 
-        d = self.num_cols
         if _jax.process_count() > 1:
             from spark_rapids_ml_tpu.parallel.distributed import (
                 shard_rows_process_local,
@@ -295,13 +297,16 @@ class RowMatrix:
             xs, mask, n_global = shard_rows_process_local(
                 self.partitions, self.mesh, dtype=np.dtype(self.dtype)
             )
-            # num_rows must report the GLOBAL count after a distributed
-            # fit, and the <2 check happens here — consistently on every
-            # process, after the allgather.
+            # Shape facts must be GLOBAL after a distributed placement (a
+            # process may hold zero local rows), and the <2 check happens
+            # here — consistently on every process, after the allgather.
+            d = int(xs.shape[1])  # model axis is 1 in this mode: no padding
             self._num_rows = int(n_global)
+            self._num_cols = d
             if n_global < 2:
                 raise ValueError(f"need at least 2 rows, got {n_global}")
         else:
+            d = self.num_cols
             xs, mask, _ = shard_rows_from_partitions(
                 self.partitions, self.mesh, dtype=np.dtype(self.dtype)
             )
@@ -316,16 +321,20 @@ class RowMatrix:
     def compute_principal_components_and_explained_variance(
         self, k: int
     ) -> Tuple[np.ndarray, np.ndarray]:
-        if self.partitions is not None:
-            # Validate k before the expensive pass when the shape is known;
-            # a streaming source only learns d during the pass itself.
+        # Validate k before the expensive pass when the shape is known
+        # up front. Streaming sources learn d only during the pass, and a
+        # multi-process fit only learns the GLOBAL width from the
+        # placement allgather (a zero-row executor has no local width).
+        shape_known = self.partitions is not None and not (
+            self.mesh is not None and jax.process_count() > 1
+        )
+        if shape_known:
             n_cols = self.num_cols
             if not 1 <= k <= n_cols:
                 raise ValueError(f"k must be in [1, {n_cols}], got {k}")
         cov = self.compute_covariance()
         n_cols = self.num_cols
-        if self.partitions is None and not 1 <= k <= n_cols:
-            # Streaming sources only learn d during the pass itself.
+        if not shape_known and not 1 <= k <= n_cols:
             raise ValueError(f"k must be in [1, {n_cols}], got {k}")
         if self.precision == "dd":
             # The covariance is exact-fp64 host data; a device eigensolve

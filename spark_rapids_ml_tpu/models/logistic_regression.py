@@ -364,6 +364,12 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model):
         _, probs, _ = self._predict_all(as_matrix(x))
         return probs
 
+    def predictRaw(self, x) -> np.ndarray:
+        """Raw margins (Spark's rawPrediction): [-z, z] for binomial,
+        the logits for multinomial — NOT probabilities."""
+        _, _, raw = self._predict_all(as_matrix(x))
+        return raw
+
     def _predict_all(self, x: np.ndarray):
         """One forward pass; binomial labels honor the threshold param."""
         labels, probs, raw = predict_logistic(

@@ -391,6 +391,11 @@ class RandomForestClassificationModel(_RandomForestParams, Model):
     def predict(self, x) -> np.ndarray:
         return np.argmax(self.predictProbability(x), axis=1)
 
+    def predictRaw(self, x) -> np.ndarray:
+        """Spark RF rawPrediction: unnormalized per-class vote mass (mean
+        leaf distribution scaled by the tree count)."""
+        return self.predictProbability(x) * len(np.asarray(self._forest.feature))
+
     def transform(self, dataset: Any) -> Any:
         rows = extract_features(dataset, self.getFeaturesCol(), drop=self.getLabelCol())
         probs = self.predictProbability(rows)

@@ -122,12 +122,27 @@ def shard_rows_process_local(
     if dtype is not None:
         parts = [p.astype(dtype, copy=False) for p in parts]
     n_local = sum(p.shape[0] for p in parts)
-    d = parts[0].shape[1]
-    np_dtype = parts[0].dtype
+    # Zero-row placeholder blocks (e.g. the (0, 0) densification of an
+    # empty partition list) carry no width information.
+    d_local = next((p.shape[1] for p in parts if p.shape[0] > 0), -1)
 
-    counts = multihost_utils.process_allgather(np.asarray([n_local]))
-    counts = np.asarray(counts).ravel()
+    # The allgather comes FIRST — before anything that can raise on a
+    # process with no local blocks — so an empty executor participates in
+    # the collective instead of stranding its peers in it.
+    info = multihost_utils.process_allgather(np.asarray([n_local, d_local]))
+    info = np.asarray(info).reshape(-1, 2)
+    counts = info[:, 0]
     n_true = int(counts.sum())
+    widths = sorted({int(w) for w in info[:, 1] if w >= 0})
+    if not widths:
+        raise ValueError("no process contributed any blocks")
+    if len(widths) > 1:
+        # Every process sees the same gathered widths, so this raises on
+        # ALL of them consistently — an asymmetric raise would strand the
+        # healthy processes in the next collective.
+        raise ValueError(f"feature dim mismatch across processes: {widths}")
+    d = widths[0]
+    np_dtype = parts[0].dtype if parts else np.dtype(dtype or np.float64)
 
     n_proc = jax.process_count()
     local_dev = jax.local_device_count()
@@ -152,6 +167,8 @@ def shard_rows_process_local(
     x_local = np.zeros((per_proc, d), dtype=np_dtype)
     off = 0
     for p in parts:
+        if p.shape[0] == 0:
+            continue
         x_local[off : off + p.shape[0]] = p
         off += p.shape[0]
     mask_local = np.zeros(per_proc, dtype=np_dtype)

@@ -103,12 +103,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             from spark_rapids_ml_tpu.core import persistence as P
 
             metadata = P.load_metadata(path, expected_class="TpuPCA")
-            est = cls()
-            for source in (metadata.get("defaultParamMap", {}), metadata.get("paramMap", {})):
-                for name, value in source.items():
-                    if est.hasParam(name):
-                        est._set(**{name: value})
-            return est
+            return _set_params_from_metadata(cls(), metadata)
 
         def _fit(self, dataset):
             in_col = self.getOrDefault(self.inputCol)
@@ -246,8 +241,662 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             # pyspark Param values set by name (pyspark's typeConverter API
             # differs from the core Params', so core get_and_set_params does
             # not apply here).
-            for source in (metadata.get("defaultParamMap", {}), metadata.get("paramMap", {})):
-                for name, value in source.items():
-                    if model.hasParam(name):
-                        model._set(**{name: value})
+            return _set_params_from_metadata(model, metadata)
+
+    # ------------------------------------------------------------------
+    # Shared adapter plumbing for the non-PCA families
+    # ------------------------------------------------------------------
+
+    def _set_params_from_metadata(obj, metadata):
+        """Restore pyspark Param values by name from core metadata JSON."""
+        for source in (metadata.get("defaultParamMap", {}), metadata.get("paramMap", {})):
+            for name, value in source.items():
+                if obj.hasParam(name):
+                    obj._set(**{name: value})
+        return obj
+
+    def _collect_xy(dataset, features_col, label_col):
+        """Materialize (X, y) on the driver via toLocalIterator (partition-
+        streamed fetch, avoiding one huge collect() result object). The
+        final arrays ARE the full dataset: the classifier families train on
+        the driver-attached chip, like modern spark-rapids-ml concentrating
+        data at the accelerator process."""
+        xs, ys = [], []
+        for row in dataset.select(features_col, label_col).rdd.toLocalIterator():
+            xs.append(np.asarray(row[0].toArray(), dtype=np.float64))
+            ys.append(float(row[1]))
+        if not xs:
+            raise ValueError("empty dataset")
+        return np.stack(xs), np.asarray(ys)
+
+    def _prediction_udf(fn):
+        """Vectorized Arrow-batch prediction column (one numpy/JAX batch op
+        per Arrow batch — the working version of the reference's disabled
+        batched transform, RapidsPCA.scala:172-185)."""
+        from pyspark.sql.functions import pandas_udf
+
+        @pandas_udf("double")
+        def predict(series):
+            import pandas as pd
+
+            block = np.stack([np.asarray(v, dtype=np.float64) for v in series])
+            return pd.Series(np.asarray(fn(block), dtype=np.float64))
+
+        return predict
+
+    def _sq_dists(x: np.ndarray, centers: np.ndarray) -> np.ndarray:
+        """(n, k) squared distances via ||x||^2 - 2 x c^T + ||c||^2: one
+        (n, d) x (d, k) matmul, no (n, k, d) intermediate (the memory
+        discipline of ops/kmeans.py, numpy edition for executors)."""
+        d2 = (
+            (x * x).sum(axis=1)[:, None]
+            - 2.0 * (x @ centers.T)
+            + (centers * centers).sum(axis=1)[None, :]
+        )
+        return np.maximum(d2, 0.0)
+
+    class _TpuPredictorParams(Params):
+        featuresCol = Param(Params._dummy(), "featuresCol", "features column", TypeConverters.toString)
+        labelCol = Param(Params._dummy(), "labelCol", "label column", TypeConverters.toString)
+        predictionCol = Param(Params._dummy(), "predictionCol", "prediction column", TypeConverters.toString)
+
+        def setFeaturesCol(self, value):
+            return self._set(featuresCol=value)
+
+        def setLabelCol(self, value):
+            return self._set(labelCol=value)
+
+        def setPredictionCol(self, value):
+            return self._set(predictionCol=value)
+
+    # ------------------------------------------------------------------
+    # KMeans — genuinely distributed Lloyd iterations over the RDD
+    # ------------------------------------------------------------------
+
+    class TpuKMeans(SparkEstimator, _TpuPredictorParams):
+        """Distributed k-means: per-iteration partition-local assignment
+        stats (numpy on executors) merged via treeReduce, centers updated
+        on the driver — the mllib KMeans aggregation structure with this
+        framework's driver-side finishing."""
+
+        k = Param(Params._dummy(), "k", "number of clusters", TypeConverters.toInt)
+        maxIter = Param(Params._dummy(), "maxIter", "max iterations", TypeConverters.toInt)
+        tol = Param(Params._dummy(), "tol", "convergence tolerance", TypeConverters.toFloat)
+        seed = Param(Params._dummy(), "seed", "random seed", TypeConverters.toInt)
+
+        def __init__(self, k=2, featuresCol="features", predictionCol="prediction"):
+            super().__init__()
+            self._setDefault(
+                k=2, maxIter=20, tol=1e-4, seed=0,
+                featuresCol="features", predictionCol="prediction",
+            )
+            self._set(k=k, featuresCol=featuresCol, predictionCol=predictionCol)
+
+        def setK(self, value):
+            return self._set(k=value)
+
+        def setMaxIter(self, value):
+            return self._set(maxIter=value)
+
+        def setTol(self, value):
+            return self._set(tol=value)
+
+        def setSeed(self, value):
+            return self._set(seed=value)
+
+        def _fit(self, dataset):
+            k = self.getOrDefault(self.k)
+            rdd = dataset.select(self.getOrDefault(self.featuresCol)).rdd.map(
+                lambda r: r[0]
+            )
+            # Lloyd re-reads the data every iteration: persist once instead
+            # of recomputing the select+deserialize lineage maxIter times
+            # (Spark's own KMeans caches the normalized data the same way).
+            rdd.persist()
+            try:
+                # takeSample, not take: take() reads the FIRST partitions,
+                # and row order often correlates with structure (sorted
+                # labels, time order) — seeding from one partition
+                # collapses clusters.
+                seed_rows = rdd.takeSample(
+                    False, max(10 * k, k), self.getOrDefault(self.seed)
+                )
+                if not seed_rows:
+                    raise ValueError("empty dataset")
+                sample = np.stack(
+                    [np.asarray(v.toArray(), dtype=np.float64) for v in seed_rows]
+                )
+                if sample.shape[0] < k:
+                    raise ValueError(
+                        f"k={k} exceeds the number of rows {sample.shape[0]}"
+                    )
+                d = sample.shape[1]
+                # k-means++ seeding on the driver sample (numpy,
+                # deterministic); distances via the Gram expansion
+                # ||x||^2 - 2 x c^T + ||c||^2 — never a (n, k, d) tensor
+                # (the ops/kmeans.py memory discipline).
+                rng = np.random.default_rng(self.getOrDefault(self.seed))
+                centers = sample[rng.integers(sample.shape[0])][None, :]
+                while centers.shape[0] < k:
+                    d2 = np.min(_sq_dists(sample, centers), axis=1)
+                    probs = d2 / d2.sum() if d2.sum() > 0 else None
+                    centers = np.concatenate(
+                        [centers, sample[rng.choice(sample.shape[0], p=probs)][None]]
+                    )
+
+                for _ in range(self.getOrDefault(self.maxIter)):
+                    c = centers  # closure-captured broadcast analogue
+
+                    def part_op(rows, c=c, k=k, d=d):
+                        sums = np.zeros((k, d))
+                        counts = np.zeros(k)
+                        sse = 0.0
+                        batch = []
+
+                        def flush(batch, sums, counts, sse):
+                            x = np.stack(batch)
+                            d2 = _sq_dists(x, c)
+                            a = np.argmin(d2, axis=1)
+                            np.add.at(sums, a, x)
+                            np.add.at(counts, a, 1.0)
+                            return sse + float(d2[np.arange(len(a)), a].sum())
+
+                        for v in rows:
+                            batch.append(np.asarray(v.toArray(), dtype=np.float64))
+                            if len(batch) >= 4096:
+                                sse = flush(batch, sums, counts, sse)
+                                batch = []
+                        if batch:
+                            sse = flush(batch, sums, counts, sse)
+                        return [(sums, counts, sse)]
+
+                    sums, counts, _sse = rdd.mapPartitions(part_op).treeReduce(
+                        lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2])
+                    )
+                    new_centers = np.where(
+                        counts[:, None] > 0,
+                        sums / np.maximum(counts, 1.0)[:, None],
+                        centers,
+                    )
+                    shift = float(
+                        np.max(np.linalg.norm(new_centers - centers, axis=1))
+                    )
+                    centers = new_centers
+                    if shift < self.getOrDefault(self.tol):
+                        break
+            finally:
+                rdd.unpersist()
+
+            model = TpuKMeansModel(centers)
+            model._set(
+                featuresCol=self.getOrDefault(self.featuresCol),
+                predictionCol=self.getOrDefault(self.predictionCol),
+            )
             return model
+
+    class TpuKMeansModel(SparkModel, _TpuPredictorParams, MLReadable):
+        def __init__(self, centers=None):
+            super().__init__()
+            self._setDefault(featuresCol="features", predictionCol="prediction")
+            self._centers = None if centers is None else np.asarray(centers, dtype=np.float64)
+
+        def clusterCenters(self):
+            return [c for c in self._centers]
+
+        def _transform(self, dataset):
+            from pyspark.ml.functions import vector_to_array
+            from pyspark.sql.functions import col
+
+            centers = self._centers
+
+            def assign(block):
+                return np.argmin(_sq_dists(block, centers), axis=1).astype(np.float64)
+
+            return dataset.withColumn(
+                self.getOrDefault(self.predictionCol),
+                _prediction_udf(assign)(
+                    vector_to_array(col(self.getOrDefault(self.featuresCol)))
+                ),
+            )
+
+        def _save_impl(self, path):
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name="TpuKMeansModel")
+            P.save_data(path, {"clusterCenters": ("matrix", self._centers)})
+
+        @classmethod
+        def load(cls, path):
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            metadata = P.load_metadata(path, expected_class="TpuKMeansModel")
+            data = P.load_data(path)
+            model = cls(np.asarray(data["clusterCenters"]))
+            return _set_params_from_metadata(model, metadata)
+
+    # ------------------------------------------------------------------
+    # LinearRegression — distributed normal-equation moments + fp64 solve
+    # ------------------------------------------------------------------
+
+    class TpuLinearRegression(SparkEstimator, _TpuPredictorParams):
+        """Distributed least squares: executors accumulate the [X|y]
+        shifted second moments (numpy, picklable), treeReduce merges, the
+        driver solves the normal equations in fp64
+        (ops.linear.solve_normal_host) — one data pass, d x d on the wire."""
+
+        regParam = Param(Params._dummy(), "regParam", "L2 regularization", TypeConverters.toFloat)
+        elasticNetParam = Param(Params._dummy(), "elasticNetParam", "L1 mixing (must be 0)", TypeConverters.toFloat)
+        fitIntercept = Param(Params._dummy(), "fitIntercept", "fit intercept", TypeConverters.toBoolean)
+        standardization = Param(Params._dummy(), "standardization", "standardize penalty", TypeConverters.toBoolean)
+
+        def __init__(self, featuresCol="features", labelCol="label", predictionCol="prediction"):
+            super().__init__()
+            self._setDefault(
+                regParam=0.0, elasticNetParam=0.0, fitIntercept=True,
+                standardization=True, featuresCol="features", labelCol="label",
+                predictionCol="prediction",
+            )
+            self._set(
+                featuresCol=featuresCol, labelCol=labelCol, predictionCol=predictionCol
+            )
+
+        def setRegParam(self, value):
+            return self._set(regParam=value)
+
+        def setElasticNetParam(self, value):
+            return self._set(elasticNetParam=value)
+
+        def setFitIntercept(self, value):
+            return self._set(fitIntercept=value)
+
+        def setStandardization(self, value):
+            return self._set(standardization=value)
+
+        def _fit(self, dataset):
+            if self.getOrDefault(self.elasticNetParam) != 0.0:
+                raise ValueError(
+                    "TpuLinearRegression's distributed normal-equation path "
+                    "supports only L2 (elasticNetParam must be 0)"
+                )
+            f_col = self.getOrDefault(self.featuresCol)
+            l_col = self.getOrDefault(self.labelCol)
+            rdd = dataset.select(f_col, l_col).rdd
+            first = rdd.first()
+            d = len(first[0].toArray())
+
+            def part_op(rows, d=d):
+                acc = ShiftedMoments(d + 1)
+                batch = []
+                for row in rows:
+                    batch.append(
+                        np.concatenate(
+                            [np.asarray(row[0].toArray(), dtype=np.float64), [float(row[1])]]
+                        )
+                    )
+                    if len(batch) >= 4096:
+                        acc.add_block(np.stack(batch))
+                        batch = []
+                if batch:
+                    acc.add_block(np.stack(batch))
+                return [acc]
+
+            acc = rdd.mapPartitions(part_op).treeReduce(lambda a, b: a.merge(b))
+            raw, mean = acc.finalize(center=False)  # raw 2nd moment / (n-1)
+            n = float(acc.n_rows)
+            raw = raw * (n - 1.0)
+            from spark_rapids_ml_tpu.ops.linear import solve_normal_host
+
+            coef, intercept = solve_normal_host(
+                raw[:d, :d],
+                raw[:d, d],
+                mean[:d] * n,
+                mean[d] * n,
+                n,
+                reg_param=self.getOrDefault(self.regParam),
+                fit_intercept=self.getOrDefault(self.fitIntercept),
+                standardization=self.getOrDefault(self.standardization),
+            )
+            model = TpuLinearRegressionModel(
+                DenseVector(np.asarray(coef).tolist()), float(intercept)
+            )
+            model._set(
+                featuresCol=f_col,
+                labelCol=l_col,
+                predictionCol=self.getOrDefault(self.predictionCol),
+            )
+            return model
+
+    class TpuLinearRegressionModel(SparkModel, _TpuPredictorParams, MLReadable):
+        def __init__(self, coefficients=None, intercept=0.0):
+            super().__init__()
+            self._setDefault(
+                featuresCol="features", labelCol="label", predictionCol="prediction"
+            )
+            self.coefficients = coefficients
+            self.intercept = float(intercept)
+
+        def _transform(self, dataset):
+            from pyspark.ml.functions import vector_to_array
+            from pyspark.sql.functions import col
+
+            coef = np.asarray(self.coefficients.toArray())
+            b = self.intercept
+            return dataset.withColumn(
+                self.getOrDefault(self.predictionCol),
+                _prediction_udf(lambda block: block @ coef + b)(
+                    vector_to_array(col(self.getOrDefault(self.featuresCol)))
+                ),
+            )
+
+        def _save_impl(self, path):
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name="TpuLinearRegressionModel")
+            P.save_data(
+                path,
+                {
+                    "coefficients": ("vector", np.asarray(self.coefficients.toArray())),
+                    "intercept": ("scalar", self.intercept),
+                },
+            )
+
+        @classmethod
+        def load(cls, path):
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            metadata = P.load_metadata(path, expected_class="TpuLinearRegressionModel")
+            data = P.load_data(path)
+            model = cls(
+                DenseVector(np.asarray(data["coefficients"]).tolist()),
+                float(data["intercept"]),
+            )
+            return _set_params_from_metadata(model, metadata)
+
+    # ------------------------------------------------------------------
+    # LogisticRegression / RandomForest — blocks stream to the driver
+    # chip; the core TPU estimator does the optimization (the modern
+    # spark-rapids-ml deployment shape: data to the accelerator process,
+    # compute on chip)
+    # ------------------------------------------------------------------
+
+    class _TpuProbabilisticParams(_TpuPredictorParams):
+        probabilityCol = Param(Params._dummy(), "probabilityCol", "probability column", TypeConverters.toString)
+        rawPredictionCol = Param(Params._dummy(), "rawPredictionCol", "raw prediction column", TypeConverters.toString)
+
+        def setProbabilityCol(self, value):
+            return self._set(probabilityCol=value)
+
+        def setRawPredictionCol(self, value):
+            return self._set(rawPredictionCol=value)
+
+    def _classifier_transform(forward, n_classes, adapter):
+        """Append rawPrediction / probability / prediction columns from a
+        numpy-only ``forward(block) -> (raw, probs, pred)`` callable.
+
+        ONE forward pass per Arrow batch: the combined [raw | probs | pred]
+        scores land in a temporary array column, and the three public
+        columns are cheap slices of it. ``forward`` must close over plain
+        numpy arrays + spark.executor_math functions only — executors have
+        numpy, not JAX (module docstring contract).
+        """
+
+        def _apply(dataset):
+            from pyspark.ml.functions import array_to_vector, vector_to_array
+            from pyspark.sql.functions import col, pandas_udf
+
+            feats = vector_to_array(
+                col(adapter.getOrDefault(adapter.featuresCol))
+            )
+
+            @pandas_udf("array<double>")
+            def scores(series):
+                import pandas as pd
+
+                block = np.stack(
+                    [np.asarray(v, dtype=np.float64) for v in series]
+                )
+                raw, probs, pred = forward(block)
+                return pd.Series(
+                    list(np.concatenate([raw, probs, pred[:, None]], axis=1))
+                )
+
+            def slice_vec(lo, hi):
+                @pandas_udf("array<double>")
+                def s(series):
+                    import pandas as pd
+
+                    return pd.Series([np.asarray(v)[lo:hi] for v in series])
+
+                return s
+
+            @pandas_udf("double")
+            def last(series):
+                import pandas as pd
+
+                return pd.Series([float(np.asarray(v)[-1]) for v in series])
+
+            tmp = "_tpu_scores"
+            out = dataset.withColumn(tmp, scores(feats))
+            c = n_classes
+            out = out.withColumn(
+                adapter.getOrDefault(adapter.rawPredictionCol),
+                array_to_vector(slice_vec(0, c)(col(tmp))),
+            )
+            out = out.withColumn(
+                adapter.getOrDefault(adapter.probabilityCol),
+                array_to_vector(slice_vec(c, 2 * c)(col(tmp))),
+            )
+            out = out.withColumn(
+                adapter.getOrDefault(adapter.predictionCol), last(col(tmp))
+            )
+            return out.drop(tmp)
+
+        return _apply
+
+    class TpuLogisticRegression(SparkEstimator, _TpuProbabilisticParams):
+        maxIter = Param(Params._dummy(), "maxIter", "max iterations", TypeConverters.toInt)
+        regParam = Param(Params._dummy(), "regParam", "regularization", TypeConverters.toFloat)
+        elasticNetParam = Param(Params._dummy(), "elasticNetParam", "L1/L2 mixing", TypeConverters.toFloat)
+
+        def __init__(self, featuresCol="features", labelCol="label"):
+            super().__init__()
+            self._setDefault(
+                maxIter=100, regParam=0.0, elasticNetParam=0.0,
+                featuresCol="features", labelCol="label",
+                predictionCol="prediction", probabilityCol="probability",
+                rawPredictionCol="rawPrediction",
+            )
+            self._set(featuresCol=featuresCol, labelCol=labelCol)
+
+        def setMaxIter(self, value):
+            return self._set(maxIter=value)
+
+        def setRegParam(self, value):
+            return self._set(regParam=value)
+
+        def setElasticNetParam(self, value):
+            return self._set(elasticNetParam=value)
+
+        def _fit(self, dataset):
+            from spark_rapids_ml_tpu.classification import LogisticRegression
+
+            x, y = _collect_xy(
+                dataset,
+                self.getOrDefault(self.featuresCol),
+                self.getOrDefault(self.labelCol),
+            )
+            core = (
+                LogisticRegression()
+                .setMaxIter(self.getOrDefault(self.maxIter))
+                .setRegParam(self.getOrDefault(self.regParam))
+                .setElasticNetParam(self.getOrDefault(self.elasticNetParam))
+                .fit((x, y))
+            )
+            model = TpuLogisticRegressionModel(core)
+            for p in ("featuresCol", "labelCol", "predictionCol", "probabilityCol", "rawPredictionCol"):
+                model._set(**{p: self.getOrDefault(getattr(self, p))})
+            return model
+
+    class TpuLogisticRegressionModel(SparkModel, _TpuProbabilisticParams, MLReadable):
+        def __init__(self, core_model=None):
+            super().__init__()
+            self._setDefault(
+                featuresCol="features", labelCol="label",
+                predictionCol="prediction", probabilityCol="probability",
+                rawPredictionCol="rawPrediction",
+            )
+            self._core = core_model
+
+        @property
+        def coefficients(self):
+            return DenseVector(self._core.coefficients.tolist())
+
+        @property
+        def intercept(self):
+            return float(self._core.intercept)
+
+        def _transform(self, dataset):
+            import functools
+
+            from spark_rapids_ml_tpu.spark import executor_math
+
+            # Extract plain numpy params on the driver; the closure ships
+            # arrays + a numpy-only module function to executors (no JAX).
+            forward = functools.partial(
+                executor_math.logistic_forward,
+                np.asarray(self._core.weights, dtype=np.float64),
+                np.asarray(self._core.intercepts, dtype=np.float64),
+                float(self._core.getThreshold()),
+            )
+            return _classifier_transform(forward, self._core.numClasses, self)(dataset)
+
+        def _save_impl(self, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name="TpuLogisticRegressionModel")
+            self._core.save(_os.path.join(path, "core"))
+
+        @classmethod
+        def load(cls, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+            from spark_rapids_ml_tpu.models.logistic_regression import (
+                LogisticRegressionModel,
+            )
+
+            metadata = P.load_metadata(path, expected_class="TpuLogisticRegressionModel")
+            model = cls(LogisticRegressionModel.load(_os.path.join(path, "core")))
+            return _set_params_from_metadata(model, metadata)
+
+    class TpuRandomForestClassifier(SparkEstimator, _TpuProbabilisticParams):
+        numTrees = Param(Params._dummy(), "numTrees", "number of trees", TypeConverters.toInt)
+        maxDepth = Param(Params._dummy(), "maxDepth", "max tree depth", TypeConverters.toInt)
+        maxBins = Param(Params._dummy(), "maxBins", "max feature bins", TypeConverters.toInt)
+        seed = Param(Params._dummy(), "seed", "random seed", TypeConverters.toInt)
+        impurity = Param(Params._dummy(), "impurity", "gini or entropy", TypeConverters.toString)
+
+        def __init__(self, featuresCol="features", labelCol="label"):
+            super().__init__()
+            self._setDefault(
+                numTrees=20, maxDepth=5, maxBins=32, seed=0, impurity="gini",
+                featuresCol="features", labelCol="label",
+                predictionCol="prediction", probabilityCol="probability",
+                rawPredictionCol="rawPrediction",
+            )
+            self._set(featuresCol=featuresCol, labelCol=labelCol)
+
+        def setNumTrees(self, value):
+            return self._set(numTrees=value)
+
+        def setMaxDepth(self, value):
+            return self._set(maxDepth=value)
+
+        def setMaxBins(self, value):
+            return self._set(maxBins=value)
+
+        def setSeed(self, value):
+            return self._set(seed=value)
+
+        def setImpurity(self, value):
+            return self._set(impurity=value)
+
+        def _fit(self, dataset):
+            from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+            x, y = _collect_xy(
+                dataset,
+                self.getOrDefault(self.featuresCol),
+                self.getOrDefault(self.labelCol),
+            )
+            core = (
+                RandomForestClassifier()
+                .setNumTrees(self.getOrDefault(self.numTrees))
+                .setMaxDepth(self.getOrDefault(self.maxDepth))
+                .setMaxBins(self.getOrDefault(self.maxBins))
+                .setSeed(self.getOrDefault(self.seed))
+                .setImpurity(self.getOrDefault(self.impurity))
+                .fit((x, y))
+            )
+            model = TpuRandomForestClassificationModel(core)
+            for p in ("featuresCol", "labelCol", "predictionCol", "probabilityCol", "rawPredictionCol"):
+                model._set(**{p: self.getOrDefault(getattr(self, p))})
+            return model
+
+    class TpuRandomForestClassificationModel(SparkModel, _TpuProbabilisticParams, MLReadable):
+        def __init__(self, core_model=None):
+            super().__init__()
+            self._setDefault(
+                featuresCol="features", labelCol="label",
+                predictionCol="prediction", probabilityCol="probability",
+                rawPredictionCol="rawPrediction",
+            )
+            self._core = core_model
+
+        @property
+        def numClasses(self):
+            return self._core.numClasses
+
+        def _transform(self, dataset):
+            import functools
+
+            from spark_rapids_ml_tpu.models.random_forest import _forest_depth
+            from spark_rapids_ml_tpu.spark import executor_math
+
+            f = self._core._forest
+            forward = functools.partial(
+                executor_math.forest_forward,
+                np.asarray(f.feature),
+                np.asarray(f.threshold, dtype=np.float64),
+                np.asarray(f.is_leaf),
+                np.asarray(f.leaf_value, dtype=np.float64),
+                _forest_depth(f),
+            )
+            return _classifier_transform(forward, self._core.numClasses, self)(dataset)
+
+        def _save_impl(self, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name="TpuRandomForestClassificationModel")
+            self._core.save(_os.path.join(path, "core"))
+
+        @classmethod
+        def load(cls, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+            from spark_rapids_ml_tpu.models.random_forest import (
+                RandomForestClassificationModel,
+            )
+
+            metadata = P.load_metadata(
+                path, expected_class="TpuRandomForestClassificationModel"
+            )
+            model = cls(
+                RandomForestClassificationModel.load(_os.path.join(path, "core"))
+            )
+            return _set_params_from_metadata(model, metadata)

@@ -1,0 +1,83 @@
+"""Pure-numpy model forwards for Spark EXECUTOR processes.
+
+The adapter's contract is that executors need numpy only — no JAX, no
+chip (adapter.py module docstring; the reference's executors likewise run
+JVM+CUDA-lib only, no Spark-side ML framework). Transform pandas_udfs
+therefore close over plain numpy parameter arrays plus the functions in
+THIS module (imported by reference on the executor, pulling in nothing but
+numpy) — never over core model objects, whose modules import jax at the
+top level.
+
+The math mirrors the core kernels exactly: ``logistic_forward`` twins
+ops/logistic.predict_logistic (raw = [-z, z] margins for binomial, logits
+for multinomial); ``forest_forward`` twins ops/trees.forest_apply +
+forest_predict_proba (heap-indexed routing, LEFT when x[feature] <=
+threshold, probs = mean leaf distribution, raw = vote mass).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def logistic_forward(
+    weights: np.ndarray,  # (d, 1) binomial or (d, C) multinomial
+    intercepts: np.ndarray,  # (1,) or (C,)
+    threshold: float,
+    block: np.ndarray,  # (n, d)
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (raw, probabilities, predictions) for one row block."""
+    logits = block @ weights + intercepts
+    if weights.shape[1] == 1:
+        z = logits[:, 0]
+        # Overflow-safe sigmoid: exp of a non-positive argument only.
+        t = np.exp(-np.abs(z))
+        p1 = np.where(z >= 0, 1.0 / (1.0 + t), t / (1.0 + t))
+        probs = np.stack([1.0 - p1, p1], axis=1)
+        raw = np.stack([-z, z], axis=1)
+        pred = (p1 > threshold).astype(np.float64)
+    else:
+        m = logits - logits.max(axis=1, keepdims=True)
+        e = np.exp(m)
+        probs = e / e.sum(axis=1, keepdims=True)
+        raw = logits
+        pred = np.argmax(logits, axis=1).astype(np.float64)
+    return raw, probs, pred
+
+
+def forest_forward(
+    feature: np.ndarray,  # (T, N) int, -1 at leaves
+    threshold: np.ndarray,  # (T, N)
+    is_leaf: np.ndarray,  # (T, N) bool
+    leaf_value: np.ndarray,  # (T, N, C) per-leaf class distribution
+    max_depth: int,
+    block: np.ndarray,  # (n, d)
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (raw vote mass, probabilities, predictions) for one block."""
+    T = feature.shape[0]
+    n = block.shape[0]
+    idx = np.zeros((T, n), dtype=np.int64)
+    f_clip = np.maximum(feature, 0)
+    for _ in range(max_depth):
+        f = np.take_along_axis(f_clip, idx, axis=1)  # (T, n)
+        leaf = np.take_along_axis(is_leaf, idx, axis=1)
+        thr = np.take_along_axis(threshold, idx, axis=1)
+        xv = block[np.arange(n)[None, :], f]
+        child = 2 * idx + 1 + (xv > thr)
+        idx = np.where(leaf, idx, child)
+    n_classes = leaf_value.shape[2]
+    probs = np.stack(
+        [
+            np.take_along_axis(leaf_value[:, :, c], idx, axis=1).mean(axis=0)
+            for c in range(n_classes)
+        ],
+        axis=1,
+    )
+    raw = probs * T
+    pred = np.argmax(probs, axis=1).astype(np.float64)
+    return raw, probs, pred
+
+
+__all__ = ["logistic_forward", "forest_forward"]

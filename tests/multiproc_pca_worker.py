@@ -40,11 +40,16 @@ def main() -> None:
     rng = np.random.default_rng(0)
     n, d = 1003, 12
     x = rng.normal(size=(n, d)) * np.linspace(1.0, 2.0, d) + 100.0
-    bounds = np.linspace(0, n, n_proc + 1).astype(int)
+    if os.environ.get("TPUML_TEST_EMPTY_LAST") == "1" and n_proc > 1:
+        # Deployment reality: one executor may hold no rows; the fit must
+        # neither crash it nor strand its peers in a collective.
+        bounds = np.linspace(0, n, n_proc).astype(int).tolist() + [n]
+    else:
+        bounds = np.linspace(0, n, n_proc + 1).astype(int)
     local = x[bounds[pid] : bounds[pid + 1]]
 
     mesh = dist.global_mesh()
-    model = PCA(mesh=mesh).setK(3).fit([local])
+    model = PCA(mesh=mesh).setK(3).fit([local] if local.shape[0] else [])
 
     from spark_rapids_ml_tpu.utils.testing import assert_components_close
 

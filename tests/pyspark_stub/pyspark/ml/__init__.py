@@ -1,0 +1,20 @@
+"""pyspark.ml stand-in: Estimator/Model/Transformer with the _fit/_transform
+dispatch contract."""
+
+from __future__ import annotations
+
+from pyspark.ml.param.shared import Params
+
+
+class Transformer(Params):
+    def transform(self, dataset):
+        return self._transform(dataset)
+
+
+class Estimator(Params):
+    def fit(self, dataset):
+        return self._fit(dataset)
+
+
+class Model(Transformer):
+    pass

@@ -1,0 +1,33 @@
+"""pyspark.ml.functions subset: vector_to_array / array_to_vector as
+column expressions."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pyspark.sql import Column
+from pyspark.ml.linalg import DenseVector
+
+
+def vector_to_array(column: Column, dtype: str = "float64") -> Column:
+    def convert(series):
+        import pandas as pd
+
+        return pd.Series(
+            [np.asarray(v.toArray(), dtype=np.float64) for v in series],
+            dtype=object,
+        )
+
+    return Column("udf", name="vector_to_array", fn=convert, args=[column])
+
+
+def array_to_vector(column: Column) -> Column:
+    def convert(series):
+        import pandas as pd
+
+        return pd.Series(
+            [DenseVector(np.asarray(v, dtype=np.float64)) for v in series],
+            dtype=object,
+        )
+
+    return Column("udf", name="array_to_vector", fn=convert, args=[column])

@@ -1,0 +1,61 @@
+"""pyspark.ml.linalg subset: DenseVector / SparseVector / DenseMatrix /
+Vectors with the toArray contracts the adapter relies on."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DenseVector:
+    def __init__(self, values):
+        self.values = np.asarray(values, dtype=np.float64)
+
+    def toArray(self) -> np.ndarray:
+        return self.values
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __repr__(self) -> str:
+        return f"DenseVector({self.values.tolist()})"
+
+
+class SparseVector:
+    def __init__(self, size, indices, values):
+        self.size = int(size)
+        self.indices = np.asarray(indices, dtype=np.int32)
+        self.values = np.asarray(values, dtype=np.float64)
+
+    def toArray(self) -> np.ndarray:
+        out = np.zeros(self.size, dtype=np.float64)
+        out[self.indices] = self.values
+        return out
+
+    def __len__(self) -> int:
+        return self.size
+
+
+class DenseMatrix:
+    """Column-major storage, like Spark's."""
+
+    def __init__(self, numRows, numCols, values, isTransposed=False):
+        self.numRows = int(numRows)
+        self.numCols = int(numCols)
+        self.values = np.asarray(values, dtype=np.float64)
+        self.isTransposed = bool(isTransposed)
+
+    def toArray(self) -> np.ndarray:
+        order = "C" if self.isTransposed else "F"
+        return self.values.reshape((self.numRows, self.numCols), order=order)
+
+
+class Vectors:
+    @staticmethod
+    def dense(*values):
+        if len(values) == 1 and isinstance(values[0], (list, tuple, np.ndarray)):
+            return DenseVector(values[0])
+        return DenseVector(values)
+
+    @staticmethod
+    def sparse(size, indices, values):
+        return SparseVector(size, indices, values)

@@ -1,0 +1,3 @@
+from pyspark.ml.param.shared import Param, Params, TypeConverters
+
+__all__ = ["Param", "Params", "TypeConverters"]

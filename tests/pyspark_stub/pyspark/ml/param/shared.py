@@ -1,0 +1,109 @@
+"""pyspark.ml.param machinery subset: Param descriptors declared on the
+class with ``Params._dummy()`` parents, per-instance value/default maps,
+TypeConverters applied on ``_set``."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict
+
+
+class TypeConverters:
+    @staticmethod
+    def toInt(v) -> int:
+        return int(v)
+
+    @staticmethod
+    def toFloat(v) -> float:
+        return float(v)
+
+    @staticmethod
+    def toString(v) -> str:
+        return str(v)
+
+    @staticmethod
+    def toBoolean(v) -> bool:
+        if isinstance(v, bool):
+            return v
+        raise TypeError(f"Boolean Param requires value of type bool, got {v!r}")
+
+    @staticmethod
+    def toList(v) -> list:
+        return list(v)
+
+    @staticmethod
+    def identity(v):
+        return v
+
+
+class Param:
+    def __init__(self, parent, name: str, doc: str, typeConverter=None):
+        self.parent = getattr(parent, "uid", parent)
+        self.name = name
+        self.doc = doc
+        self.typeConverter = typeConverter or TypeConverters.identity
+
+    def __repr__(self) -> str:
+        return f"Param({self.parent}__{self.name})"
+
+
+class Params:
+    """Like pyspark, the value maps (`_paramMap` / `_defaultParamMap`) are
+    keyed by the Param OBJECTS (shared class attributes), not by name —
+    consumers such as persistence writers iterate `p.name for p in map`."""
+
+    def __init__(self):
+        self.uid = f"{type(self).__name__}_{uuid.uuid4().hex[:12]}"
+        self._paramMap: Dict[Param, Any] = {}
+        self._defaultParamMap: Dict[Param, Any] = {}
+
+    @classmethod
+    def _dummy(cls) -> "Params":
+        dummy = object.__new__(Params)
+        dummy.uid = "undefined"
+        return dummy
+
+    def _params_by_name(self) -> Dict[str, Param]:
+        out = {}
+        for klass in type(self).__mro__:
+            for name, value in vars(klass).items():
+                if isinstance(value, Param) and name not in out:
+                    out[name] = value
+        return out
+
+    def hasParam(self, name: str) -> bool:
+        return name in self._params_by_name()
+
+    def getParam(self, name: str) -> Param:
+        try:
+            return self._params_by_name()[name]
+        except KeyError as e:
+            raise AttributeError(f"no param {name}") from e
+
+    def _resolve(self, param) -> Param:
+        return param if isinstance(param, Param) else self.getParam(param)
+
+    def _set(self, **kwargs) -> "Params":
+        for name, value in kwargs.items():
+            param = self.getParam(name)
+            self._paramMap[param] = param.typeConverter(value)
+        return self
+
+    def _setDefault(self, **kwargs) -> "Params":
+        for name, value in kwargs.items():
+            param = self.getParam(name)
+            self._defaultParamMap[param] = param.typeConverter(value)
+        return self
+
+    def isSet(self, param) -> bool:
+        return self._resolve(param) in self._paramMap
+
+    def isDefined(self, param) -> bool:
+        p = self._resolve(param)
+        return p in self._paramMap or p in self._defaultParamMap
+
+    def getOrDefault(self, param):
+        p = self._resolve(param)
+        if p in self._paramMap:
+            return self._paramMap[p]
+        return self._defaultParamMap[p]

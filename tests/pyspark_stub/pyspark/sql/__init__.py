@@ -1,0 +1,210 @@
+"""Local pyspark.sql stand-in: Row / Column expressions / DataFrame /
+SparkSession over partitioned Python lists, with the RDD ops the adapter
+uses (map / first / take / mapPartitions / treeReduce / toLocalIterator)
+running the adapter's own callables through a pickle round-trip, like a
+real cluster would."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Sequence
+
+from pyspark import _pickle_roundtrip
+
+
+class Row(tuple):
+    """Named tuple-alike: field access by name or index."""
+
+    def __new__(cls, fields: Sequence[str], values: Sequence[Any]):
+        row = super().__new__(cls, values)
+        row._fields = list(fields)
+        return row
+
+    def __getattr__(self, name):
+        try:
+            return self[self._fields.index(name)]
+        except ValueError as e:
+            raise AttributeError(name) from e
+
+    def asDict(self):
+        return dict(zip(self._fields, self))
+
+
+class Column:
+    """Expression node: a column reference or a function of columns."""
+
+    def __init__(self, kind: str, name: str = "", fn: Callable = None, args=None):
+        self.kind = kind  # "ref" | "udf"
+        self.name = name
+        self.fn = fn
+        self.args = list(args or [])
+
+
+class RDD:
+    def __init__(self, partitions: List[list]):
+        self._parts = [list(p) for p in partitions]
+
+    def map(self, f) -> "RDD":
+        f = _pickle_roundtrip(f)
+        return RDD([[f(x) for x in p] for p in self._parts])
+
+    def mapPartitions(self, f) -> "RDD":
+        f = _pickle_roundtrip(f)
+        return RDD([list(f(iter(p))) for p in self._parts])
+
+    def persist(self, *_) -> "RDD":
+        return self  # local lists are already materialized
+
+    def cache(self) -> "RDD":
+        return self
+
+    def unpersist(self, *_) -> "RDD":
+        return self
+
+    def first(self):
+        for p in self._parts:
+            if p:
+                return p[0]
+        raise ValueError("empty RDD")
+
+    def take(self, n: int) -> list:
+        out = []
+        for p in self._parts:
+            for x in p:
+                if len(out) >= n:
+                    return out
+                out.append(x)
+        return out
+
+    def takeSample(self, withReplacement: bool, num: int, seed: int = 0) -> list:
+        import numpy as _np
+
+        all_rows = self.collect()
+        rng = _np.random.default_rng(seed)
+        if not all_rows:
+            return []
+        idx = rng.choice(
+            len(all_rows), size=min(num, len(all_rows)) if not withReplacement else num,
+            replace=withReplacement,
+        )
+        return [all_rows[i] for i in idx]
+
+    def collect(self) -> list:
+        return [x for p in self._parts for x in p]
+
+    def toLocalIterator(self):
+        for p in self._parts:
+            yield from p
+
+    def count(self) -> int:
+        return sum(len(p) for p in self._parts)
+
+    def treeReduce(self, op, depth: int = 2):
+        op = _pickle_roundtrip(op)
+        partials = []
+        for p in self._parts:
+            acc = None
+            for x in p:
+                # Values crossing the executor->driver boundary are
+                # serialized on a real cluster.
+                acc = x if acc is None else op(acc, x)
+            if acc is not None:
+                partials.append(_pickle_roundtrip(acc))
+        if not partials:
+            raise ValueError("empty RDD")
+        acc = partials[0]
+        for x in partials[1:]:
+            acc = op(acc, x)
+        return acc
+
+    def getNumPartitions(self) -> int:
+        return len(self._parts)
+
+
+class DataFrame:
+    def __init__(self, schema: List[str], partitions: List[List[Row]]):
+        self._schema = list(schema)
+        self._parts = partitions
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._schema)
+
+    @property
+    def rdd(self) -> RDD:
+        return RDD(self._parts)
+
+    def count(self) -> int:
+        return sum(len(p) for p in self._parts)
+
+    def collect(self) -> List[Row]:
+        return [r for p in self._parts for r in p]
+
+    def select(self, *cols_) -> "DataFrame":
+        names = [c if isinstance(c, str) else c.name for c in cols_]
+        idx = [self._schema.index(n) for n in names]
+        parts = [
+            [Row(names, [r[i] for i in idx]) for r in p] for p in self._parts
+        ]
+        return DataFrame(names, parts)
+
+    def _eval_column(self, column: Column, part: List[Row]) -> list:
+        if column.kind == "ref":
+            i = self._schema.index(column.name)
+            return [r[i] for r in part]
+        import pandas as pd
+
+        args = [
+            pd.Series(self._eval_column(a, part), dtype=object)
+            for a in column.args
+        ]
+        out = column.fn(*args)
+        return list(out)
+
+    def drop(self, *names) -> "DataFrame":
+        keep = [c for c in self._schema if c not in names]
+        return self.select(*keep)
+
+    def withColumn(self, name: str, column: Column) -> "DataFrame":
+        schema = self._schema + ([name] if name not in self._schema else [])
+        parts = []
+        for p in self._parts:
+            vals = self._eval_column(column, p)
+            rows = []
+            for r, v in zip(p, vals):
+                d = list(r)
+                if name in self._schema:
+                    d[self._schema.index(name)] = v
+                    rows.append(Row(schema, d))
+                else:
+                    rows.append(Row(schema, d + [v]))
+            parts.append(rows)
+        return DataFrame(schema, parts)
+
+
+class SparkSession:
+    class Builder:
+        def master(self, _):
+            return self
+
+        def appName(self, _):
+            return self
+
+        def config(self, *_, **__):
+            return self
+
+        def getOrCreate(self) -> "SparkSession":
+            return SparkSession()
+
+    builder = Builder()
+
+    def createDataFrame(self, data, schema, numPartitions: int = 2) -> DataFrame:
+        rows = [Row(schema, list(r)) for r in data]
+        if not rows:
+            return DataFrame(list(schema), [[]])
+        per = max(1, -(-len(rows) // numPartitions))
+        parts = [rows[i : i + per] for i in range(0, len(rows), per)]
+        return DataFrame(list(schema), parts)
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,32 @@
+"""pyspark.sql.functions subset."""
+
+from __future__ import annotations
+
+import functools
+
+from pyspark.sql import Column
+
+
+def col(name: str) -> Column:
+    return Column("ref", name=name)
+
+
+def lit(value) -> Column:
+    def constant(series):
+        import pandas as pd
+
+        return pd.Series([value] * len(series), dtype=object)
+
+    return Column("udf", name="lit", fn=constant, args=[Column("ref", name="__first__")])
+
+
+def pandas_udf(returnType):
+    def decorate(fn):
+        @functools.wraps(fn)
+        def apply(*cols):
+            return Column("udf", name=fn.__name__, fn=fn, args=cols)
+
+        apply.__is_pandas_udf__ = True
+        return apply
+
+    return decorate

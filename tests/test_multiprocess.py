@@ -83,11 +83,7 @@ class TestShardRowsFromPartitions:
 
 
 class TestMultiProcess:
-    def test_4_process_distributed_pca(self):
-        """4 OS processes x 2 virtual CPU devices = an 8-way data-parallel
-        fit through PCA(mesh=...).fit(local_blocks), checked against the
-        full-dataset oracle in every process."""
-        n_proc = 4
+    def _run(self, n_proc, extra_env=None):
         port = _free_port()
         procs = []
         for pid in range(n_proc):
@@ -99,6 +95,7 @@ class TestMultiProcess:
                 TPUML_COORDINATOR=f"127.0.0.1:{port}",
                 TPUML_NUM_PROCESSES=str(n_proc),
                 TPUML_PROCESS_ID=str(pid),
+                **(extra_env or {}),
             )
             procs.append(
                 subprocess.Popen(
@@ -114,3 +111,15 @@ class TestMultiProcess:
         for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {pid} failed:\n{err[-3000:]}"
             assert f"OK process {pid}/{n_proc}" in out, out
+
+    def test_4_process_distributed_pca(self):
+        """4 OS processes x 2 virtual CPU devices = an 8-way data-parallel
+        fit through PCA(mesh=...).fit(local_blocks), checked against the
+        full-dataset oracle in every process."""
+        self._run(4)
+
+    def test_empty_executor_does_not_strand_peers(self):
+        """One process holds zero local rows; the fit must still complete
+        on every process with the identical oracle-checked model (the
+        asymmetric-failure/deadlock case)."""
+        self._run(3, extra_env={"TPUML_TEST_EMPTY_LAST": "1"})
