@@ -61,6 +61,7 @@ class RowMatrix:
         precision: str = "highest",
         dtype=None,
         input_dtype=None,
+        backend: str = "xla",
     ):
         # Streaming sources (block iterators / readers / iterator
         # factories) are never materialized: the covariance runs as a
@@ -84,6 +85,37 @@ class RowMatrix:
                 "precision='dd' is single-device; unset the mesh or use "
                 "precision='highest' (the mesh covariance path)"
             )
+        # Covariance kernel backend for the GEMM path. Measured on v5e at
+        # 1M x 1024 f32/HIGHEST (BASELINE.md): XLA whole-array fusion 24.9
+        # TFLOP/s > pallas fused streaming 22.0 > XLA scan-blocked 21.7 —
+        # so "xla" is the default and "pallas" is the explicit choice when
+        # row blocking is required anyway (it keeps the centered tile and
+        # accumulator in VMEM, beating the scan path's HBM round-trip).
+        if backend not in ("xla", "pallas"):
+            raise ValueError(f"backend must be 'xla' or 'pallas', got {backend!r}")
+        if backend == "pallas":
+            # The explicit kernel choice must never be silently dropped:
+            # only the materialized single-device GEMM route consults it.
+            if mesh is not None:
+                raise ValueError("backend='pallas' has no mesh path; use 'xla'")
+            if self.partitions is None:
+                raise ValueError(
+                    "backend='pallas' has no streaming path; use 'xla'"
+                )
+            if not use_gemm:
+                raise ValueError(
+                    "backend='pallas' applies to the GEMM path (useGemm=True)"
+                )
+            if self.precision == "dd":
+                if precision == "auto":
+                    # pallas IS an fp32-kernel choice; auto must not route
+                    # fp64 input to the (incompatible) dd path under it.
+                    self.precision = "highest"
+                else:
+                    raise ValueError(
+                        "precision='dd' has its own kernels; use backend='xla'"
+                    )
+        self.backend = backend
         self._dtype = dtype
         self._num_rows: Optional[int] = None
         self._num_cols: Optional[int] = None
@@ -185,10 +217,21 @@ class RowMatrix:
         """Per-partition fused centered Gram + host partial sum (:168-201)."""
         device = self._device()
         acc = None
+        use_pallas = self.backend == "pallas"
+        if use_pallas:
+            from spark_rapids_ml_tpu.ops.pallas.covariance import (
+                centered_gram_pallas,
+            )
+
+            # The interpreter covers non-TPU platforms (CI's CPU mesh).
+            interpret = jax.default_backend() != "tpu"
         for part in self.partitions:
             with TraceRange("gemm", TraceColor.GREEN):
                 blk = jax.device_put(np.asarray(part, dtype=self.dtype), device)
-                gram = centered_gram(blk, mean, precision=self.precision)
+                if use_pallas:
+                    gram = centered_gram_pallas(blk, mean, interpret=interpret)
+                else:
+                    gram = centered_gram(blk, mean, precision=self.precision)
             acc = gram if acc is None else acc + gram
         return acc / (self.num_rows - 1)
 

@@ -62,12 +62,18 @@ class _PCAParams(HasInputCol, HasOutputCol):
         "auto | default | high | highest | dd (double-float fp64 emulation)",
         toString,
     )
+    covarianceBackend = Param(
+        "_",
+        "covarianceBackend",
+        "xla (fused, default) | pallas (VMEM-resident streaming kernel)",
+        toString,
+    )
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
         self._setDefault(
             meanCentering=True, useGemm=True, useCuSolverSVD=True, gpuId=-1,
-            solver="auto", precision="auto",
+            solver="auto", precision="auto", covarianceBackend="xla",
         )
 
     def getK(self) -> int:
@@ -90,6 +96,9 @@ class _PCAParams(HasInputCol, HasOutputCol):
 
     def getPrecision(self) -> str:
         return self.getOrDefault(self.precision)
+
+    def getCovarianceBackend(self) -> str:
+        return self.getOrDefault(self.covarianceBackend)
 
 
 class PCA(_PCAParams, Estimator, MLReadable):
@@ -143,6 +152,16 @@ class PCA(_PCAParams, Estimator, MLReadable):
         self.set(self.precision, validate_precision(value))
         return self
 
+    def setCovarianceBackend(self, value: str) -> "PCA":
+        """Kernel backend for the covariance GEMM. Measured on v5e
+        (BASELINE.md): "xla" (whole-array fusion) is fastest when the
+        dataset fits HBM; "pallas" fuses centering + accumulation in VMEM
+        and beats the XLA scan path when row-blocking is required."""
+        if value not in ("xla", "pallas"):
+            raise ValueError(f"covarianceBackend must be xla|pallas, got {value!r}")
+        self.set(self.covarianceBackend, value)
+        return self
+
     # Above this many features, "auto" switches to the randomized sketch:
     # the (d, d) covariance + full eigh grow as d^2 / d^3 while the sketch
     # stays O(n d l) with l = k + oversample.
@@ -170,6 +189,17 @@ class PCA(_PCAParams, Estimator, MLReadable):
                 "the randomized solver has no dd path; use "
                 "solver='covariance' with precision='dd'"
             )
+        if self.getCovarianceBackend() == "pallas" and (
+            self.mesh is not None
+            or streaming
+            or not self.getUseGemm()
+            or solver == "randomized"
+        ):
+            raise ValueError(
+                "covarianceBackend='pallas' applies to the single-device "
+                "materialized GEMM covariance path (no mesh, no streaming "
+                "source, useGemm=True, solver != 'randomized')"
+            )
         # Resolve "auto" against the RAW input dtype (before densification
         # coerces to float64) so only genuinely-fp64 sources route to dd —
         # RowMatrix.resolve is the single home of this policy.
@@ -194,6 +224,14 @@ class PCA(_PCAParams, Estimator, MLReadable):
                 infer_input_dtype(probe_source) if requested_prec == "auto" else None
             ),
         )
+        if self.getCovarianceBackend() == "pallas" and resolved_prec == "dd":
+            if requested_prec == "dd":
+                raise ValueError(
+                    "precision='dd' has its own kernels; use "
+                    "covarianceBackend='xla'"
+                )
+            # auto-resolved dd yields to the explicit fp32 kernel choice.
+            resolved_prec = "highest"
         # 'auto' peeks at the first partition/row only — the covariance
         # path streams partitions, so routing must not force a densify.
         # An auto-resolved dd forces the covariance path (the sketch is
@@ -203,6 +241,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
             and self.mesh is None
             and resolved_prec != "dd"
             and not streaming  # a stream cannot be peeked or materialized
+            and self.getCovarianceBackend() != "pallas"  # explicit kernel choice
             and num_features(rows) >= self._RANDOMIZED_AUTO_DIM
         ):
             return self._fit_randomized(rows)
@@ -214,6 +253,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
             device_id=self.getGpuId(),
             mesh=self.mesh,
             precision=resolved_prec,
+            backend=self.getCovarianceBackend(),
         )
         pc, explained = mat.compute_principal_components_and_explained_variance(self.getK())
         model = PCAModel(self.uid, np.asarray(pc), np.asarray(explained))

@@ -394,7 +394,7 @@ class RandomForestClassificationModel(_RandomForestParams, Model):
     def predictRaw(self, x) -> np.ndarray:
         """Spark RF rawPrediction: unnormalized per-class vote mass (mean
         leaf distribution scaled by the tree count)."""
-        return self.predictProbability(x) * len(np.asarray(self._forest.feature))
+        return self.predictProbability(x) * self._forest.feature.shape[0]
 
     def transform(self, dataset: Any) -> Any:
         rows = extract_features(dataset, self.getFeaturesCol(), drop=self.getLabelCol())
