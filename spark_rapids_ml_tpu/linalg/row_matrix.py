@@ -79,7 +79,9 @@ class RowMatrix:
         self.use_accel_svd = use_accel_svd
         self.device_id = device_id
         self.mesh = mesh
-        self.precision = self.resolve(precision, mesh=mesh, input_dtype=input_dtype)
+        self.precision = self.resolve(
+            precision, mesh=mesh, input_dtype=input_dtype, backend=backend
+        )
         if self.precision == "dd" and mesh is not None:
             raise ValueError(
                 "precision='dd' is single-device; unset the mesh or use "
@@ -91,8 +93,6 @@ class RowMatrix:
         # so "xla" is the default and "pallas" is the explicit choice when
         # row blocking is required anyway (it keeps the centered tile and
         # accumulator in VMEM, beating the scan path's HBM round-trip).
-        if backend not in ("xla", "pallas"):
-            raise ValueError(f"backend must be 'xla' or 'pallas', got {backend!r}")
         if backend == "pallas":
             # The explicit kernel choice must never be silently dropped:
             # only the materialized single-device GEMM route consults it.
@@ -106,22 +106,13 @@ class RowMatrix:
                 raise ValueError(
                     "backend='pallas' applies to the GEMM path (useGemm=True)"
                 )
-            if self.precision == "dd":
-                if precision == "auto":
-                    # pallas IS an fp32-kernel choice; auto must not route
-                    # fp64 input to the (incompatible) dd path under it.
-                    self.precision = "highest"
-                else:
-                    raise ValueError(
-                        "precision='dd' has its own kernels; use backend='xla'"
-                    )
         self.backend = backend
         self._dtype = dtype
         self._num_rows: Optional[int] = None
         self._num_cols: Optional[int] = None
 
     @staticmethod
-    def resolve(precision: str, mesh=None, input_dtype=None) -> str:
+    def resolve(precision: str, mesh=None, input_dtype=None, backend: str = "xla") -> str:
         """THE home of precision-request resolution (PCA calls this too —
         keep the policy in one place). ``input_dtype`` is the dtype of the
         RAW user container, probed by the caller before as_partitions
@@ -130,10 +121,21 @@ class RowMatrix:
         post-coercion) — it resolves to "highest" rather than silently
         routing every fit through the slow dd emulation. With a mesh,
         "auto" defers to the mesh covariance path (dd has no mesh route).
+        Under ``backend="pallas"`` (an fp32-kernel choice), auto-resolved
+        dd yields to "highest"; explicit dd is an error.
         """
+        if backend not in ("xla", "pallas"):
+            raise ValueError(f"backend must be 'xla' or 'pallas', got {backend!r}")
         if precision == "auto" and mesh is not None:
             return "highest"
-        return resolve_precision(precision, input_dtype=input_dtype)
+        resolved = resolve_precision(precision, input_dtype=input_dtype)
+        if backend == "pallas" and resolved == "dd":
+            if precision == "dd":
+                raise ValueError(
+                    "precision='dd' has its own kernels; use backend='xla'"
+                )
+            return "highest"
+        return resolved
 
     # --- shape (lazy, like numRows/numCols via count()/first(), :48-57) ---
 
@@ -225,6 +227,13 @@ class RowMatrix:
 
             # The interpreter covers non-TPU platforms (CI's CPU mesh).
             interpret = jax.default_backend() != "tpu"
+            if not interpret and np.dtype(self.dtype) == np.float64:
+                # Mosaic has no f64 MXU dot — fail clearly instead of at
+                # kernel compile (reachable only with x64 forced on TPU).
+                raise ValueError(
+                    "backend='pallas' compiles f32 kernels; disable x64 or "
+                    "pass dtype=jnp.float32 (or use backend='xla')"
+                )
         for part in self.partitions:
             with TraceRange("gemm", TraceColor.GREEN):
                 blk = jax.device_put(np.asarray(part, dtype=self.dtype), device)

@@ -286,6 +286,9 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
         return m
 
     def _build_index(self) -> None:
+        # With a mesh, the BUILD is distributed too: the coarse quantizer
+        # and PQ codebook Lloyds shard their rows over the data axis
+        # (previously only the search side was sharded).
         params = self.getAlgoParams()
         if self.getAlgorithm() == "ivfpq":
             self._index = build_ivfpq_index(
@@ -296,6 +299,7 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
                 seed=self.getSeed(),
                 kmeans_iters=int(params.get("kmeans_iters", 10)),
                 pq_iters=int(params.get("pq_iters", 10)),
+                mesh=self.mesh,
             )
         else:
             self._index = build_ivf_index(
@@ -303,6 +307,7 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
                 n_lists=self._effective_nlist(),
                 seed=self.getSeed(),
                 kmeans_iters=int(params.get("kmeans_iters", 10)),
+                mesh=self.mesh,
             )
 
     def kneighbors(

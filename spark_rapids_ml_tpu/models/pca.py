@@ -223,15 +223,8 @@ class PCA(_PCAParams, Estimator, MLReadable):
             input_dtype=(
                 infer_input_dtype(probe_source) if requested_prec == "auto" else None
             ),
+            backend=self.getCovarianceBackend(),
         )
-        if self.getCovarianceBackend() == "pallas" and resolved_prec == "dd":
-            if requested_prec == "dd":
-                raise ValueError(
-                    "precision='dd' has its own kernels; use "
-                    "covarianceBackend='xla'"
-                )
-            # auto-resolved dd yields to the explicit fp32 kernel choice.
-            resolved_prec = "highest"
         # 'auto' peeks at the first partition/row only — the covariance
         # path streams partitions, so routing must not force a densify.
         # An auto-resolved dd forces the covariance path (the sketch is

@@ -252,7 +252,18 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
                 emb0 = 10.0 * jax.random.uniform(
                     k_init, (n, dim), minval=-1.0, maxval=1.0
                 )
-            emb = optimize_layout(
+            if self.mesh is not None:
+                # Mesh fit: the epoch SGD shards its edges over the data
+                # axis too (one delta psum per epoch) — both heavy stages
+                # (kNN graph AND layout optimization) are distributed.
+                import functools
+
+                from spark_rapids_ml_tpu.ops.umap import optimize_layout_sharded
+
+                optimizer = functools.partial(optimize_layout_sharded, self.mesh)
+            else:
+                optimizer = optimize_layout
+            emb = optimizer(
                 emb0.astype(jnp.float32),
                 graph,
                 k_opt,
