@@ -13,6 +13,11 @@ import time
 from typing import Callable
 
 
+# v5e bf16 MXU peak, the denominator for every %-of-peak / MFU figure in
+# this repo (bench.py and the precision sweep must agree on it).
+PEAK_BF16_TFLOPS = 197.0
+
+
 def time_median(fn: Callable[[], None], repeats: int = 3) -> float:
     """Median wall-clock of ``fn`` over ``repeats`` runs (after 1 warmup)."""
     fn()  # warmup: compile

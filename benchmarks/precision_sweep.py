@@ -1,0 +1,101 @@
+"""Precision sweep: accuracy + throughput of the covariance GEMM per
+matmul precision, against the fp64 host oracle.
+
+Prints a markdown table (recorded in BASELINE.md) justifying the per-op
+precision defaults from data (VERDICT r1 weak item 3): DEFAULT is one
+bf16 pass, HIGH three, HIGHEST six; dd is the double-float emulation.
+
+Accuracy is measured on ILL-CONDITIONED input (column means >> stddevs,
+the case that exposes precision loss); throughput on the bench.py shape.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import PEAK_BF16_TFLOPS  # noqa: E402
+
+# An N-pass f32 emulation divides the bf16 peak.
+PASSES = {"default": 1, "high": 3, "highest": 6}
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.common import time_amortized
+    from spark_rapids_ml_tpu.ops.covariance import centered_gram
+    from spark_rapids_ml_tpu.ops.doubledouble import covariance_dd_blocks
+
+    # --- accuracy: 20k x 256, means ~1e4, unit-ish stddevs (small: the
+    # accuracy inputs cross the ~20 MB/s relay tunnel) ---
+    rng = np.random.default_rng(0)
+    d_acc = 256
+    n_acc = 20_000
+    x_acc = 1e4 * (1.0 + np.arange(d_acc)) / d_acc + np.linspace(
+        1.0, 2.0, d_acc
+    ) * rng.normal(size=(n_acc, d_acc))
+    oracle = np.cov(x_acc, rowvar=False)
+    mean64 = x_acc.mean(axis=0)
+
+    accs = {}
+    xj = jnp.asarray(x_acc, dtype=jnp.float32)
+    mj = jnp.asarray(mean64, dtype=jnp.float32)
+    for prec in ("default", "high", "highest"):
+        cov = np.asarray(centered_gram(xj, mj, precision=prec)) / (n_acc - 1)
+        accs[prec] = float(np.max(np.abs(cov - oracle)))
+    _, cov_dd, _ = covariance_dd_blocks([x_acc])
+    accs["dd"] = float(np.max(np.abs(cov_dd - oracle)))
+
+    # --- throughput: 1M x 1024 f32 on-device ---
+    n, d = 1_000_000, 1024
+    x = jax.random.normal(jax.random.key(7), (n, d), dtype=jnp.float32)
+    mean = jnp.mean(x, axis=0)
+    float(mean[0])
+    flop = 2.0 * n * d * d
+    thr = {}
+    for prec in ("default", "high", "highest"):
+        t = time_amortized(
+            lambda prec=prec: centered_gram(x, mean, precision=prec),
+            lambda ev: float(ev[0, 0]),
+            inner=5,
+        )
+        thr[prec] = flop / t / 1e12
+    # dd DEVICE throughput: time matmul_dd on on-device split operands
+    # (host split + transfer would measure the relay tunnel, not the
+    # kernel). Logical FLOPs = the one fp64 GEMM being emulated.
+    from spark_rapids_ml_tpu.ops.doubledouble import matmul_dd
+
+    n_dd = 200_000
+    a_hi = jax.random.normal(jax.random.key(1), (d, n_dd), dtype=jnp.float32)
+    a_lo = a_hi * 1e-8
+    b_hi = jnp.swapaxes(a_hi, 0, 1)
+    b_lo = b_hi * 1e-8
+    float(a_hi[0, 0])
+    t = time_amortized(
+        lambda: matmul_dd(a_hi, a_lo, b_hi, b_lo)[0],
+        lambda ev: float(ev[0, 0]),
+        inner=3,
+    )
+    thr["dd"] = (2.0 * n_dd * d * d) / t / 1e12
+
+    print("| precision | passes | max abs err vs fp64 (ill-cond.) | TFLOP/s | % of bf16 peak |")
+    print("|---|---|---|---|---|")
+    for prec in ("default", "high", "highest"):
+        print(
+            f"| {prec} | {PASSES[prec]}x bf16 | {accs[prec]:.2e} | "
+            f"{thr[prec]:.1f} | {100 * thr[prec] / PEAK_BF16_TFLOPS:.0f}% |"
+        )
+    print(
+        f"| dd | 3x HIGHEST-matmul scan | {accs['dd']:.2e} | {thr['dd']:.1f} "
+        f"(device kernel only) | {100 * thr['dd'] / PEAK_BF16_TFLOPS:.0f}% |"
+    )
+
+
+if __name__ == "__main__":
+    main()

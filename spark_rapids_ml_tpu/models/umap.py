@@ -213,11 +213,12 @@ def _knn_excluding_self(x: jax.Array, k: int, metric: str, mesh=None,
 class UMAP(_UMAPParams, Estimator, MLReadable):
     """``UMAP().setNNeighbors(15).setNComponents(2).fit(x)``.
 
-    With a mesh, the kNN graph build — the O(n^2 d) stage — shards items
-    over the data axis (local top-k + all-gathered candidate merge over
-    ICI, :func:`ops.knn.knn_sharded`); the layout optimization stays
-    replicated (its working set is the O(n k) edge list, tiny next to the
-    distance matrix the graph stage avoids materializing).
+    With a mesh, BOTH heavy stages are distributed: the kNN graph build —
+    the O(n^2 d) stage — shards items over the data axis (local top-k +
+    all-gathered candidate merge over ICI, :func:`ops.knn.knn_sharded`),
+    and the layout SGD shards its edges over the same axis with one
+    (n, dim) delta psum per epoch
+    (:func:`ops.umap.optimize_layout_sharded`).
     """
 
     def __init__(self, uid: Optional[str] = None, mesh=None):

@@ -18,7 +18,7 @@ once instead of umap-learn's per-point Python loop.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple, Tuple
 
 import jax
@@ -210,60 +210,19 @@ def optimize_layout(
     return y
 
 
-def optimize_layout_sharded(
-    mesh,
-    embedding: jax.Array,
-    graph: FuzzyGraph,
-    key: jax.Array,
-    *,
-    n_epochs: int,
-    neg_rate: int = 5,
-    learning_rate: float = 1.0,
-    repulsion: float = 1.0,
-    a: float = 1.577,
-    b: float = 0.895,
-) -> jax.Array:
-    """Mesh-sharded synchronous-epoch layout optimization (fit mode).
-
-    The epoch is EDGE-parallel: edges (and their negative draws) shard over
-    the mesh data axis, each shard scatter-adds its gradient contributions
-    into a local (n, dim) delta, and ONE psum per epoch merges the deltas
-    over ICI — the embedding stays replicated, so the per-epoch wire cost
-    is the (n, dim) delta, independent of edge count (VERDICT r1 missing
-    item 6: previously only the kNN-graph stage sharded).
-
-    Negative samples are drawn per shard (key folded with the shard index),
-    so the draw SEQUENCE differs from the single-device path while the
-    sampling distribution and count per edge are identical — same
-    optimization, different RNG stream, like any reseeded SGD run.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+@lru_cache(maxsize=None)
+def _sharded_layout_fn(
+    mesh, n: int, n_epochs: int, neg_rate: int, learning_rate: float,
+    repulsion: float, a: float, b: float,
+):
+    """Build (and cache) the jitted shard_map epoch program for one
+    (mesh, shape, hyperparameter) combination — jit's cache is keyed on
+    the function object, so the closure must not be rebuilt per call (the
+    knn/ann/dbscan cached-builder pattern)."""
+    from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
-
-    n, dim = embedding.shape
-    k = graph.indices.shape[1]
-    src = jnp.broadcast_to(
-        jnp.arange(n, dtype=jnp.int32)[:, None], (n, k)
-    ).reshape(-1)
-    dst = graph.indices.reshape(-1)
-    w = graph.weight.reshape(-1)
-    e = src.shape[0]
-    dp = int(mesh.shape[DATA_AXIS])
-    pad = (-e) % dp
-    if pad:
-        # Padded edges carry zero weight: their attractive AND repulsive
-        # terms are scaled by w, so they contribute exactly nothing.
-        src = jnp.concatenate([src, jnp.zeros(pad, jnp.int32)])
-        dst = jnp.concatenate([dst, jnp.zeros(pad, jnp.int32)])
-        w = jnp.concatenate([w, jnp.zeros(pad, w.dtype)])
-
-    edge_sharding = NamedSharding(mesh, P(DATA_AXIS))
-    src = jax.device_put(src, edge_sharding)
-    dst = jax.device_put(dst, edge_sharding)
-    w = jax.device_put(w, edge_sharding)
-    y0 = jax.device_put(embedding, NamedSharding(mesh, P()))
 
     def local(src_b, dst_b, w_b, y0, key):
         key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
@@ -306,7 +265,68 @@ def optimize_layout_sharded(
         out_specs=P(),
         check_vma=False,  # the psum-merged y is replicated by construction
     )
-    return jax.jit(fit)(src, dst, w, y0.astype(jnp.float32), key)
+    return jax.jit(fit)
+
+
+def optimize_layout_sharded(
+    mesh,
+    embedding: jax.Array,
+    graph: FuzzyGraph,
+    key: jax.Array,
+    *,
+    n_epochs: int,
+    neg_rate: int = 5,
+    learning_rate: float = 1.0,
+    repulsion: float = 1.0,
+    a: float = 1.577,
+    b: float = 0.895,
+) -> jax.Array:
+    """Mesh-sharded synchronous-epoch layout optimization (fit mode).
+
+    The epoch is EDGE-parallel: edges (and their negative draws) shard over
+    the mesh data axis, each shard scatter-adds its gradient contributions
+    into a local (n, dim) delta, and ONE psum per epoch merges the deltas
+    over ICI — the embedding stays replicated, so the per-epoch wire cost
+    is the (n, dim) delta, independent of edge count (VERDICT r1 missing
+    item 6: previously only the kNN-graph stage sharded).
+
+    Negative samples are drawn per shard (key folded with the shard index),
+    so the draw SEQUENCE differs from the single-device path while the
+    sampling distribution and count per edge are identical — same
+    optimization, different RNG stream, like any reseeded SGD run.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    n, dim = embedding.shape
+    k = graph.indices.shape[1]
+    src = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None], (n, k)
+    ).reshape(-1)
+    dst = graph.indices.reshape(-1)
+    w = graph.weight.reshape(-1)
+    e = src.shape[0]
+    dp = int(mesh.shape[DATA_AXIS])
+    pad = (-e) % dp
+    if pad:
+        # Padded edges carry zero weight: their attractive AND repulsive
+        # terms are scaled by w, so they contribute exactly nothing.
+        src = jnp.concatenate([src, jnp.zeros(pad, jnp.int32)])
+        dst = jnp.concatenate([dst, jnp.zeros(pad, jnp.int32)])
+        w = jnp.concatenate([w, jnp.zeros(pad, w.dtype)])
+
+    edge_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    src = jax.device_put(src, edge_sharding)
+    dst = jax.device_put(dst, edge_sharding)
+    w = jax.device_put(w, edge_sharding)
+    y0 = jax.device_put(embedding.astype(jnp.float32), NamedSharding(mesh, P()))
+
+    fit = _sharded_layout_fn(
+        mesh, n, n_epochs, neg_rate, float(learning_rate), float(repulsion),
+        float(a), float(b),
+    )
+    return fit(src, dst, w, y0, key)
 
 
 def spectral_init(
