@@ -7,6 +7,13 @@ bf16 pass, HIGH three, HIGHEST six; dd is the double-float emulation.
 
 Accuracy is measured on ILL-CONDITIONED input (column means >> stddevs,
 the case that exposes precision loss); throughput on the bench.py shape.
+
+Accuracy rows measure END-TO-END PIPELINE error, which includes each
+path's input representation: default/high/highest consume the f32-cast
+input (their pipeline contract), while dd consumes the original fp64
+input (ITS contract — the hi+lo split carries ~48 mantissa bits, which
+is the whole point). Feeding dd an f32 cast would measure ~1e-6 cast
+error instead of the emulation floor.
 """
 
 from __future__ import annotations

@@ -211,20 +211,20 @@ def optimize_layout(
 
 
 @lru_cache(maxsize=None)
-def _sharded_layout_fn(
-    mesh, n: int, n_epochs: int, neg_rate: int, learning_rate: float,
-    repulsion: float, a: float, b: float,
-):
+def _sharded_layout_fn(mesh, n: int, n_epochs: int, neg_rate: int):
     """Build (and cache) the jitted shard_map epoch program for one
-    (mesh, shape, hyperparameter) combination — jit's cache is keyed on
-    the function object, so the closure must not be rebuilt per call (the
-    knn/ann/dbscan cached-builder pattern)."""
+    (mesh, shape) combination — jit's cache is keyed on the function
+    object, so the closure must not be rebuilt per call (the
+    knn/ann/dbscan cached-builder pattern). Float hyperparameters enter
+    as TRACED scalars, not cache keys: a tuning sweep over learning rate
+    or min_dist must reuse one executable, not pin one per float value.
+    """
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 
-    def local(src_b, dst_b, w_b, y0, key):
+    def local(src_b, dst_b, w_b, y0, key, learning_rate, repulsion, a, b):
         key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
 
         def epoch(ep, carry):
@@ -261,7 +261,10 @@ def _sharded_layout_fn(
     fit = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P(),
+            P(), P(), P(), P(),
+        ),
         out_specs=P(),
         check_vma=False,  # the psum-merged y is replicated by construction
     )
@@ -322,11 +325,13 @@ def optimize_layout_sharded(
     w = jax.device_put(w, edge_sharding)
     y0 = jax.device_put(embedding.astype(jnp.float32), NamedSharding(mesh, P()))
 
-    fit = _sharded_layout_fn(
-        mesh, n, n_epochs, neg_rate, float(learning_rate), float(repulsion),
-        float(a), float(b),
+    fit = _sharded_layout_fn(mesh, n, n_epochs, neg_rate)
+    f32 = jnp.float32
+    return fit(
+        src, dst, w, y0, key,
+        jnp.asarray(learning_rate, f32), jnp.asarray(repulsion, f32),
+        jnp.asarray(a, f32), jnp.asarray(b, f32),
     )
-    return fit(src, dst, w, y0, key)
 
 
 def spectral_init(

@@ -27,7 +27,7 @@ import numpy as np
 try:
     from pyspark import keyword_only  # noqa: F401
     from pyspark.ml import Estimator as SparkEstimator, Model as SparkModel
-    from pyspark.ml.linalg import DenseMatrix, DenseVector, Vectors
+    from pyspark.ml.linalg import DenseMatrix, DenseVector
     from pyspark.ml.param.shared import Param, Params, TypeConverters
     from pyspark.sql import functions as F  # noqa: F401
 

@@ -11,7 +11,7 @@ native library across tasks (SURVEY.md §3.5).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 

@@ -25,6 +25,14 @@ import jax
 jax.config.update("jax_platforms", _platform)
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: the suite is compile-dominated (~10 min
+# single-threaded, mostly XLA), and the cache survives across runs AND is
+# shared by pytest-xdist workers — second runs skip most compiles.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", "target", "jax_cache")
+os.makedirs(_cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np
 import pytest
 

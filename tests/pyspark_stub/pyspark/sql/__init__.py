@@ -6,7 +6,6 @@ real cluster would."""
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, List, Sequence
 
 from pyspark import _pickle_roundtrip

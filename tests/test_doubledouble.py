@@ -6,9 +6,7 @@ plain f32-HIGHEST by orders of magnitude on long contractions.
 """
 
 import numpy as np
-import pytest
 
-import jax
 import jax.numpy as jnp
 
 from spark_rapids_ml_tpu.ops.doubledouble import (

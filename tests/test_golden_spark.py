@@ -1,0 +1,288 @@
+"""Golden-file cross-compat: model directories in the EXACT shape upstream
+Spark writes them must load through this framework, and directories this
+framework writes must carry the exact structural schema Spark reads.
+
+No pyspark/JVM exists in this image, so the golden directories are
+byte-constructed here from Spark's documented on-disk contract
+(DefaultParamsWriter metadata JSON + snappy parquet with Spark's
+row-metadata key and MatrixUDT/VectorUDT structs — RapidsPCA.scala:218-254,
+SURVEY §3.4 "must keep this exact on-disk format"): Spark-style part file
+names, sparkVersion stamps, JVM class names, and nullable struct fields.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+from spark_rapids_ml_tpu.clustering import KMeansModel  # noqa: E402
+from spark_rapids_ml_tpu.feature import PCA, PCAModel  # noqa: E402
+from spark_rapids_ml_tpu.regression import LinearRegressionModel  # noqa: E402
+
+# Spark's MatrixUDT / VectorUDT arrow-side schemas, nullable like Spark's.
+_SPARK_MATRIX = pa.struct(
+    [
+        ("type", pa.int8()),
+        ("numRows", pa.int32()),
+        ("numCols", pa.int32()),
+        ("colPtrs", pa.list_(pa.int32())),
+        ("rowIndices", pa.list_(pa.int32())),
+        ("values", pa.list_(pa.float64())),
+        ("isTransposed", pa.bool_()),
+    ]
+)
+_SPARK_VECTOR = pa.struct(
+    [
+        ("type", pa.int8()),
+        ("size", pa.int32()),
+        ("indices", pa.list_(pa.int32())),
+        ("values", pa.list_(pa.float64())),
+    ]
+)
+
+
+def _write_spark_metadata(path, class_name, uid, param_map, default_map=None):
+    """DefaultParamsWriter.saveMetadata byte shape: single JSON line in
+    metadata/part-00000 + empty _SUCCESS."""
+    meta_dir = os.path.join(path, "metadata")
+    os.makedirs(meta_dir)
+    payload = {
+        "class": class_name,
+        "timestamp": 1714456800000,
+        "sparkVersion": "3.5.1",
+        "uid": uid,
+        "paramMap": param_map,
+        "defaultParamMap": default_map or {},
+    }
+    with open(os.path.join(meta_dir, "part-00000"), "w") as f:
+        f.write(json.dumps(payload) + "\n")
+    open(os.path.join(meta_dir, "_SUCCESS"), "w").close()
+
+
+def _write_spark_parquet(path, schema, rows, spark_schema_json):
+    """Spark executor part-file shape: snappy parquet named
+    part-00000-<uuid>-c000.snappy.parquet with Spark's row-metadata keys."""
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir)
+    arrays = [
+        pa.array([r[name] for r in rows], type=schema.field(name).type)
+        for name in schema.names
+    ]
+    table = pa.Table.from_arrays(arrays, schema=schema).replace_schema_metadata(
+        {
+            "org.apache.spark.version": "3.5.1",
+            "org.apache.spark.sql.parquet.row.metadata": spark_schema_json,
+        }
+    )
+    pq.write_table(
+        table,
+        os.path.join(
+            data_dir,
+            "part-00000-2fc4f2c3-0d5e-4a52-9b3e-77a312345678-c000.snappy.parquet",
+        ),
+        compression="snappy",
+    )
+    open(os.path.join(data_dir, "_SUCCESS"), "w").close()
+
+
+def _matrix_struct(m):
+    m = np.asarray(m, dtype=np.float64)
+    return {
+        "type": 1,
+        "numRows": m.shape[0],
+        "numCols": m.shape[1],
+        "colPtrs": None,
+        "rowIndices": None,
+        "values": m.ravel(order="F").tolist(),
+        "isTransposed": False,
+    }
+
+
+def _vector_struct(v):
+    return {
+        "type": 1,
+        "size": len(v),
+        "indices": None,
+        "values": np.asarray(v, dtype=np.float64).tolist(),
+    }
+
+
+class TestLoadSparkWrittenModels:
+    def test_pca_model(self, tmp_path, rng):
+        pc = rng.normal(size=(5, 2))
+        ev = np.array([0.7, 0.2])
+        path = str(tmp_path / "spark_pca")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.feature.PCAModel",
+            "PCAModel_4b1c2d3e4f50",
+            {"k": 2, "inputCol": "features", "outputCol": "pca"},
+        )
+        schema = pa.schema([("pc", _SPARK_MATRIX), ("explainedVariance", _SPARK_VECTOR)])
+        _write_spark_parquet(
+            path,
+            schema,
+            [{"pc": _matrix_struct(pc), "explainedVariance": _vector_struct(ev)}],
+            '{"type":"struct","fields":[{"name":"pc","type":{"type":"udt",'
+            '"class":"org.apache.spark.ml.linalg.MatrixUDT"},"nullable":true,'
+            '"metadata":{}},{"name":"explainedVariance","type":{"type":"udt",'
+            '"class":"org.apache.spark.ml.linalg.VectorUDT"},"nullable":true,'
+            '"metadata":{}}]}',
+        )
+
+        model = PCAModel.load(path)
+        np.testing.assert_allclose(model.pc, pc)
+        np.testing.assert_allclose(model.explainedVariance, ev)
+        assert model.getK() == 2
+        assert model.getInputCol() == "features"
+        # And it transforms.
+        out = model.transform(rng.normal(size=(10, 5)))
+        assert out.shape == (10, 2)
+
+    def test_pca_model_is_transposed_layout(self, tmp_path, rng):
+        """Spark may store matrices row-major (isTransposed=True)."""
+        pc = rng.normal(size=(4, 2))
+        path = str(tmp_path / "spark_pca_t")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path, "org.apache.spark.ml.feature.PCAModel", "PCAModel_x", {"k": 2}
+        )
+        struct = _matrix_struct(pc)
+        struct["values"] = pc.ravel(order="C").tolist()
+        struct["isTransposed"] = True
+        schema = pa.schema([("pc", _SPARK_MATRIX), ("explainedVariance", _SPARK_VECTOR)])
+        _write_spark_parquet(
+            path,
+            schema,
+            [{"pc": struct, "explainedVariance": _vector_struct([0.9, 0.1])}],
+            "{}",
+        )
+        model = PCAModel.load(path)
+        np.testing.assert_allclose(model.pc, pc)
+
+    def test_kmeans_model(self, tmp_path, rng):
+        centers = rng.normal(size=(3, 4))
+        path = str(tmp_path / "spark_kmeans")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.clustering.KMeansModel",
+            "KMeansModel_abc",
+            {"k": 3, "featuresCol": "features", "predictionCol": "prediction"},
+        )
+        schema = pa.schema(
+            [("clusterIdx", pa.int32()), ("clusterCenter", _SPARK_VECTOR)]
+        )
+        _write_spark_parquet(
+            path,
+            schema,
+            [
+                {"clusterIdx": i, "clusterCenter": _vector_struct(c)}
+                for i, c in enumerate(centers)
+            ],
+            "{}",
+        )
+        model = KMeansModel.load(path)
+        np.testing.assert_allclose(model.clusterCenters(), centers)
+
+    def test_linear_regression_model(self, tmp_path, rng):
+        coef = rng.normal(size=6)
+        path = str(tmp_path / "spark_lr")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.regression.LinearRegressionModel",
+            "LinearRegressionModel_q",
+            {"featuresCol": "features", "labelCol": "label"},
+        )
+        schema = pa.schema(
+            [("intercept", pa.float64()), ("coefficients", _SPARK_VECTOR)]
+        )
+        _write_spark_parquet(
+            path,
+            schema,
+            [{"intercept": 2.5, "coefficients": _vector_struct(coef)}],
+            "{}",
+        )
+        model = LinearRegressionModel.load(path)
+        np.testing.assert_allclose(model.coefficients, coef)
+        assert model.intercept == pytest.approx(2.5)
+
+    def test_sparse_vector_struct(self, tmp_path):
+        """Spark VectorUDT type=0 is sparse; loaders must densify it."""
+        path = str(tmp_path / "spark_lr_sparse")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.regression.LinearRegressionModel",
+            "LinearRegressionModel_s",
+            {},
+        )
+        schema = pa.schema(
+            [("intercept", pa.float64()), ("coefficients", _SPARK_VECTOR)]
+        )
+        sparse = {"type": 0, "size": 5, "indices": [1, 3], "values": [2.0, -1.0]}
+        _write_spark_parquet(
+            path, schema, [{"intercept": 0.0, "coefficients": sparse}], "{}"
+        )
+        model = LinearRegressionModel.load(path)
+        np.testing.assert_allclose(model.coefficients, [0.0, 2.0, 0.0, -1.0, 0.0])
+
+
+class TestWrittenFormatIsSparkShaped:
+    """The reverse direction: what this framework writes must be exactly
+    the structural schema Spark's readers parse."""
+
+    def test_pca_written_schema(self, tmp_path, rng):
+        x = rng.normal(size=(50, 4))
+        model = PCA().setK(2).fit(x)
+        path = str(tmp_path / "ours")
+        model.write.overwrite().save(path)
+
+        # metadata: single-line JSON with DefaultParamsReader's keys.
+        with open(os.path.join(path, "metadata", "part-00000")) as f:
+            lines = f.read().splitlines()
+        assert len(lines) == 1
+        meta = json.loads(lines[0])
+        for key in ("class", "timestamp", "sparkVersion", "uid", "paramMap", "defaultParamMap"):
+            assert key in meta, key
+        assert meta["class"].endswith("PCAModel")
+        assert os.path.exists(os.path.join(path, "metadata", "_SUCCESS"))
+
+        # data: parquet whose struct fields match MatrixUDT/VectorUDT
+        # name-for-name, type-for-type.
+        files = [
+            f
+            for f in os.listdir(os.path.join(path, "data"))
+            if f.endswith(".parquet")
+        ]
+        assert files
+        table = pq.read_table(os.path.join(path, "data", files[0]))
+        assert table.num_rows == 1
+        assert table.schema.field("pc").type == _SPARK_MATRIX
+        assert table.schema.field("explainedVariance").type == _SPARK_VECTOR
+        assert os.path.exists(os.path.join(path, "data", "_SUCCESS"))
+
+    def test_roundtrip_through_spark_shape(self, tmp_path, rng):
+        """Write with our writer, re-read the raw structs as a Spark reader
+        would (column-major values + struct fields), and compare."""
+        x = rng.normal(size=(60, 5)) * np.linspace(1, 2, 5)
+        model = PCA().setK(3).fit(x)
+        path = str(tmp_path / "ours_rt")
+        model.write.overwrite().save(path)
+        files = [
+            f
+            for f in os.listdir(os.path.join(path, "data"))
+            if f.endswith(".parquet")
+        ]
+        row = pq.read_table(os.path.join(path, "data", files[0])).to_pylist()[0]
+        pc_struct = row["pc"]
+        pc = np.asarray(pc_struct["values"]).reshape(
+            pc_struct["numCols"], pc_struct["numRows"]
+        ).T  # column-major, as Spark's DenseMatrix stores
+        np.testing.assert_allclose(pc, model.pc)

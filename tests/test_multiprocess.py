@@ -12,7 +12,6 @@ import sys
 from pathlib import Path
 
 import numpy as np
-import pytest
 
 from spark_rapids_ml_tpu.parallel.mesh import make_mesh, shard_rows, shard_rows_from_partitions
 

@@ -1,0 +1,62 @@
+"""Build quality gates — the ``-Xfatal-warnings`` / apache-rat analogue
+(pom.xml:194,361-397). The image ships no ruff/mypy, so the gate is the
+stdlib-ast lint in tools/lint.py plus an import sweep of every module
+(which catches module-scope NameErrors, bad decorators, and circular
+imports the way a compiler pass would)."""
+
+import importlib
+import pkgutil
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def test_lint_clean():
+    import lint
+
+    findings = []
+    for root in (REPO / "spark_rapids_ml_tpu", REPO / "tests", REPO / "benchmarks"):
+        for f in sorted(root.rglob("*.py")):
+            findings.extend(lint.lint_file(f))
+    assert not findings, "\n".join(findings)
+
+
+def test_every_module_imports():
+    """Import every package module (catches decorator-time NameErrors etc.
+    that only explode at import). spark.adapter self-gates on pyspark."""
+    import spark_rapids_ml_tpu
+
+    failures = []
+    for mod in pkgutil.walk_packages(
+        spark_rapids_ml_tpu.__path__, prefix="spark_rapids_ml_tpu."
+    ):
+        if mod.name.endswith("libtpuml_host"):
+            continue  # ctypes shared library, not a Python extension module
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:  # noqa: BLE001 - we want the full report
+            failures.append(f"{mod.name}: {type(e).__name__}: {e}")
+    assert not failures, "\n".join(failures)
+
+
+def test_lint_catches_planted_defects(tmp_path):
+    """The gate itself must work: plant each defect class and assert the
+    linter flags it."""
+    import lint
+
+    cases = {
+        "unused import": "'''doc'''\nimport os\n",
+        "bare except": "'''doc'''\ntry:\n    pass\nexcept:\n    pass\n",
+        "mutable default": "'''doc'''\ndef f(a=[]):\n    return a\n",
+        "import *": "'''doc'''\nfrom os.path import *\n",
+        "missing module docstring": "x = 1\n",
+    }
+    for name, src in cases.items():
+        f = tmp_path / "planted.py"
+        f.write_text(src)
+        assert lint.lint_file(f), f"lint missed: {name}"
+    clean = tmp_path / "clean.py"
+    clean.write_text("'''doc'''\nimport os\n\nprint(os.sep)\n")
+    assert not lint.lint_file(clean)

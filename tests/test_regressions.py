@@ -4,7 +4,7 @@ import numpy as np
 import pytest
 
 from spark_rapids_ml_tpu.core.data import DataFrame
-from spark_rapids_ml_tpu.feature import PCA, PCAModel
+from spark_rapids_ml_tpu.feature import PCA
 from spark_rapids_ml_tpu.parallel.mesh import make_mesh
 
 
