@@ -7,6 +7,12 @@ the JVM (SURVEY.md §3.3 — the transform UDF itself is CPU there).
 
 Datasets accepted by ``evaluate``: the DataFrame shim or a pandas frame
 carrying the evaluator's columns, or a plain ``(y_true, y_pred)`` tuple.
+
+SCALE NOTE: these evaluators materialize both columns on the host (the
+AUC sort included), which is right for validation-fold sizes but not for
+scoring 100M-row outputs — at that scale, compute metrics where the
+predictions live (a device reduction or a per-partition aggregate) rather
+than collecting them here.
 """
 
 from __future__ import annotations

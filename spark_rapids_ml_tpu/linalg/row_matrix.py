@@ -283,13 +283,27 @@ class RowMatrix:
     def _covariance_streaming(self) -> jnp.ndarray:
         """Constant-memory covariance over a streaming block source: one
         pass, one block resident at a time (shifted accumulation). Records
-        the shape discovered during the pass."""
-        if self.mesh is not None:
-            raise ValueError(
-                "streaming input has no mesh path; pass materialized "
-                "blocks for a mesh-distributed fit"
-            )
+        the shape discovered during the pass. With a mesh, each block is
+        row-sharded over the data axis and the Gram accumulates replicated
+        on device (one psum per block over ICI) — the north-star streamed
+        deployment loop (BASELINE config 5)."""
         blocks = iter_stream_blocks(self._stream)
+        if self.mesh is not None:
+            from spark_rapids_ml_tpu.ops.covariance import (
+                streaming_mean_and_covariance_mesh,
+            )
+
+            with TraceRange("compute cov (stream, mesh)", TraceColor.RED):
+                _, cov, n = streaming_mean_and_covariance_mesh(
+                    blocks,
+                    self.mesh,
+                    center=self.mean_centering,
+                    dtype=self.dtype,
+                    precision=self.precision,
+                )
+            self._num_rows = int(n)
+            self._num_cols = int(cov.shape[0])
+            return jnp.asarray(cov, dtype=self.dtype)
         with TraceRange("compute cov (stream)", TraceColor.RED):
             if self.precision == "dd":
                 from spark_rapids_ml_tpu.ops.doubledouble import (

@@ -15,7 +15,7 @@ PCA outputs are invariant to the scalar, so the test oracle is unaffected.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -163,6 +163,64 @@ def streaming_mean_and_covariance(
             precision=precision,
         )
 
+    return finalize_shifted_gram(*shifted_block_scan(blocks, center, gram_fn), center)
+
+
+@lru_cache(maxsize=None)
+def _sharded_block_gram(mesh, precision: str):
+    """Cached jitted program: Gram of a row-sharded block with the
+    replicated (d, d) result — XLA inserts one psum over the data axis
+    per block (the cross-chip reduce of the streamed mesh covariance)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    prec = _dot_precision(precision)
+
+    @partial(jax.jit, out_shardings=NamedSharding(mesh, P()))
+    def gram(xs):
+        return jnp.matmul(xs.T, xs, precision=prec)
+
+    return gram
+
+
+def streaming_mean_and_covariance_mesh(
+    blocks, mesh, center: bool = True, dtype=None, precision: str = "highest"
+):
+    """ONE-pass covariance over streamed host blocks, each block
+    row-sharded over the mesh data axis — the north-star deployment loop
+    (BASELINE config 5): stream from disk, shard each block over the
+    chips, accumulate the replicated (d, d) Gram on device with one psum
+    per block riding ICI. Host and per-device memory stay bounded by one
+    block; the same shifted-accumulation algebra as the single-device
+    streaming path. Returns host fp64 ``(mean, cov, n)``.
+    """
+    import numpy as _np
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    if jax.process_count() > 1:
+        raise ValueError(
+            "streamed mesh covariance is single-process for now; in "
+            "multi-process deployments pass materialized local blocks"
+        )
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dp = int(mesh.shape[DATA_AXIS])
+    x_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    device_gram = _sharded_block_gram(mesh, precision)
+
+    def gram_fn(bs):
+        # Pad rows to the data-axis multiple with zeros — zero rows
+        # contribute exactly nothing to the Gram (the caller's column sums
+        # use the unpadded block).
+        pad = (-bs.shape[0]) % dp
+        if pad:
+            bs = _np.concatenate([bs, _np.zeros((pad, bs.shape[1]))])
+        xs = jax.device_put(bs.astype(_np.dtype(dtype), copy=False), x_sharding)
+        return device_gram(xs)
+
+    # One home for the streaming algebra: shifted_block_scan.
     return finalize_shifted_gram(*shifted_block_scan(blocks, center, gram_fn), center)
 
 

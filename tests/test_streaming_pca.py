@@ -94,12 +94,15 @@ class TestStreamingPCA:
         with pytest.raises(ValueError, match="materialized"):
             PCA().setK(2).setSolver("randomized").fit(iter([np.ones((4, 3))]))
 
-    def test_mesh_rejects_stream(self, rng):
+    def test_mesh_stream_fit(self, rng):
+        """Streaming + mesh is a REAL path now (the north-star loop):
+        blocks shard over the data axis with one psum per block."""
         from spark_rapids_ml_tpu.parallel.mesh import make_mesh
 
-        x = rng.normal(size=(64, 4))
-        with pytest.raises(ValueError, match="streaming input has no mesh"):
-            PCA(mesh=make_mesh()).setK(2).fit(iter([x]))
+        x = rng.normal(size=(640, 4)) + 5.0
+        model = PCA(mesh=make_mesh()).setK(2).fit(iter([x[:300], x[300:]]))
+        oracle = PCA().setK(2).fit(x)
+        _pc_close(model.pc, oracle.pc, 1e-8)
 
     def test_rowmatrix_shape_unknown_before_pass(self, rng):
         rm = RowMatrix(iter([rng.normal(size=(10, 3))]))

@@ -30,18 +30,20 @@ _IMPORT_SIDE_EFFECT_OK = {"annotations"}
 
 
 def _imported_names(tree: ast.Module):
-    """(alias-name, lineno, is_module_scope) for every import binding."""
+    """(bound-name, lineno) for every import binding, in ANY scope —
+    a binding unused anywhere in the file is flagged regardless of where
+    the import statement sits."""
     out = []
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 name = (a.asname or a.name).split(".")[0]
-                out.append((name, node.lineno, a))
+                out.append((name, node.lineno))
         elif isinstance(node, ast.ImportFrom):
             for a in node.names:
                 if a.name == "*":
                     continue
-                out.append((a.asname or a.name, node.lineno, a))
+                out.append((a.asname or a.name, node.lineno))
     return out
 
 
@@ -50,9 +52,6 @@ def _used_names(tree: ast.Module):
     for node in ast.walk(tree):
         if isinstance(node, ast.Name):
             used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # foo.bar marks foo used via the Name child (walk covers it).
-            pass
     # Names referenced in __all__ strings count as used (re-export files).
     for node in ast.walk(tree):
         if (
@@ -83,7 +82,7 @@ def lint_file(path: Path) -> list[str]:
     noqa_lines = {
         i + 1 for i, line in enumerate(src.splitlines()) if "# noqa" in line
     }
-    for name, lineno, alias in _imported_names(tree):
+    for name, lineno in _imported_names(tree):
         if name in _IMPORT_SIDE_EFFECT_OK or lineno in noqa_lines:
             continue
         if name not in used:
