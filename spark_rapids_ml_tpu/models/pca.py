@@ -68,12 +68,26 @@ class _PCAParams(HasInputCol, HasOutputCol):
         "xla (fused, default) | pallas (VMEM-resident streaming kernel)",
         toString,
     )
+    eigenSolver = Param(
+        "_",
+        "eigenSolver",
+        "full (exact eigh, default) | topk (subspace iteration, k << d)",
+        toString,
+    )
+    eigenIters = Param(
+        "_",
+        "eigenIters",
+        "subspace iterations for eigenSolver='topk' (raise for slowly "
+        "decaying spectra: subspace error ~ (lambda_{k+1}/lambda_k)^iters)",
+        toInt,
+    )
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
         self._setDefault(
             meanCentering=True, useGemm=True, useCuSolverSVD=True, gpuId=-1,
             solver="auto", precision="auto", covarianceBackend="xla",
+            eigenSolver="full", eigenIters=8,
         )
 
     def getK(self) -> int:
@@ -99,6 +113,12 @@ class _PCAParams(HasInputCol, HasOutputCol):
 
     def getCovarianceBackend(self) -> str:
         return self.getOrDefault(self.covarianceBackend)
+
+    def getEigenSolver(self) -> str:
+        return self.getOrDefault(self.eigenSolver)
+
+    def getEigenIters(self) -> int:
+        return self.getOrDefault(self.eigenIters)
 
 
 class PCA(_PCAParams, Estimator, MLReadable):
@@ -150,6 +170,25 @@ class PCA(_PCAParams, Estimator, MLReadable):
         from spark_rapids_ml_tpu.ops.linalg import validate_precision
 
         self.set(self.precision, validate_precision(value))
+        return self
+
+    def setEigenSolver(self, value: str) -> "PCA":
+        """``"topk"`` replaces the full O(d^3) eigensolve with subspace
+        iteration + Rayleigh-Ritz (O(d^2 k) MXU matmuls) — the right
+        choice when k << d and the spectrum decays; explained-variance
+        ratios stay exact (trace-normalized). Convergence depends on the
+        eigengap: subspace error shrinks like (lambda_{k+1}/lambda_k)^iters,
+        so raise ``eigenIters`` (default 8) for slowly decaying spectra.
+        ``"full"`` (default) is the reference-parity exact eigh."""
+        if value not in ("full", "topk"):
+            raise ValueError(f"eigenSolver must be full|topk, got {value!r}")
+        self.set(self.eigenSolver, value)
+        return self
+
+    def setEigenIters(self, value: int) -> "PCA":
+        if value < 1:
+            raise ValueError(f"eigenIters must be >= 1, got {value}")
+        self.set(self.eigenIters, value)
         return self
 
     def setCovarianceBackend(self, value: str) -> "PCA":
@@ -247,6 +286,8 @@ class PCA(_PCAParams, Estimator, MLReadable):
             mesh=self.mesh,
             precision=resolved_prec,
             backend=self.getCovarianceBackend(),
+            eigen_solver=self.getEigenSolver(),
+            eigen_iters=self.getEigenIters(),
         )
         pc, explained = mat.compute_principal_components_and_explained_variance(self.getK())
         model = PCAModel(self.uid, np.asarray(pc), np.asarray(explained))

@@ -193,8 +193,6 @@ def streaming_mean_and_covariance_mesh(
     block; the same shifted-accumulation algebra as the single-device
     streaming path. Returns host fp64 ``(mean, cov, n)``.
     """
-    import numpy as _np
-
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
@@ -216,8 +214,10 @@ def streaming_mean_and_covariance_mesh(
         # use the unpadded block).
         pad = (-bs.shape[0]) % dp
         if pad:
-            bs = _np.concatenate([bs, _np.zeros((pad, bs.shape[1]))])
-        xs = jax.device_put(bs.astype(_np.dtype(dtype), copy=False), x_sharding)
+            # Match dtype: a default-f64 zeros block would upcast (and
+            # copy) the whole concatenated block.
+            bs = np.concatenate([bs, np.zeros((pad, bs.shape[1]), dtype=bs.dtype)])
+        xs = jax.device_put(bs.astype(np.dtype(dtype), copy=False), x_sharding)
         return device_gram(xs)
 
     # One home for the streaming algebra: shifted_block_scan.
