@@ -205,6 +205,12 @@ class Params:
         # fits to single-device for exactly the workloads that need sharding.
         if hasattr(self, "mesh") and hasattr(that, "mesh"):
             that.mesh = self.mesh
+        # Non-Param instance state a subclass declares in _copy_attrs
+        # (e.g. warm-start arrays) survives copies too — the names live
+        # with the models, only the mechanism lives here.
+        for attr in getattr(self, "_copy_attrs", ()):
+            if getattr(self, attr, None) is not None:
+                setattr(that, attr, getattr(self, attr))
         return that
 
     def _copyValues(self, to: "Params", extra: Optional[Dict[Param, Any]] = None) -> "Params":

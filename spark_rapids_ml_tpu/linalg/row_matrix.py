@@ -37,6 +37,7 @@ from spark_rapids_ml_tpu.ops.eigh import (
     eigh_descending,
     eigh_descending_host,
     eigh_topk,
+    eigh_topk_host,
     sign_flip,
 )
 from spark_rapids_ml_tpu.ops.linalg import resolve_precision, triu_to_full
@@ -421,7 +422,15 @@ class RowMatrix:
             # The covariance is exact-fp64 host data; a device eigensolve
             # would round it to fp32 on a no-x64 platform. Host LAPACK
             # keeps the dd accuracy end to end (d x d only — O(d^3) off
-            # the critical data path).
+            # the critical data path). An explicit topk request is honored
+            # at fp64 via ARPACK rather than silently ignored.
+            if self.eigen_solver == "topk" and k < n_cols:
+                with TraceRange("host fp64 topk", TraceColor.BLUE):
+                    w_k, u_k = eigh_topk_host(np.asarray(cov), k)
+                    w_k = np.clip(w_k, 0, None)
+                    total = float(np.trace(np.asarray(cov)))
+                    explained = w_k / total if total > 0 else w_k
+                    return u_k, explained
             with TraceRange("host fp64 SVD", TraceColor.BLUE):
                 w, u = eigh_descending_host(np.asarray(cov))
         elif self.eigen_solver == "topk" and k < n_cols:

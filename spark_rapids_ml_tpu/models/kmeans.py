@@ -149,6 +149,23 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
         self.mesh = mesh
         return self
 
+    def setInitialModel(self, value) -> "KMeans":
+        """Warm start: begin Lloyd from an existing model's centers (or a
+        raw (k, d) array) instead of k-means++/random seeding — the
+        resume-after-interruption / refine-a-checkpoint path (mllib's
+        ``setInitialModel``, cuML's init array). ``k`` must match."""
+        centers = value.clusterCenters() if hasattr(value, "clusterCenters") else value
+        centers = np.asarray(centers, dtype=np.float64)
+        if centers.ndim != 2:
+            # Validate BEFORE assigning: a raising setter must not leave
+            # the estimator holding a malformed warm start.
+            raise ValueError("initial model/centers must be a (k, d) matrix")
+        self._initial_centers = centers
+        return self
+
+    _initial_centers = None
+    _copy_attrs = ("_initial_centers",)  # survives Params.copy (tuning grids)
+
     def fit(self, dataset: Any) -> "KMeansModel":
         rows = _extract_features(dataset, self.getFeaturesCol())
         x_host = as_matrix(rows)
@@ -173,7 +190,27 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                 # Zero out padding via the mask's SUPPORT, not its value —
                 # fractional weights must not rescale the unit vectors.
                 xs = normalize_rows(xs) * (mask > 0).astype(dtype)[:, None]
-            if self.getInitMode() == "random":
+            if self._initial_centers is not None:
+                if self._initial_centers.shape[0] != k:
+                    raise ValueError(
+                        f"initial model has {self._initial_centers.shape[0]} "
+                        f"centers but k={k}"
+                    )
+                if self._initial_centers.shape[1] != x_host.shape[1]:
+                    raise ValueError(
+                        f"initial centers have {self._initial_centers.shape[1]} "
+                        f"features but the data has {x_host.shape[1]}"
+                    )
+                init = jnp.asarray(
+                    np.pad(
+                        self._initial_centers,
+                        ((0, 0), (0, xs.shape[1] - x_host.shape[1])),
+                    ),
+                    dtype=dtype,
+                )
+                if cosine:
+                    init = normalize_rows(init)
+            elif self.getInitMode() == "random":
                 init = random_init(xs, mask, key, k)
             else:
                 init = kmeans_plusplus_init(xs, mask, key, k)

@@ -229,6 +229,21 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
         self.mesh = mesh
         return self
 
+    _init_embedding = None
+    _copy_attrs = ("_init_embedding",)  # survives Params.copy (tuning grids)
+
+    def setInitEmbedding(self, value) -> "UMAP":
+        """Warm start / resume: begin the epoch SGD from an existing (n,
+        nComponents) layout — a previous model's ``embedding`` — instead
+        of spectral/random init. Lets an interrupted optimization continue
+        (run more epochs from the checkpointed layout) or refine a coarse
+        fit; cuML/umap-learn's ``init=array`` semantics."""
+        arr = np.asarray(value, dtype=np.float32)
+        if arr.ndim != 2:
+            raise ValueError("init embedding must be an (n, nComponents) matrix")
+        self._init_embedding = arr
+        return self
+
     def fit(self, dataset: Any) -> "UMAPModel":
         rows = extract_features(dataset, self.getFeaturesCol())
         x_host = as_matrix(rows)
@@ -247,7 +262,14 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
                 x, k, self.getMetric(), self.mesh, x_host=x_host
             )
             graph = fuzzy_simplicial_set(idx, dists)
-            if self.getInit() == "spectral" and n <= _SPECTRAL_CAP:
+            if self._init_embedding is not None:
+                if self._init_embedding.shape != (n, dim):
+                    raise ValueError(
+                        f"init embedding shape {self._init_embedding.shape} != "
+                        f"({n}, {dim})"
+                    )
+                emb0 = jnp.asarray(self._init_embedding)
+            elif self.getInit() == "spectral" and n <= _SPECTRAL_CAP:
                 emb0 = spectral_init(graph, n, dim, k_init)
             else:
                 emb0 = 10.0 * jax.random.uniform(

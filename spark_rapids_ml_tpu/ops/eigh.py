@@ -98,6 +98,29 @@ def eigh_topk(a: jax.Array, k: int, iters: int = 8):
     return w, sign_flip(v)
 
 
+def eigh_topk_host(a, k: int):
+    """Host fp64 twin of :func:`eigh_topk` for the dd precision path (the
+    covariance is exact-fp64 host data there; a device solve would round
+    it to fp32). Uses ARPACK (scipy eigsh) with a dense-LAPACK fallback.
+    Same contract: descending top-k eigenpairs, deterministic sign flip.
+    """
+    import numpy as np
+
+    a = np.asarray(a, dtype=np.float64)
+    try:
+        from scipy.sparse.linalg import eigsh
+
+        w, v = eigsh(a, k=k, which="LA")
+        order = np.argsort(w)[::-1]
+        w, v = w[order], v[:, order]
+    except Exception:  # pragma: no cover - tiny k near d, or no scipy
+        w_all, v_all = np.linalg.eigh(a)
+        w, v = w_all[::-1][:k], v_all[:, ::-1][:, :k]
+    idx = np.argmax(np.abs(v), axis=0)
+    pivot = v[idx, np.arange(v.shape[1])]
+    return w, v * np.where(pivot < 0, -1.0, 1.0)[None, :]
+
+
 @jax.jit
 def cal_svd(a: jax.Array):
     """SVD of a symmetric PSD matrix via eigendecomposition.

@@ -199,3 +199,40 @@ class TestReviewRegressions:
         assert set(table.column_names) == {"clusterIdx", "clusterCenter"}
         row0 = table.to_pylist()[0]
         assert row0["clusterCenter"]["type"] == 1  # dense VectorUDT struct
+
+
+class TestWarmStart:
+    """setInitialModel: resume/refine from an existing model's centers —
+    the recovery path for interrupted long fits (mllib setInitialModel /
+    cuML init-array semantics)."""
+
+    def test_resume_converges_from_checkpoint(self, rng):
+        from spark_rapids_ml_tpu.clustering import KMeans
+
+        centers_true = np.array([[0.0, 0.0], [8.0, 8.0], [0.0, 8.0]])
+        x = np.concatenate(
+            [c + rng.normal(scale=0.4, size=(60, 2)) for c in centers_true]
+        )
+        # "Interrupted" fit: only 1 Lloyd iteration.
+        partial = KMeans().setK(3).setSeed(0).setMaxIter(1).fit((x,))
+        # Resume from its centers; a converged result must match a full fit.
+        resumed = (
+            KMeans().setK(3).setMaxIter(50).setInitialModel(partial).fit((x,))
+        )
+        full = KMeans().setK(3).setSeed(0).setMaxIter(50).fit((x,))
+        assert resumed.trainingCost == pytest.approx(full.trainingCost, rel=1e-6)
+
+    def test_shape_validation(self, rng):
+        from spark_rapids_ml_tpu.clustering import KMeans
+
+        x = rng.normal(size=(30, 4))
+        with pytest.raises(ValueError, match="centers but k"):
+            KMeans().setK(3).setInitialModel(np.zeros((2, 4))).fit((x,))
+        with pytest.raises(ValueError, match="features"):
+            KMeans().setK(2).setInitialModel(np.zeros((2, 3))).fit((x,))
+
+    def test_copy_preserves_warm_start(self):
+        from spark_rapids_ml_tpu.clustering import KMeans
+
+        est = KMeans().setK(2).setInitialModel(np.zeros((2, 3)))
+        assert est.copy({})._initial_centers.shape == (2, 3)

@@ -407,3 +407,24 @@ class TestTopkEigenSolver:
     def test_eigen_iters_validation(self):
         with pytest.raises(ValueError, match="eigenIters"):
             PCA().setEigenIters(0)
+
+    def test_topk_with_dd_precision(self, rng):
+        """Explicit topk + dd is honored at fp64 (ARPACK), not silently
+        downgraded to the full host eigh (r2 review)."""
+        from spark_rapids_ml_tpu.utils.testing import assert_components_close
+
+        x = self._decaying(rng, n=3000, d=64)
+        m = PCA().setK(3).setPrecision("dd").setEigenSolver("topk").fit(x)
+        m_ref = PCA().setK(3).setPrecision("dd").fit(x)
+        assert_components_close(m.pc, m_ref.pc, 1e-6)
+        np.testing.assert_allclose(
+            m.explainedVariance, m_ref.explainedVariance, atol=1e-9
+        )
+
+    def test_setter_raise_leaves_estimator_clean(self):
+        from spark_rapids_ml_tpu.clustering import KMeans
+
+        est = KMeans().setK(3)
+        with pytest.raises(ValueError):
+            est.setInitialModel(np.zeros(3))
+        assert est._initial_centers is None  # no corrupted state

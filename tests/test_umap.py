@@ -197,3 +197,39 @@ class TestUMAP:
         assert u.getInit() == "spectral"
         assert u._auto_epochs(5_000) == 500
         assert u._auto_epochs(50_000) == 200
+
+
+class TestResume:
+    def test_init_embedding_resumes_optimization(self, rng):
+        """An interrupted fit's embedding seeds a continuation that reaches
+        the same separation quality as one long fit."""
+        from spark_rapids_ml_tpu.manifold import UMAP
+
+        x = np.concatenate(
+            [rng.normal(size=(40, 6)) + off for off in (0.0, 12.0)]
+        )
+        def separation(emb):
+            labels = np.repeat([0, 1], 40)
+            c0, c1 = emb[labels == 0].mean(0), emb[labels == 1].mean(0)
+            spread = np.mean(np.linalg.norm(emb[labels == 0] - c0, axis=1)) + 1e-9
+            return np.linalg.norm(c0 - c1) / spread
+
+        short = UMAP().setNNeighbors(8).setNEpochs(10).setSeed(0).fit(x)
+        resumed = (
+            UMAP()
+            .setNNeighbors(8)
+            .setNEpochs(150)
+            .setSeed(0)
+            .setInitEmbedding(short.embedding)
+            .fit(x)
+        )
+        # Continuation genuinely improves on the interrupted layout and
+        # reaches a well-separated embedding.
+        assert separation(resumed.embedding) > max(2.0, separation(short.embedding))
+
+    def test_shape_validation(self, rng):
+        from spark_rapids_ml_tpu.manifold import UMAP
+
+        x = rng.normal(size=(30, 5))
+        with pytest.raises(ValueError, match="shape"):
+            UMAP().setNNeighbors(5).setInitEmbedding(np.zeros((10, 2))).fit(x)
