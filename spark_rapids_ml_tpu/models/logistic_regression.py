@@ -201,6 +201,21 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
         self.mesh = mesh
         return self
 
+    _initial_weights = None  # (weights (d, c), intercepts (c,)) warm start
+    _copy_attrs = ("_initial_weights",)
+
+    def setInitialModel(self, value) -> "LogisticRegression":
+        """Warm start the L-BFGS solve from an existing model's solution —
+        resume an interrupted fit, or seed a regularization-path sweep
+        (each grid cell starts from the previous optimum). Applies to the
+        L-BFGS (L2 / unregularized) path."""
+        w = np.asarray(value.weights, dtype=np.float64)
+        b = np.asarray(value.intercepts, dtype=np.float64)
+        if w.ndim != 2 or b.ndim != 1 or w.shape[1] != b.shape[0]:
+            raise ValueError("initial model must carry (d, c) weights and (c,) intercepts")
+        self._initial_weights = (w, b)
+        return self
+
     def fit(self, dataset: Any) -> "LogisticRegressionModel":
         x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         w_host = extract_weights(dataset, self.getWeightCol())
@@ -239,6 +254,21 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
             # regParam == 0 means zero effective penalty whatever enet says:
             # use the L-BFGS path (faster, and it applies the multinomial
             # identifiability pivot the proximal path has no need for).
+            init_w = init_b = None
+            if self._initial_weights is not None:
+                w0, b0 = self._initial_weights
+                c_expect = n_classes if (use_multinomial or n_classes > 2) else 1
+                if w0.shape != (x_host.shape[1], c_expect):
+                    raise ValueError(
+                        f"initial model weights {w0.shape} != expected "
+                        f"({x_host.shape[1]}, {c_expect})"
+                    )
+                # Pad to any model-axis feature padding the mesh added.
+                pad_d = xs.shape[1] - w0.shape[0]
+                init_w = jnp.asarray(
+                    np.pad(w0, ((0, pad_d), (0, 0))), dtype=dtype
+                )
+                init_b = jnp.asarray(b0, dtype=dtype)
             if enet == 0.0 or self.getRegParam() == 0.0:
                 result = fit_logistic(
                     xs,
@@ -251,8 +281,15 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                     max_iter=self.getMaxIter(),
                     tol=self.getTol(),
                     multinomial=use_multinomial,
+                    init_w=init_w,
+                    init_b=init_b,
                 )
             else:
+                if self._initial_weights is not None:
+                    raise ValueError(
+                        "setInitialModel warm start applies to the L-BFGS "
+                        "path (elasticNetParam 0 or regParam 0)"
+                    )
                 # L1/elastic net: FISTA (Spark reaches this via OWL-QN).
                 # maxIter caps proximal iterations exactly as it caps
                 # OWL-QN iterations in Spark — users of the slower-

@@ -48,6 +48,16 @@ def eigh_descending(a: jax.Array):
     return w, sign_flip(v)
 
 
+def _sign_flip_host(v):
+    """Numpy twin of :func:`sign_flip` — ONE home for the host-side sign
+    convention (the reference's signFlip contract)."""
+    import numpy as np
+
+    idx = np.argmax(np.abs(v), axis=0)
+    pivot = v[idx, np.arange(v.shape[1])]
+    return v * np.where(pivot < 0, -1.0, 1.0)[None, :]
+
+
 def eigh_descending_host(a):
     """Host (NumPy/LAPACK) fallback with the same contract as
     :func:`eigh_descending` — the reference's driver-CPU breeze-SVD branch
@@ -56,12 +66,7 @@ def eigh_descending_host(a):
     import numpy as np
 
     w, v = np.linalg.eigh(np.asarray(a, dtype=np.float64))
-    w = w[::-1]
-    v = v[:, ::-1]
-    idx = np.argmax(np.abs(v), axis=0)
-    pivot = v[idx, np.arange(v.shape[1])]
-    v = v * np.where(pivot < 0, -1.0, 1.0)[None, :]
-    return w, v
+    return w[::-1], _sign_flip_host(v[:, ::-1])
 
 
 @partial(jax.jit, static_argnames=("k", "iters"))
@@ -116,9 +121,7 @@ def eigh_topk_host(a, k: int):
     except Exception:  # pragma: no cover - tiny k near d, or no scipy
         w_all, v_all = np.linalg.eigh(a)
         w, v = w_all[::-1][:k], v_all[:, ::-1][:, :k]
-    idx = np.argmax(np.abs(v), axis=0)
-    pivot = v[idx, np.arange(v.shape[1])]
-    return w, v * np.where(pivot < 0, -1.0, 1.0)[None, :]
+    return w, _sign_flip_host(v)
 
 
 @jax.jit

@@ -80,8 +80,14 @@ def fit_logistic(
     tol: float = 1e-6,
     precision: str = "highest",
     multinomial: bool = False,
+    init_w: jax.Array | None = None,
+    init_b: jax.Array | None = None,
 ) -> LogisticFit:
     """Fit binomial or multinomial logistic regression.
+
+    ``init_w`` (d, c) / ``init_b`` (c,) warm-start the optimizer from an
+    ORIGINAL-space solution (e.g. a previous model) — mapped into the
+    standardized optimization space internally; default zeros.
 
     ``x``: (n, d); ``y``: (n,) integer labels in [0, n_classes); ``mask``:
     (n,) 1.0 for real rows, 0.0 for padding (mesh row-sharding pads).
@@ -135,8 +141,23 @@ def fit_logistic(
         data_loss = jnp.sum(per_row * mask) / n
         return data_loss + 0.5 * reg_param * jnp.sum(w * w)
 
-    w0 = jnp.zeros((d, c), dtype=dtype)
-    b0 = jnp.zeros((c,), dtype=dtype)
+    if init_w is None:
+        w0 = jnp.zeros((d, c), dtype=dtype)
+        b0 = jnp.zeros((c,), dtype=dtype)
+    else:
+        # Inverse of the final back-map: the optimizer works in
+        # standardized space (w_std = w_orig * scale; the intercept
+        # re-absorbs the centering offset).
+        w_orig0 = jnp.asarray(init_w, dtype=dtype)
+        w0 = w_orig0 * scale[:, None]
+        if fit_intercept and init_b is not None:
+            b0 = jnp.asarray(init_b, dtype=dtype) + jnp.matmul(
+                offset, w_orig0, precision=prec
+            )
+        else:
+            # No intercept in the model: b is never optimized (zero
+            # gradient), so a stale nonzero init would leak into predict.
+            b0 = jnp.zeros((c,), dtype=dtype)
     params0 = (w0, b0)
 
     solver = optax.lbfgs()

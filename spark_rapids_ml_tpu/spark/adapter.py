@@ -900,3 +900,109 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 RandomForestClassificationModel.load(_os.path.join(path, "core"))
             )
             return _set_params_from_metadata(model, metadata)
+
+    class TpuRandomForestRegressor(SparkEstimator, _TpuPredictorParams):
+        numTrees = Param(Params._dummy(), "numTrees", "number of trees", TypeConverters.toInt)
+        maxDepth = Param(Params._dummy(), "maxDepth", "max tree depth", TypeConverters.toInt)
+        maxBins = Param(Params._dummy(), "maxBins", "max feature bins", TypeConverters.toInt)
+        seed = Param(Params._dummy(), "seed", "random seed", TypeConverters.toInt)
+
+        def __init__(self, featuresCol="features", labelCol="label"):
+            super().__init__()
+            self._setDefault(
+                numTrees=20, maxDepth=5, maxBins=32, seed=0,
+                featuresCol="features", labelCol="label",
+                predictionCol="prediction",
+            )
+            self._set(featuresCol=featuresCol, labelCol=labelCol)
+
+        def setNumTrees(self, value):
+            return self._set(numTrees=value)
+
+        def setMaxDepth(self, value):
+            return self._set(maxDepth=value)
+
+        def setMaxBins(self, value):
+            return self._set(maxBins=value)
+
+        def setSeed(self, value):
+            return self._set(seed=value)
+
+        def _fit(self, dataset):
+            from spark_rapids_ml_tpu.regression import RandomForestRegressor
+
+            x, y = _collect_xy(
+                dataset,
+                self.getOrDefault(self.featuresCol),
+                self.getOrDefault(self.labelCol),
+            )
+            core = (
+                RandomForestRegressor()
+                .setNumTrees(self.getOrDefault(self.numTrees))
+                .setMaxDepth(self.getOrDefault(self.maxDepth))
+                .setMaxBins(self.getOrDefault(self.maxBins))
+                .setSeed(self.getOrDefault(self.seed))
+                .fit((x, y))
+            )
+            model = TpuRandomForestRegressionModel(core)
+            for p in ("featuresCol", "labelCol", "predictionCol"):
+                model._set(**{p: self.getOrDefault(getattr(self, p))})
+            return model
+
+    class TpuRandomForestRegressionModel(SparkModel, _TpuPredictorParams, MLReadable):
+        def __init__(self, core_model=None):
+            super().__init__()
+            self._setDefault(
+                featuresCol="features", labelCol="label", predictionCol="prediction"
+            )
+            self._core = core_model
+
+        def _transform(self, dataset):
+            import functools
+
+            from pyspark.ml.functions import vector_to_array
+            from pyspark.sql.functions import col
+
+            from spark_rapids_ml_tpu.models.random_forest import _forest_depth
+            from spark_rapids_ml_tpu.spark import executor_math
+
+            f = self._core._forest
+            forward = functools.partial(
+                executor_math.forest_forward_reg,
+                np.asarray(f.feature),
+                np.asarray(f.threshold, dtype=np.float64),
+                np.asarray(f.is_leaf),
+                np.asarray(f.leaf_value, dtype=np.float64),
+                _forest_depth(f),
+            )
+            return dataset.withColumn(
+                self.getOrDefault(self.predictionCol),
+                _prediction_udf(forward)(
+                    vector_to_array(col(self.getOrDefault(self.featuresCol)))
+                ),
+            )
+
+        def _save_impl(self, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name="TpuRandomForestRegressionModel")
+            self._core.save(_os.path.join(path, "core"))
+
+        @classmethod
+        def load(cls, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+            from spark_rapids_ml_tpu.models.random_forest import (
+                RandomForestRegressionModel,
+            )
+
+            metadata = P.load_metadata(
+                path, expected_class="TpuRandomForestRegressionModel"
+            )
+            model = cls(
+                RandomForestRegressionModel.load(_os.path.join(path, "core"))
+            )
+            return _set_params_from_metadata(model, metadata)

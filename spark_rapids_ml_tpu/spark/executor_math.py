@@ -57,16 +57,7 @@ def forest_forward(
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Returns (raw vote mass, probabilities, predictions) for one block."""
     T = feature.shape[0]
-    n = block.shape[0]
-    idx = np.zeros((T, n), dtype=np.int64)
-    f_clip = np.maximum(feature, 0)
-    for _ in range(max_depth):
-        f = np.take_along_axis(f_clip, idx, axis=1)  # (T, n)
-        leaf = np.take_along_axis(is_leaf, idx, axis=1)
-        thr = np.take_along_axis(threshold, idx, axis=1)
-        xv = block[np.arange(n)[None, :], f]
-        child = 2 * idx + 1 + (xv > thr)
-        idx = np.where(leaf, idx, child)
+    idx = forest_apply_leaves(feature, threshold, is_leaf, max_depth, block)
     n_classes = leaf_value.shape[2]
     probs = np.stack(
         [
@@ -80,4 +71,44 @@ def forest_forward(
     return raw, probs, pred
 
 
-__all__ = ["logistic_forward", "forest_forward"]
+def forest_apply_leaves(
+    feature: np.ndarray,
+    threshold: np.ndarray,
+    is_leaf: np.ndarray,
+    max_depth: int,
+    block: np.ndarray,
+) -> np.ndarray:
+    """(T, n) leaf indices — the shared routing of the forest forwards."""
+    T = feature.shape[0]
+    n = block.shape[0]
+    idx = np.zeros((T, n), dtype=np.int64)
+    f_clip = np.maximum(feature, 0)
+    for _ in range(max_depth):
+        f = np.take_along_axis(f_clip, idx, axis=1)
+        leaf = np.take_along_axis(is_leaf, idx, axis=1)
+        thr = np.take_along_axis(threshold, idx, axis=1)
+        xv = block[np.arange(n)[None, :], f]
+        child = 2 * idx + 1 + (xv > thr)
+        idx = np.where(leaf, idx, child)
+    return idx
+
+
+def forest_forward_reg(
+    feature: np.ndarray,
+    threshold: np.ndarray,
+    is_leaf: np.ndarray,
+    leaf_value: np.ndarray,  # (T, N, 1) per-leaf means
+    max_depth: int,
+    block: np.ndarray,
+) -> np.ndarray:
+    """(n,) regression predictions: mean of per-tree leaf means."""
+    idx = forest_apply_leaves(feature, threshold, is_leaf, max_depth, block)
+    return np.take_along_axis(leaf_value[:, :, 0], idx, axis=1).mean(axis=0)
+
+
+__all__ = [
+    "logistic_forward",
+    "forest_forward",
+    "forest_forward_reg",
+    "forest_apply_leaves",
+]

@@ -236,3 +236,11 @@ class TestWarmStart:
 
         est = KMeans().setK(2).setInitialModel(np.zeros((2, 3)))
         assert est.copy({})._initial_centers.shape == (2, 3)
+
+    def test_setter_raise_leaves_estimator_clean(self):
+        from spark_rapids_ml_tpu.clustering import KMeans
+
+        est = KMeans().setK(3)
+        with pytest.raises(ValueError):
+            est.setInitialModel(np.zeros(3))
+        assert est._initial_centers is None  # no corrupted state

@@ -290,3 +290,66 @@ class TestDistributed:
         np.testing.assert_allclose(
             dist.coefficientMatrix, single.coefficientMatrix, atol=1e-5
         )
+
+
+class TestWarmStart:
+    def test_resume_reaches_same_optimum_faster(self, rng):
+        """A warm start from a near-converged model must reproduce the
+        cold optimum in (far) fewer iterations — the resume/path-sweep
+        semantics."""
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        x = rng.normal(size=(400, 6))
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(float)
+        cold = LogisticRegression().setMaxIter(200).setTol(1e-9).fit((x, y))
+        warm = (
+            LogisticRegression()
+            .setMaxIter(200)
+            .setTol(1e-9)
+            .setInitialModel(cold)
+            .fit((x, y))
+        )
+        np.testing.assert_allclose(warm.weights, cold.weights, atol=1e-4)
+        assert warm.numIter < cold.numIter / 2
+
+    def test_rejects_elastic_net_path(self, rng):
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        x = rng.normal(size=(60, 3))
+        y = (x[:, 0] > 0).astype(float)
+        cold = LogisticRegression().setMaxIter(20).fit((x, y))
+        with pytest.raises(ValueError, match="L-BFGS"):
+            (
+                LogisticRegression()
+                .setRegParam(0.1)
+                .setElasticNetParam(0.5)
+                .setInitialModel(cold)
+                .fit((x, y))
+            )
+
+    def test_shape_validation(self, rng):
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        x = rng.normal(size=(60, 3))
+        y = (x[:, 0] > 0).astype(float)
+        cold = LogisticRegression().setMaxIter(5).fit((x, y))
+        with pytest.raises(ValueError, match="initial model weights"):
+            LogisticRegression().setInitialModel(cold).fit((x[:, :2], y))
+
+    def test_no_intercept_warm_start_drops_stale_intercepts(self, rng):
+        """fitIntercept=False never optimizes b — a warm start must not
+        leak the initial model's intercepts into predictions (r2 review)."""
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        x = rng.normal(size=(200, 4))
+        y = (x[:, 0] > 0).astype(float)
+        with_b = LogisticRegression().setMaxIter(100).fit((x, y))
+        assert abs(with_b.intercept) > 0  # a nonzero intercept to leak
+        warm = (
+            LogisticRegression()
+            .setFitIntercept(False)
+            .setMaxIter(100)
+            .setInitialModel(with_b)
+            .fit((x, y))
+        )
+        np.testing.assert_allclose(warm.intercepts, 0.0, atol=1e-12)

@@ -421,10 +421,3 @@ class TestTopkEigenSolver:
             m.explainedVariance, m_ref.explainedVariance, atol=1e-9
         )
 
-    def test_setter_raise_leaves_estimator_clean(self):
-        from spark_rapids_ml_tpu.clustering import KMeans
-
-        est = KMeans().setK(3)
-        with pytest.raises(ValueError):
-            est.setInitialModel(np.zeros(3))
-        assert est._initial_centers is None  # no corrupted state

@@ -289,3 +289,32 @@ class TestTpuRandomForest:
         out2 = loaded.transform(df)
         preds2 = np.asarray([r.prediction for r in out2.collect()])
         np.testing.assert_array_equal(preds2, preds)
+
+
+class TestTpuRandomForestRegressor:
+    def test_fit_transform_save_load(self, spark_env, rng, tmp_path):
+        adapter, spark = spark_env
+        x = rng.uniform(0, 1, size=(300, 3))
+        y = 3.0 * x[:, 0] - 2.0 * x[:, 1]
+        df = _vector_df(spark, x, extra={"label": list(y)})
+        model = (
+            adapter.TpuRandomForestRegressor()
+            .setNumTrees(20)
+            .setMaxDepth(6)
+            .setSeed(0)
+            .fit(df)
+        )
+        out = model.transform(df)
+        preds = np.asarray([r.prediction for r in out.collect()])
+        rmse = float(np.sqrt(np.mean((preds - y) ** 2)))
+        assert rmse < 0.4, rmse
+        # Executor forward must equal the core (JAX) model's predictions.
+        np.testing.assert_allclose(preds, model._core.predict(x), atol=1e-6)
+
+        path = str(tmp_path / "rfr_model")
+        model._save_impl(path)
+        loaded = adapter.TpuRandomForestRegressionModel.load(path)
+        preds2 = np.asarray(
+            [r.prediction for r in loaded.transform(df).collect()]
+        )
+        np.testing.assert_allclose(preds2, preds)
