@@ -305,18 +305,35 @@ class RowMatrix:
         deployment loop (BASELINE config 5)."""
         blocks = iter_stream_blocks(self._stream)
         if self.mesh is not None:
-            from spark_rapids_ml_tpu.ops.covariance import (
-                streaming_mean_and_covariance_mesh,
-            )
-
-            with TraceRange("compute cov (stream, mesh)", TraceColor.RED):
-                _, cov, n = streaming_mean_and_covariance_mesh(
-                    blocks,
-                    self.mesh,
-                    center=self.mean_centering,
-                    dtype=self.dtype,
-                    precision=self.precision,
+            if jax.process_count() > 1:
+                # Executor model: each process streams ITS local blocks on
+                # its own chip; one allgather merges the O(d^2) moments —
+                # the reference's partition-local compute + cross-process
+                # reduce, at constant memory per executor.
+                from spark_rapids_ml_tpu.parallel.distributed import (
+                    streaming_covariance_process_local,
                 )
+
+                with TraceRange("compute cov (stream, multiproc)", TraceColor.RED):
+                    _, cov, n = streaming_covariance_process_local(
+                        blocks,
+                        center=self.mean_centering,
+                        dtype=self.dtype,
+                        precision=self.precision,
+                    )
+            else:
+                from spark_rapids_ml_tpu.ops.covariance import (
+                    streaming_mean_and_covariance_mesh,
+                )
+
+                with TraceRange("compute cov (stream, mesh)", TraceColor.RED):
+                    _, cov, n = streaming_mean_and_covariance_mesh(
+                        blocks,
+                        self.mesh,
+                        center=self.mean_centering,
+                        dtype=self.dtype,
+                        precision=self.precision,
+                    )
             self._num_rows = int(n)
             self._num_cols = int(cov.shape[0])
             return jnp.asarray(cov, dtype=self.dtype)

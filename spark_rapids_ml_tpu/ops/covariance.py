@@ -97,7 +97,7 @@ def centered_gram_packed(x: jax.Array, mean: jax.Array) -> jax.Array:
     return full[rows, cols]
 
 
-def shifted_block_scan(blocks, center: bool, gram_fn):
+def shifted_block_scan(blocks, center: bool, gram_fn, min_rows: int = 2):
     """Shared scaffold of the one-pass shifted covariance accumulations
     (this module's fp32/HIGHEST path and ops.doubledouble's dd path — ONE
     home for the streaming algebra).
@@ -126,7 +126,9 @@ def shifted_block_scan(blocks, center: bool, gram_fn):
         sb = bs.sum(axis=0)
         s = sb if s is None else s + sb
         n += b.shape[0]
-    if n < 2:
+    if n < min_rows:
+        # min_rows=0 callers (per-process partial scans that merge across
+        # processes) accept empty results — shift/gram/s are None then.
         raise ValueError(f"need at least 2 rows to compute a covariance, got {n}")
     return shift, gram, s, n
 
@@ -199,8 +201,10 @@ def streaming_mean_and_covariance_mesh(
 
     if jax.process_count() > 1:
         raise ValueError(
-            "streamed mesh covariance is single-process for now; in "
-            "multi-process deployments pass materialized local blocks"
+            "this single-process sharded-block path has a multi-process "
+            "sibling: parallel.distributed.streaming_covariance_process_local "
+            "(each process streams its LOCAL blocks; RowMatrix routes there "
+            "automatically)"
         )
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32

@@ -150,10 +150,16 @@ def fit_logistic(
         # re-absorbs the centering offset).
         w_orig0 = jnp.asarray(init_w, dtype=dtype)
         w0 = w_orig0 * scale[:, None]
-        if fit_intercept and init_b is not None:
-            b0 = jnp.asarray(init_b, dtype=dtype) + jnp.matmul(
-                offset, w_orig0, precision=prec
+        if fit_intercept:
+            # Absorb the centering offset whether or not an original-space
+            # intercept was supplied — (w_orig, 0) must start as the SAME
+            # decision function, not a shifted one.
+            b_orig0 = (
+                jnp.asarray(init_b, dtype=dtype)
+                if init_b is not None
+                else jnp.zeros((c,), dtype=dtype)
             )
+            b0 = b_orig0 + jnp.matmul(offset, w_orig0, precision=prec)
         else:
             # No intercept in the model: b is never optimized (zero
             # gradient), so a stale nonzero init would leak into predict.

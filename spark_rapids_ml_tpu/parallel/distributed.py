@@ -185,9 +185,118 @@ def shard_rows_process_local(
     return xs, ms, n_true
 
 
+def streaming_covariance_process_local(
+    blocks, center: bool = True, dtype=None, precision: str = "highest"
+):
+    """Each process streams ITS OWN local blocks through the one-pass
+    shifted accumulation (device Gram per block on its chip — or the dd
+    double-float kernels for ``precision="dd"``), then ONE allgather of
+    the O(d²) per-process moments merges them exactly — the reference's
+    executor-local compute + cross-process reduce
+    (RapidsRowMatrix.scala:170-201) at constant memory per process.
+
+    Per-process shifts differ (each uses its first block's means); the
+    merge rebases every process's moments onto a common shift with the
+    exact closed-form corrections (the ShiftedMoments.merge algebra,
+    core/moments.py). Zero-block processes contribute nothing and strand
+    nobody. Returns host fp64 ``(mean, cov, n_global)`` on every process.
+    """
+    import jax.numpy as jnp
+
+    from jax.experimental import multihost_utils
+
+    from spark_rapids_ml_tpu.ops.covariance import shifted_block_scan
+
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+    if precision == "dd":
+        from spark_rapids_ml_tpu.ops.doubledouble import centered_gram_dd
+
+        def gram_fn(bs):
+            return centered_gram_dd(bs, np.zeros(bs.shape[1]))
+
+    else:
+        from spark_rapids_ml_tpu.ops.covariance import centered_gram
+
+        def gram_fn(bs):
+            return centered_gram(
+                jnp.asarray(bs, dtype=dtype),
+                jnp.zeros(bs.shape[1], dtype=dtype),
+                precision=precision,
+            )
+
+    # min_rows=0: a process with zero (or one) local rows still returns
+    # its partial moments and joins the merge instead of raising.
+    shift, gram, s, n_local = shifted_block_scan(blocks, center, gram_fn, min_rows=0)
+    if gram is not None:
+        gram = np.asarray(gram, dtype=np.float64)
+    d_local = shift.shape[0] if shift is not None else -1
+
+    info = multihost_utils.process_allgather(
+        np.asarray([n_local, d_local], dtype=np.int64)
+    )
+    info = np.asarray(info).reshape(-1, 2)
+    widths = sorted({int(w) for w in info[:, 1] if w >= 0})
+    if not widths:
+        raise ValueError("no process contributed any blocks")
+    if len(widths) > 1:
+        raise ValueError(f"feature dim mismatch across processes: {widths}")
+    d = widths[0]
+    if shift is None:
+        shift = np.zeros(d)
+        gram = np.zeros((d, d))
+        s = np.zeros(d)
+
+    # One allgather of the packed per-process moments: [shift | s | gram].
+    # The wire must not squash the fp64 payload: without x64,
+    # process_allgather canonicalizes float64 -> float32, so the payload
+    # travels as a double-float (hi, lo) f32 pair (~48 mantissa bits —
+    # the same fidelity bar the dd kernels meet).
+    packed = np.concatenate([shift, s, gram.ravel()])
+    if jax.config.jax_enable_x64:
+        gathered = np.asarray(
+            multihost_utils.process_allgather(packed), dtype=np.float64
+        )
+    else:
+        from spark_rapids_ml_tpu.ops.doubledouble import split_f64
+
+        hi, lo = split_f64(packed)
+        g_hi = np.asarray(
+            multihost_utils.process_allgather(hi), dtype=np.float64
+        )
+        g_lo = np.asarray(
+            multihost_utils.process_allgather(lo), dtype=np.float64
+        )
+        gathered = g_hi + g_lo
+    gathered = gathered.reshape(-1, 2 * d + d * d)
+    counts = info[:, 0]
+
+    # Merge through the ONE home of the shifted-moment rebase algebra.
+    from spark_rapids_ml_tpu.core.moments import ShiftedMoments
+
+    acc = None
+    for i in range(gathered.shape[0]):
+        n_i = int(counts[i])
+        if n_i == 0:
+            continue
+        m = ShiftedMoments(d)
+        m.n_rows = n_i
+        m.shift = gathered[i, :d].copy()
+        m.sum = gathered[i, d : 2 * d].copy()
+        m.gram = gathered[i, 2 * d :].reshape(d, d).copy()
+        acc = m if acc is None else acc.merge(m)
+    if acc is None or acc.n_rows < 2:
+        n_tot = 0 if acc is None else acc.n_rows
+        raise ValueError(f"need at least 2 rows to compute a covariance, got {n_tot}")
+    cov, mean = acc.finalize(center=center)
+    return mean, cov, acc.n_rows
+
+
 __all__ = [
     "initialize",
     "bringup_executor",
     "global_mesh",
     "shard_rows_process_local",
+    "streaming_covariance_process_local",
 ]

@@ -21,7 +21,10 @@ import numpy as np
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+# Default x64 for tight oracle tolerances; TPUML_TEST_NO_X64 exercises the
+# real-TPU configuration (fp32 compute, double-float moment wire format).
+_x64 = os.environ.get("TPUML_TEST_NO_X64") != "1"
+jax.config.update("jax_enable_x64", _x64)
 
 from spark_rapids_ml_tpu.parallel import distributed as dist
 
@@ -49,16 +52,23 @@ def main() -> None:
     local = x[bounds[pid] : bounds[pid + 1]]
 
     mesh = dist.global_mesh()
-    model = PCA(mesh=mesh).setK(3).fit([local] if local.shape[0] else [])
+    if os.environ.get("TPUML_TEST_STREAMING") == "1":
+        # Stream the local rows as a one-shot generator of small blocks —
+        # per-process constant-memory scan + cross-process moment merge.
+        blocks = (local[i : i + 97] for i in range(0, local.shape[0], 97))
+        model = PCA(mesh=mesh).setK(3).fit(blocks)
+    else:
+        model = PCA(mesh=mesh).setK(3).fit([local] if local.shape[0] else [])
 
     from spark_rapids_ml_tpu.utils.testing import assert_components_close
 
     cov = np.cov(x, rowvar=False)
     w, v = np.linalg.eigh(cov)
     w, v = w[::-1], v[:, ::-1]
-    assert_components_close(model.pc, v[:, :3], 1e-6)
+    tol = 1e-6 if _x64 else 1e-3  # fp32 compute floor on +100-offset data
+    assert_components_close(model.pc, v[:, :3], tol)
     np.testing.assert_allclose(
-        model.explainedVariance, (w / w.sum())[:3], atol=1e-8
+        model.explainedVariance, (w / w.sum())[:3], atol=tol
     )
     print(f"OK process {pid}/{n_proc}")
 

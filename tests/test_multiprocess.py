@@ -86,16 +86,16 @@ class TestMultiProcess:
         port = _free_port()
         procs = []
         for pid in range(n_proc):
-            env = dict(
-                os.environ,
-                JAX_PLATFORMS="cpu",
-                JAX_ENABLE_X64="1",
-                XLA_FLAGS="--xla_force_host_platform_device_count=2",
-                TPUML_COORDINATOR=f"127.0.0.1:{port}",
-                TPUML_NUM_PROCESSES=str(n_proc),
-                TPUML_PROCESS_ID=str(pid),
-                **(extra_env or {}),
-            )
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "JAX_ENABLE_X64": "1",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "TPUML_COORDINATOR": f"127.0.0.1:{port}",
+                "TPUML_NUM_PROCESSES": str(n_proc),
+                "TPUML_PROCESS_ID": str(pid),
+                **(extra_env or {}),  # extra_env wins (e.g. x64 off)
+            }
             procs.append(
                 subprocess.Popen(
                     [sys.executable, str(REPO / "tests" / "multiproc_pca_worker.py")],
@@ -122,3 +122,32 @@ class TestMultiProcess:
         on every process with the identical oracle-checked model (the
         asymmetric-failure/deadlock case)."""
         self._run(3, extra_env={"TPUML_TEST_EMPTY_LAST": "1"})
+
+    def test_streaming_executors(self):
+        """Each process STREAMS its local rows (one-shot block generator):
+        per-process shifted scans merge through one allgather of the
+        O(d^2) moments — the full executor deployment loop, checked
+        against the full-dataset oracle in every process."""
+        self._run(3, extra_env={"TPUML_TEST_STREAMING": "1"})
+
+    def test_streaming_with_empty_executor(self):
+        self._run(
+            3,
+            extra_env={
+                "TPUML_TEST_STREAMING": "1",
+                "TPUML_TEST_EMPTY_LAST": "1",
+            },
+        )
+
+    def test_streaming_without_x64(self):
+        """The real-TPU configuration: fp32 compute, and the fp64 moment
+        payload crosses the allgather as a double-float (hi, lo) pair —
+        the wire must not silently squash it (r2 review)."""
+        self._run(
+            2,
+            extra_env={
+                "TPUML_TEST_STREAMING": "1",
+                "TPUML_TEST_NO_X64": "1",
+                "JAX_ENABLE_X64": "0",
+            },
+        )
