@@ -8,11 +8,12 @@ the JVM (SURVEY.md §3.3 — the transform UDF itself is CPU there).
 Datasets accepted by ``evaluate``: the DataFrame shim or a pandas frame
 carrying the evaluator's columns, or a plain ``(y_true, y_pred)`` tuple.
 
-SCALE NOTE: these evaluators materialize both columns on the host (the
-AUC sort included), which is right for validation-fold sizes but not for
-scoring 100M-row outputs — at that scale, compute metrics where the
-predictions live (a device reduction or a per-partition aggregate) rather
-than collecting them here.
+SCALE NOTE: tuple datasets route to DEVICE metric kernels
+(``ops/metrics.py`` — fused reductions, a bincount confusion matrix, an
+on-device AUC sort) whenever either column is already a jax array or the
+row count exceeds ``_DEVICE_THRESHOLD``; named-column containers (the
+validation-fold path) stay host-side numpy, where a device round-trip
+would cost more than the metric.
 """
 
 from __future__ import annotations
@@ -26,6 +27,27 @@ from spark_rapids_ml_tpu.core.params import Param, Params, toString
 
 # numpy renamed trapz -> trapezoid in 2.0; support both.
 _trapezoid = getattr(np, "trapezoid", None) or np.trapz
+
+# Tuple inputs at/above this many rows (or already device-resident) score
+# on the accelerator instead of collecting to host numpy.
+_DEVICE_THRESHOLD = 1_000_000
+
+
+def _device_pair(dataset):
+    """If ``dataset`` is a (y, scores/preds) tuple that should score on
+    device, return it as jax arrays; else None."""
+    if not (isinstance(dataset, tuple) and len(dataset) == 2):
+        return None
+    y, p = dataset
+    import jax
+
+    on_device = isinstance(y, jax.Array) or isinstance(p, jax.Array)
+    big = getattr(y, "shape", [0])[0] >= _DEVICE_THRESHOLD
+    if not (on_device or big):
+        return None
+    import jax.numpy as jnp
+
+    return jnp.ravel(jnp.asarray(y)), jnp.ravel(jnp.asarray(p))
 
 
 def _column(dataset: Any, name: str) -> np.ndarray:
@@ -100,6 +122,16 @@ class RegressionEvaluator(Evaluator):
         return self.getMetricName() == "r2"
 
     def evaluate(self, dataset: Any) -> float:
+        dev = _device_pair(dataset)
+        if dev is not None:
+            from spark_rapids_ml_tpu.ops.metrics import regression_metrics_device
+
+            rmse, mse, mae, r2 = regression_metrics_device(*dev)
+            return float(
+                {"rmse": rmse, "mse": mse, "mae": mae, "r2": r2}[
+                    self.getMetricName()
+                ]
+            )
         y, p = _pair(
             dataset, self.getOrDefault(self.labelCol), self.getOrDefault(self.predictionCol)
         )
@@ -152,6 +184,26 @@ class MulticlassClassificationEvaluator(Evaluator):
         return self.getOrDefault(self.metricName)
 
     def evaluate(self, dataset: Any) -> float:
+        dev = _device_pair(dataset)
+        if dev is not None:
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.metrics import multiclass_metrics_device
+
+            y_d, p_d = dev
+            # The bincount confusion matrix needs dense small non-negative
+            # integer labels; anything else falls back to the host path
+            # (np.unique handles sparse/float IDs, at collect cost).
+            integral = bool(
+                jnp.all(y_d == jnp.round(y_d)) & jnp.all(p_d == jnp.round(p_d))
+            )
+            lo = float(jnp.minimum(jnp.min(y_d), jnp.min(p_d)))
+            hi = float(jnp.maximum(jnp.max(y_d), jnp.max(p_d)))
+            if integral and lo >= 0 and hi < 4096:
+                return multiclass_metrics_device(
+                    y_d.astype(jnp.int32), p_d.astype(jnp.int32), int(hi) + 1
+                )[self.getMetricName()]
+            dataset = (np.asarray(y_d), np.asarray(p_d))
         y, p = _pair(
             dataset, self.getOrDefault(self.labelCol), self.getOrDefault(self.predictionCol)
         )
@@ -230,6 +282,11 @@ class BinaryClassificationEvaluator(Evaluator):
         return y, s
 
     def evaluate(self, dataset: Any) -> float:
+        dev = _device_pair(dataset)
+        if dev is not None:
+            from spark_rapids_ml_tpu.ops.metrics import binary_auc_device
+
+            return float(binary_auc_device(*dev, metric=self.getMetricName()))
         y, s = self._scores(dataset)
         order = np.argsort(-s, kind="stable")
         y_sorted = y[order]

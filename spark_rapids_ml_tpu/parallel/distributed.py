@@ -104,6 +104,26 @@ def global_mesh(shape: Optional[Tuple[int, int]] = None) -> Mesh:
     return make_mesh(shape)
 
 
+def _allgather_counts_and_width(n_local: int, d_local: int):
+    """The deadlock-safe shape handshake shared by every process-local
+    collective entry: the allgather comes FIRST — before anything that can
+    raise on one process — so an empty/odd executor participates instead
+    of stranding its peers, and width mismatches raise on ALL processes
+    consistently. Returns ``(counts (n_proc,), d)``."""
+    from jax.experimental import multihost_utils
+
+    info = multihost_utils.process_allgather(
+        np.asarray([n_local, d_local], dtype=np.int64)
+    )
+    info = np.asarray(info).reshape(-1, 2)
+    widths = sorted({int(w) for w in info[:, 1] if w >= 0})
+    if not widths:
+        raise ValueError("no process contributed any blocks")
+    if len(widths) > 1:
+        raise ValueError(f"feature dim mismatch across processes: {widths}")
+    return info[:, 0], widths[0]
+
+
 def shard_rows_process_local(
     partitions: List[np.ndarray], mesh: Mesh, dtype=None
 ) -> Tuple[jax.Array, jax.Array, int]:
@@ -116,8 +136,6 @@ def shard_rows_process_local(
     row mask zeroes the padding inside the compiled reductions, so results
     are exact. Returns ``(x_sharded, row_mask_sharded, n_true_rows_global)``.
     """
-    from jax.experimental import multihost_utils
-
     parts = [np.asarray(p) for p in partitions]
     if dtype is not None:
         parts = [p.astype(dtype, copy=False) for p in parts]
@@ -126,22 +144,8 @@ def shard_rows_process_local(
     # empty partition list) carry no width information.
     d_local = next((p.shape[1] for p in parts if p.shape[0] > 0), -1)
 
-    # The allgather comes FIRST — before anything that can raise on a
-    # process with no local blocks — so an empty executor participates in
-    # the collective instead of stranding its peers in it.
-    info = multihost_utils.process_allgather(np.asarray([n_local, d_local]))
-    info = np.asarray(info).reshape(-1, 2)
-    counts = info[:, 0]
+    counts, d = _allgather_counts_and_width(n_local, d_local)
     n_true = int(counts.sum())
-    widths = sorted({int(w) for w in info[:, 1] if w >= 0})
-    if not widths:
-        raise ValueError("no process contributed any blocks")
-    if len(widths) > 1:
-        # Every process sees the same gathered widths, so this raises on
-        # ALL of them consistently — an asymmetric raise would strand the
-        # healthy processes in the next collective.
-        raise ValueError(f"feature dim mismatch across processes: {widths}")
-    d = widths[0]
     np_dtype = parts[0].dtype if parts else np.dtype(dtype or np.float64)
 
     n_proc = jax.process_count()
@@ -233,16 +237,7 @@ def streaming_covariance_process_local(
         gram = np.asarray(gram, dtype=np.float64)
     d_local = shift.shape[0] if shift is not None else -1
 
-    info = multihost_utils.process_allgather(
-        np.asarray([n_local, d_local], dtype=np.int64)
-    )
-    info = np.asarray(info).reshape(-1, 2)
-    widths = sorted({int(w) for w in info[:, 1] if w >= 0})
-    if not widths:
-        raise ValueError("no process contributed any blocks")
-    if len(widths) > 1:
-        raise ValueError(f"feature dim mismatch across processes: {widths}")
-    d = widths[0]
+    counts, d = _allgather_counts_and_width(n_local, d_local)
     if shift is None:
         shift = np.zeros(d)
         gram = np.zeros((d, d))
@@ -270,7 +265,6 @@ def streaming_covariance_process_local(
         )
         gathered = g_hi + g_lo
     gathered = gathered.reshape(-1, 2 * d + d * d)
-    counts = info[:, 0]
 
     # Merge through the ONE home of the shifted-moment rebase algebra.
     from spark_rapids_ml_tpu.core.moments import ShiftedMoments

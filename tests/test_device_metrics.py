@@ -1,0 +1,91 @@
+"""Device-side evaluator kernels must agree with the host evaluators —
+the scale path (jax-array or >=1M-row tuples) vs the validation-fold path
+(VERDICT r1 weak item 7: the AUC sort no longer collects to host)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.ops.metrics import (
+    binary_auc_device,
+    confusion_matrix_device,
+    multiclass_metrics_device,
+    regression_metrics_device,
+)
+
+
+class TestRegressionDevice:
+    def test_matches_host(self, rng):
+        y = rng.normal(size=5000) * 3 + 1
+        p = y + 0.3 * rng.normal(size=5000)
+        for m in ("rmse", "mse", "mae", "r2"):
+            ev = RegressionEvaluator().setMetricName(m)
+            host = ev.evaluate((y, p))
+            dev = ev.evaluate((jnp.asarray(y), jnp.asarray(p)))  # device route
+            assert dev == pytest.approx(host, rel=1e-9)
+        rmse, mse, mae, r2 = regression_metrics_device(jnp.asarray(y), jnp.asarray(p))
+        assert float(rmse) == pytest.approx(np.sqrt(np.mean((y - p) ** 2)))
+
+
+class TestMulticlassDevice:
+    def test_matches_host(self, rng):
+        y = rng.integers(0, 4, 3000).astype(float)
+        p = np.where(rng.uniform(size=3000) < 0.7, y, rng.integers(0, 4, 3000)).astype(float)
+        for m in ("accuracy", "f1", "weightedPrecision", "weightedRecall"):
+            ev = MulticlassClassificationEvaluator().setMetricName(m)
+            host = ev.evaluate((y, p))
+            dev = ev.evaluate((jnp.asarray(y), jnp.asarray(p)))
+            assert dev == pytest.approx(host, rel=1e-9), m
+
+    def test_confusion_matrix(self, rng):
+        y = rng.integers(0, 3, 500)
+        p = rng.integers(0, 3, 500)
+        cm = np.asarray(confusion_matrix_device(jnp.asarray(y), jnp.asarray(p), 3))
+        for a in range(3):
+            for b in range(3):
+                assert cm[a, b] == np.sum((y == a) & (p == b))
+
+    def test_single_class_predictions(self):
+        """All predictions one class: precision of empty classes is 0."""
+        y = jnp.asarray([0, 1, 2, 1])
+        p = jnp.asarray([1, 1, 1, 1])
+        out = multiclass_metrics_device(y, p, 3)
+        assert out["accuracy"] == pytest.approx(0.5)
+        assert 0.0 <= out["weightedPrecision"] <= 1.0
+
+
+class TestBinaryAUCDevice:
+    def test_matches_host(self, rng):
+        y = rng.integers(0, 2, 4000).astype(float)
+        s = y * 0.8 + rng.normal(size=4000)
+        for m in ("areaUnderROC", "areaUnderPR"):
+            ev = BinaryClassificationEvaluator().setMetricName(m)
+            host = ev.evaluate((y, s))
+            dev = ev.evaluate((jnp.asarray(y), jnp.asarray(s)))
+            assert dev == pytest.approx(host, rel=1e-6), m
+
+    def test_ties_match_host(self, rng):
+        """Heavy score ties: the tie-grouped curve must agree exactly."""
+        y = rng.integers(0, 2, 1000).astype(float)
+        s = np.round(y * 0.5 + rng.normal(size=1000), 1)  # many ties
+        for m in ("areaUnderROC", "areaUnderPR"):
+            ev = BinaryClassificationEvaluator().setMetricName(m)
+            host = ev.evaluate((y, s))
+            dev = float(binary_auc_device(jnp.asarray(y), jnp.asarray(s), metric=m))
+            assert dev == pytest.approx(host, rel=1e-6), m
+
+    def test_degenerate_single_class(self):
+        y = jnp.zeros(50)
+        s = jnp.linspace(0, 1, 50)
+        assert float(binary_auc_device(y, s)) == 0.0
+
+    def test_perfect_separation(self):
+        y = jnp.asarray([0.0] * 50 + [1.0] * 50)
+        s = jnp.concatenate([jnp.linspace(0, 0.4, 50), jnp.linspace(0.6, 1.0, 50)])
+        assert float(binary_auc_device(y, s)) == pytest.approx(1.0)
