@@ -45,6 +45,15 @@ def _device_pair(dataset):
     big = getattr(y, "shape", [0])[0] >= _DEVICE_THRESHOLD
     if not (on_device or big):
         return None
+    if not on_device and not jax.config.jax_enable_x64:
+        # Large HOST arrays route to device only if their precision is
+        # preserved there — a host-fp64 tuple must not silently compute at
+        # f32 just because it is big (the prior host path was exact f64).
+        f64_in = any(
+            getattr(np.asarray(a), "dtype", None) == np.float64 for a in (y, p)
+        )
+        if f64_in:
+            return None
     import jax.numpy as jnp
 
     return jnp.ravel(jnp.asarray(y)), jnp.ravel(jnp.asarray(p))
@@ -203,7 +212,8 @@ class MulticlassClassificationEvaluator(Evaluator):
                 return multiclass_metrics_device(
                     y_d.astype(jnp.int32), p_d.astype(jnp.int32), int(hi) + 1
                 )[self.getMetricName()]
-            dataset = (np.asarray(y_d), np.asarray(p_d))
+            # Fall through to the host path with the ORIGINAL columns —
+            # the device round-trip may have downcast them (x64 off).
         y, p = _pair(
             dataset, self.getOrDefault(self.labelCol), self.getOrDefault(self.predictionCol)
         )

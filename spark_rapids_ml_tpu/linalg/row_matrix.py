@@ -91,10 +91,17 @@ class RowMatrix:
             precision, mesh=mesh, input_dtype=input_dtype, backend=backend
         )
         if self.precision == "dd" and mesh is not None:
-            raise ValueError(
-                "precision='dd' is single-device; unset the mesh or use "
-                "precision='highest' (the mesh covariance path)"
-            )
+            # dd composes with a mesh ONLY as the per-executor streaming
+            # merge (each process runs the dd scan on its local blocks;
+            # parallel.distributed.streaming_covariance_process_local) —
+            # the GSPMD sharded-gram paths are f32 programs.
+            if not (self.partitions is None and jax.process_count() > 1):
+                raise ValueError(
+                    "precision='dd' with a mesh requires the multi-process "
+                    "streaming deployment (per-executor dd scans + moment "
+                    "merge); single-process mesh fits use "
+                    "precision='highest'"
+                )
         # Covariance kernel backend for the GEMM path. Measured on v5e at
         # 1M x 1024 f32/HIGHEST (BASELINE.md): XLA whole-array fusion 24.9
         # TFLOP/s > pallas fused streaming 22.0 > XLA scan-blocked 21.7 —
@@ -321,6 +328,13 @@ class RowMatrix:
                         dtype=self.dtype,
                         precision=self.precision,
                     )
+                if self.precision == "dd":
+                    # Keep the exact-fp64 host covariance — a device-dtype
+                    # cast (f32 without x64) would destroy the accuracy
+                    # this combination exists to provide.
+                    self._num_rows = int(n)
+                    self._num_cols = int(cov.shape[0])
+                    return cov
             else:
                 from spark_rapids_ml_tpu.ops.covariance import (
                     streaming_mean_and_covariance_mesh,

@@ -89,3 +89,54 @@ class TestBinaryAUCDevice:
         y = jnp.asarray([0.0] * 50 + [1.0] * 50)
         s = jnp.concatenate([jnp.linspace(0, 0.4, 50), jnp.linspace(0.6, 1.0, 50)])
         assert float(binary_auc_device(y, s)) == pytest.approx(1.0)
+
+
+class TestPrecisionRouting:
+    def test_host_f64_not_demoted_without_x64(self, rng, monkeypatch):
+        """A big host float64 tuple must stay on the exact host path when
+        the device would compute it at f32 (r2 review: 8% rmse error on
+        large-offset targets)."""
+        import spark_rapids_ml_tpu.evaluation as ev_mod
+
+        monkeypatch.setattr(ev_mod, "_DEVICE_THRESHOLD", 100)
+        y = rng.normal(size=1_000) + 1e6
+        p = y + 0.01 * rng.normal(size=1_000)
+
+        import jax
+
+        # Simulate the no-x64 platform decision without flipping the
+        # global flag mid-suite: patch the config object the router reads.
+        class _Cfg:
+            jax_enable_x64 = False
+
+        real_config = jax.config
+        monkeypatch.setattr(ev_mod, "_device_pair", ev_mod._device_pair)
+        # Directly check the routing decision instead.
+        monkeypatch.setattr(jax, "config", _Cfg)
+        try:
+            routed = ev_mod._device_pair((y, p))
+        finally:
+            monkeypatch.setattr(jax, "config", real_config)
+        assert routed is None  # stays host-side: exact f64
+
+        # f32 host input of the same size IS routed (no precision loss).
+        monkeypatch.setattr(jax, "config", _Cfg)
+        try:
+            routed32 = ev_mod._device_pair(
+                (y.astype(np.float32), p.astype(np.float32))
+            )
+        finally:
+            monkeypatch.setattr(jax, "config", real_config)
+        assert routed32 is not None
+
+    def test_multiclass_fallback_keeps_original_columns(self, rng, monkeypatch):
+        """Labels failing the bincount gate must evaluate from the ORIGINAL
+        columns, not a device round-trip (r2 review)."""
+        import spark_rapids_ml_tpu.evaluation as ev_mod
+
+        monkeypatch.setattr(ev_mod, "_DEVICE_THRESHOLD", 100)
+        # Sparse large IDs: gate rejects; host np.unique handles exactly.
+        y = rng.choice([7.0, 123456.0], size=500)
+        p = np.where(rng.uniform(size=500) < 0.8, y, 7.0)
+        ev = MulticlassClassificationEvaluator().setMetricName("accuracy")
+        assert ev.evaluate((y, p)) == pytest.approx(np.mean(y == p))

@@ -168,7 +168,9 @@ class TestPCAPrecisionDD:
         from spark_rapids_ml_tpu.parallel.mesh import make_mesh
 
         x = rng.normal(size=(64, 4))
-        with pytest.raises(ValueError, match="single-device"):
+        # Single-process mesh fits have no dd route (dd + mesh is the
+        # multi-process streaming deployment only).
+        with pytest.raises(ValueError, match="multi-process streaming"):
             PCA(mesh=make_mesh()).setK(2).setPrecision("dd").fit(x)
 
     def test_invalid_precision_rejected(self):
