@@ -119,14 +119,10 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 # row (the mapPartitions block streaming of
                 # RapidsRowMatrix.scala:170-200).
                 acc = ShiftedMoments(d)
-                batch = []
-                for v in rows:
-                    batch.append(np.asarray(v.toArray(), dtype=np.float64))
-                    if len(batch) >= 4096:
-                        acc.add_block(np.stack(batch))
-                        batch = []
-                if batch:
-                    acc.add_block(np.stack(batch))
+                for chunk in _row_batches(rows):
+                    acc.add_block(
+                        np.stack([np.asarray(v.toArray(), dtype=np.float64) for v in chunk])
+                    )
                 return [acc]
 
             acc = rdd.mapPartitions(part_op).treeReduce(lambda a, b: a.merge(b))
@@ -284,6 +280,19 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
 
         return predict
 
+    def _row_batches(rows, size=4096):
+        """Yield lists of up to ``size`` rows from a partition iterator —
+        THE executor batching convention (one numpy op per batch instead
+        of per-row Python work); shared by every mapPartitions op here."""
+        batch = []
+        for r in rows:
+            batch.append(r)
+            if len(batch) >= size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
     def _sq_dists(x: np.ndarray, centers: np.ndarray) -> np.ndarray:
         """(n, k) squared distances via ||x||^2 - 2 x c^T + ||c||^2: one
         (n, d) x (d, k) matmul, no (n, k, d) intermediate (the memory
@@ -391,23 +400,15 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                         sums = np.zeros((k, d))
                         counts = np.zeros(k)
                         sse = 0.0
-                        batch = []
-
-                        def flush(batch, sums, counts, sse):
-                            x = np.stack(batch)
+                        for chunk in _row_batches(rows):
+                            x = np.stack(
+                                [np.asarray(v.toArray(), dtype=np.float64) for v in chunk]
+                            )
                             d2 = _sq_dists(x, c)
                             a = np.argmin(d2, axis=1)
                             np.add.at(sums, a, x)
                             np.add.at(counts, a, 1.0)
-                            return sse + float(d2[np.arange(len(a)), a].sum())
-
-                        for v in rows:
-                            batch.append(np.asarray(v.toArray(), dtype=np.float64))
-                            if len(batch) >= 4096:
-                                sse = flush(batch, sums, counts, sse)
-                                batch = []
-                        if batch:
-                            sse = flush(batch, sums, counts, sse)
+                            sse += float(d2[np.arange(len(a)), a].sum())
                         return [(sums, counts, sse)]
 
                     sums, counts, _sse = rdd.mapPartitions(part_op).treeReduce(
@@ -526,18 +527,20 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
 
             def part_op(rows, d=d):
                 acc = ShiftedMoments(d + 1)
-                batch = []
-                for row in rows:
-                    batch.append(
-                        np.concatenate(
-                            [np.asarray(row[0].toArray(), dtype=np.float64), [float(row[1])]]
+                for chunk in _row_batches(rows):
+                    acc.add_block(
+                        np.stack(
+                            [
+                                np.concatenate(
+                                    [
+                                        np.asarray(row[0].toArray(), dtype=np.float64),
+                                        [float(row[1])],
+                                    ]
+                                )
+                                for row in chunk
+                            ]
                         )
                     )
-                    if len(batch) >= 4096:
-                        acc.add_block(np.stack(batch))
-                        batch = []
-                if batch:
-                    acc.add_block(np.stack(batch))
                 return [acc]
 
             acc = rdd.mapPartitions(part_op).treeReduce(lambda a, b: a.merge(b))
@@ -718,6 +721,16 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             return self._set(elasticNetParam=value)
 
         def _fit(self, dataset):
+            # Elastic net needs the proximal solver — that path collects to
+            # the driver chip; the L2/unregularized path fits DISTRIBUTED:
+            # per-iteration executor loss/grad sums (numpy, Spark's
+            # treeAggregate-per-step structure) driving L-BFGS-B on the
+            # driver.
+            if self.getOrDefault(self.elasticNetParam) > 0.0:
+                return self._fit_collected(dataset)
+            return self._fit_distributed(dataset)
+
+        def _fit_collected(self, dataset):
             from spark_rapids_ml_tpu.classification import LogisticRegression
 
             x, y = _collect_xy(
@@ -732,10 +745,126 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 .setElasticNetParam(self.getOrDefault(self.elasticNetParam))
                 .fit((x, y))
             )
+            return self._wrap(core)
+
+        def _wrap(self, core):
             model = TpuLogisticRegressionModel(core)
             for p in ("featuresCol", "labelCol", "predictionCol", "probabilityCol", "rawPredictionCol"):
                 model._set(**{p: self.getOrDefault(getattr(self, p))})
             return model
+
+        def _fit_distributed(self, dataset):
+            import scipy.optimize
+
+            from spark_rapids_ml_tpu.models.logistic_regression import (
+                LogisticRegressionModel,
+            )
+
+            f_col = self.getOrDefault(self.featuresCol)
+            l_col = self.getOrDefault(self.labelCol)
+            rdd = dataset.select(f_col, l_col).rdd
+            # The iterative fit re-reads the data every L-BFGS evaluation:
+            # persist once (Spark's own LogisticRegression caches its
+            # instances RDD the same way).
+            rdd.persist()
+            try:
+                d = len(rdd.first()[0].toArray())
+
+                # Pass 1: O(d) per-feature moments (standardization) +
+                # label range — count/sum/sum-of-squares, not a d x d gram.
+                def stat_op(rows, d=d):
+                    n_loc = 0
+                    s = np.zeros(d)
+                    ss = np.zeros(d)
+                    y_max = 0
+                    for chunk in _row_batches(rows):
+                        xb = np.stack(
+                            [np.asarray(r[0].toArray(), dtype=np.float64) for r in chunk]
+                        )
+                        y_max = max(y_max, max(int(r[1]) for r in chunk))
+                        n_loc += xb.shape[0]
+                        s += xb.sum(axis=0)
+                        ss += (xb * xb).sum(axis=0)
+                    return [(n_loc, s, ss, y_max)]
+
+                n_i, s, ss, y_max = rdd.mapPartitions(stat_op).treeReduce(
+                    lambda a, b: (
+                        a[0] + b[0], a[1] + b[1], a[2] + b[2], max(a[3], b[3])
+                    )
+                )
+                n = float(n_i)
+                mean = s / n
+                # POPULATION variance, matching the core solver's scaler
+                # (ops/logistic._masked_feature_moments divides by n).
+                var = np.clip(ss / n - mean * mean, 0.0, None)
+                sigma = np.sqrt(var)
+                scale = np.where(sigma > 0, sigma, 1.0)
+                offset = mean
+                n_classes = max(y_max + 1, 2)
+                binomial = n_classes == 2
+                c = 1 if binomial else n_classes
+                reg = self.getOrDefault(self.regParam)
+
+                def objective(theta):
+                    w = theta[: d * c].reshape(d, c)
+                    b = theta[d * c :]
+
+                    def part_op(rows, w=w, b=b, offset=offset, scale=scale,
+                                binomial=binomial):
+                        from spark_rapids_ml_tpu.spark.executor_math import (
+                            logistic_loss_grad,
+                        )
+
+                        loss = 0.0
+                        gw = np.zeros_like(w)
+                        gb = np.zeros_like(b)
+                        for chunk in _row_batches(rows):
+                            xs = (
+                                np.stack(
+                                    [
+                                        np.asarray(r[0].toArray(), dtype=np.float64)
+                                        for r in chunk
+                                    ]
+                                )
+                                - offset
+                            ) / scale
+                            yb = np.asarray([int(r[1]) for r in chunk])
+                            ls, gws, gbs = logistic_loss_grad(w, b, xs, yb, binomial)
+                            loss += ls
+                            gw += gws
+                            gb += gbs
+                        return [(loss, gw, gb)]
+
+                    tot_l, tot_gw, tot_gb = rdd.mapPartitions(part_op).treeReduce(
+                        lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2])
+                    )
+                    loss = tot_l / n + 0.5 * reg * float(np.sum(w * w))
+                    grad = np.concatenate(
+                        [(tot_gw / n + reg * w).ravel(), tot_gb / n]
+                    )
+                    return loss, grad
+
+                res = scipy.optimize.minimize(
+                    objective,
+                    np.zeros(d * c + c),
+                    jac=True,
+                    method="L-BFGS-B",
+                    options={"maxiter": self.getOrDefault(self.maxIter), "gtol": 1e-6},
+                )
+            finally:
+                rdd.unpersist()
+            w_std = res.x[: d * c].reshape(d, c)
+            b_std = res.x[d * c :]
+            if c > 1 and reg == 0.0:
+                # Identifiability pivot, matching the core solver.
+                w_std = w_std - w_std.mean(axis=1, keepdims=True)
+                b_std = b_std - b_std.mean()
+            w_orig = w_std / scale[:, None]
+            b_orig = b_std - offset @ w_orig
+            core = LogisticRegressionModel(
+                None, w_orig, b_orig, numClasses=n_classes, numIter=int(res.nit)
+            )
+            return self._wrap(core)
 
     class TpuLogisticRegressionModel(SparkModel, _TpuProbabilisticParams, MLReadable):
         def __init__(self, core_model=None):

@@ -71,6 +71,38 @@ def forest_forward(
     return raw, probs, pred
 
 
+def logistic_loss_grad(
+    w: np.ndarray,  # (d, c) standardized-space weights
+    b: np.ndarray,  # (c,)
+    xs: np.ndarray,  # (rows, d) ALREADY standardized block
+    y: np.ndarray,  # (rows,) integer labels
+    binomial: bool,
+) -> Tuple[float, np.ndarray, np.ndarray]:
+    """Partition-local (Σ loss, Σ grad_w, Σ grad_b) for the logistic
+    objective — the executor unit of work of the distributed fit (Spark's
+    per-iteration treeAggregate); sums, not means, so partitions add.
+    Mirrors ops/logistic.loss_fn exactly (softplus / log-softmax forms).
+    """
+    logits = xs @ w + b
+    if binomial:
+        z = logits[:, 0]
+        yt = (y == 1).astype(np.float64)
+        # softplus(z) - y z, stable
+        loss = float(np.sum(np.logaddexp(0.0, z) - yt * z))
+        t = np.exp(-np.abs(z))
+        sig = np.where(z >= 0, 1.0 / (1.0 + t), t / (1.0 + t))
+        r = (sig - yt)[:, None]  # (rows, 1)
+    else:
+        m = logits - logits.max(axis=1, keepdims=True)
+        lse = m - np.log(np.exp(m).sum(axis=1, keepdims=True))
+        rows = np.arange(xs.shape[0])
+        loss = float(-np.sum(lse[rows, y.astype(np.int64)]))
+        probs = np.exp(lse)
+        probs[rows, y.astype(np.int64)] -= 1.0
+        r = probs
+    return loss, xs.T @ r, r.sum(axis=0)
+
+
 def forest_apply_leaves(
     feature: np.ndarray,
     threshold: np.ndarray,

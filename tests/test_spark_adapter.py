@@ -318,3 +318,57 @@ class TestTpuRandomForestRegressor:
             [r.prediction for r in loaded.transform(df).collect()]
         )
         np.testing.assert_allclose(preds2, preds)
+
+
+class TestDistributedLogistic:
+    def test_distributed_matches_core_optimum(self, spark_env, rng):
+        """The per-iteration executor loss/grad fit (scipy L-BFGS-B on the
+        driver, numpy treeReduce on executors) must land on the same
+        convex optimum as the core single-machine solver."""
+        adapter, spark = spark_env
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        x = rng.normal(size=(400, 5)) + 2.0
+        y = (x[:, 0] - x[:, 1] > 2.0).astype(float)
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
+        m_dist = (
+            adapter.TpuLogisticRegression()
+            .setMaxIter(200)
+            .setRegParam(0.01)
+            .fit(df)
+        )
+        m_core = (
+            LogisticRegression().setMaxIter(400).setRegParam(0.01).fit((x, y))
+        )
+        # Tight: both optimize the identical objective (population-std
+        # standardization matches the core scaler exactly).
+        np.testing.assert_allclose(
+            np.asarray(m_dist.coefficients.toArray()),
+            m_core.coefficients,
+            atol=5e-4,
+        )
+        assert m_dist.intercept == pytest.approx(m_core.intercept, abs=5e-3)
+
+    def test_multinomial_distributed(self, spark_env, rng):
+        adapter, spark = spark_env
+        x = rng.normal(size=(450, 4))
+        y = np.argmax(x[:, :3] + 0.3 * rng.normal(size=(450, 3)), axis=1).astype(float)
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=3)
+        model = adapter.TpuLogisticRegression().setMaxIter(150).fit(df)
+        preds = np.asarray([r.prediction for r in model.transform(df).collect()])
+        assert np.mean(preds == y) > 0.8
+
+    def test_elastic_net_falls_back_to_collected(self, spark_env, rng):
+        adapter, spark = spark_env
+        x = rng.normal(size=(200, 4))
+        y = (x[:, 0] > 0).astype(float)
+        df = _vector_df(spark, x, extra={"label": list(y)})
+        model = (
+            adapter.TpuLogisticRegression()
+            .setMaxIter(100)
+            .setRegParam(0.05)
+            .setElasticNetParam(0.5)
+            .fit(df)
+        )
+        preds = np.asarray([r.prediction for r in model.transform(df).collect()])
+        assert np.mean(preds == y) > 0.9
