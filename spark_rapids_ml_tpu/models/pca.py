@@ -364,6 +364,32 @@ class PCAModel(_PCAParams, Model):
         if self.pc is None:
             raise RuntimeError("model has no principal components")
         rows = extract_column(dataset, self.getInputCol())
+        from spark_rapids_ml_tpu.core.data import (
+            is_streaming_source,
+            iter_stream_blocks,
+        )
+
+        if is_streaming_source(rows):
+            # Streaming in, streaming out: project block by block at
+            # constant memory (the symmetric counterpart of streaming fit).
+            pc = self.pc
+
+            def projected_blocks():
+                from spark_rapids_ml_tpu.core.data import _block_to_dense
+
+                with TraceRange("stream transform", TraceColor.GREEN):
+                    for blk in iter_stream_blocks(rows):
+                        part = _block_to_dense(blk)
+                        if part.shape[0] == 0:
+                            # Empty partitions densify to (0, 0) — skip
+                            # rather than matmul a widthless block.
+                            continue
+                        out = gemm_project(
+                            part.T.astype(pc.dtype, copy=False), pc
+                        )
+                        yield np.asarray(out)
+
+            return projected_blocks()
         parts = as_partitions(rows)
         dtype = self.pc.dtype
         outs = []

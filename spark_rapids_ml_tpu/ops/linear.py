@@ -267,6 +267,9 @@ def normal_eq_stats_streaming(block_pairs, dtype=None, precision: str = "highest
     acc = None
     d = None
     for xb, yb in block_pairs:
+        if getattr(xb, "shape", (1,))[0] == 0:
+            # Empty partitions densify to (0, 0) — no rows, no width info.
+            continue
         xj = jnp.asarray(np.ascontiguousarray(xb), dtype=dtype)
         yj = jnp.asarray(np.ascontiguousarray(yb), dtype=dtype)
         if d is None:

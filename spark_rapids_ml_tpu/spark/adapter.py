@@ -120,9 +120,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 # RapidsRowMatrix.scala:170-200).
                 acc = ShiftedMoments(d)
                 for chunk in _row_batches(rows):
-                    acc.add_block(
-                        np.stack([np.asarray(v.toArray(), dtype=np.float64) for v in chunk])
-                    )
+                    acc.add_block(_dense_chunk(chunk, col=None))
                 return [acc]
 
             acc = rdd.mapPartitions(part_op).treeReduce(lambda a, b: a.merge(b))
@@ -293,6 +291,15 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
         if batch:
             yield batch
 
+    def _dense_chunk(chunk, col=0):
+        """One (rows, d) float64 block from a chunk of Rows (or Vectors when
+        ``col is None``) — the densify half of the batching convention."""
+        if col is None:
+            return np.stack([np.asarray(v.toArray(), dtype=np.float64) for v in chunk])
+        return np.stack(
+            [np.asarray(r[col].toArray(), dtype=np.float64) for r in chunk]
+        )
+
     def _sq_dists(x: np.ndarray, centers: np.ndarray) -> np.ndarray:
         """(n, k) squared distances via ||x||^2 - 2 x c^T + ||c||^2: one
         (n, d) x (d, k) matmul, no (n, k, d) intermediate (the memory
@@ -401,9 +408,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                         counts = np.zeros(k)
                         sse = 0.0
                         for chunk in _row_batches(rows):
-                            x = np.stack(
-                                [np.asarray(v.toArray(), dtype=np.float64) for v in chunk]
-                            )
+                            x = _dense_chunk(chunk, col=None)
                             d2 = _sq_dists(x, c)
                             a = np.argmin(d2, axis=1)
                             np.add.at(sums, a, x)
@@ -726,7 +731,11 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             # per-iteration executor loss/grad sums (numpy, Spark's
             # treeAggregate-per-step structure) driving L-BFGS-B on the
             # driver.
-            if self.getOrDefault(self.elasticNetParam) > 0.0:
+            if (
+                self.getOrDefault(self.elasticNetParam) > 0.0
+                and self.getOrDefault(self.regParam) > 0.0
+            ):
+                # Nonzero effective L1 needs the proximal solver.
                 return self._fit_collected(dataset)
             return self._fit_distributed(dataset)
 
@@ -778,9 +787,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                     ss = np.zeros(d)
                     y_max = 0
                     for chunk in _row_batches(rows):
-                        xb = np.stack(
-                            [np.asarray(r[0].toArray(), dtype=np.float64) for r in chunk]
-                        )
+                        xb = _dense_chunk(chunk)
                         y_max = max(y_max, max(int(r[1]) for r in chunk))
                         n_loc += xb.shape[0]
                         s += xb.sum(axis=0)
@@ -819,15 +826,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                         gw = np.zeros_like(w)
                         gb = np.zeros_like(b)
                         for chunk in _row_batches(rows):
-                            xs = (
-                                np.stack(
-                                    [
-                                        np.asarray(r[0].toArray(), dtype=np.float64)
-                                        for r in chunk
-                                    ]
-                                )
-                                - offset
-                            ) / scale
+                            xs = (_dense_chunk(chunk) - offset) / scale
                             yb = np.asarray([int(r[1]) for r in chunk])
                             ls, gws, gbs = logistic_loss_grad(w, b, xs, yb, binomial)
                             loss += ls

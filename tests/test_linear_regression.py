@@ -269,3 +269,15 @@ class TestStreamingBlocks:
         bad = [np.ones((10, 3)), np.ones((10, 4))]
         with pytest.raises(ValueError, match="inconsistent feature dims"):
             LinearRegression().fit((bad, np.zeros(20)))
+
+
+def test_streaming_empty_blocks_skipped(rng):
+    """Empty blocks in a streamed (X, y) fit contribute nothing instead of
+    raising an inconsistent-dims error (r2 review)."""
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    x = rng.normal(size=(600, 3))
+    y = x @ np.array([1.0, -2.0, 0.5]) + 1.0
+    blocks = [x[:200], np.zeros((0, 3)), x[200:]]
+    model = LinearRegression().fit((blocks, y))
+    np.testing.assert_allclose(model.coefficients, [1.0, -2.0, 0.5], atol=1e-8)

@@ -204,3 +204,50 @@ print("GROWTH_KB", peak - base)
         # The bound is loose for run-to-run reclaim variance but decisively
         # below both the no-release behavior and the file size.
         assert growth_kb < 160_000, f"peak RSS grew {growth_kb} KB"
+
+
+class TestStreamingTransform:
+    def test_generator_in_generator_out(self, rng):
+        """transform on a streaming source yields projected blocks lazily
+        — the symmetric counterpart of the streaming fit."""
+        import types
+
+        x = rng.normal(size=(3_000, 6)) * np.linspace(1, 2, 6)
+        model = PCA().setK(2).fit(x)
+        gen = (x[i : i + 512] for i in range(0, 3_000, 512))
+        out = model.transform(gen)
+        assert isinstance(out, types.GeneratorType)
+        blocks = list(out)
+        assert sum(b.shape[0] for b in blocks) == 3_000
+        np.testing.assert_allclose(
+            np.concatenate(blocks), model.transform(x), atol=1e-9
+        )
+
+    @pytest.mark.skipif(
+        not native.available(), reason="native library unavailable"
+    )
+    def test_reader_transform(self, rng, tmp_path):
+        x = rng.normal(size=(2_048, 5))
+        path = str(tmp_path / "t.npy")
+        np.save(path, x)
+        model = PCA().setK(2).fit(x)
+        reader = native.NpyBlockReader(path, block_rows=300)
+        try:
+            blocks = list(model.transform(reader))
+        finally:
+            reader.close()
+        np.testing.assert_allclose(
+            np.concatenate(blocks), model.transform(x), atol=1e-9
+        )
+
+    def test_empty_blocks_skipped(self, rng):
+        """Empty partitions (densifying to (0, 0)) must not kill the
+        stream — fit or transform (r2 review)."""
+        x = rng.normal(size=(900, 4))
+        model = PCA().setK(2).fit(iter([x[:400], [], x[400:]]))
+        oracle = PCA().setK(2).fit(x)
+        _pc_close(model.pc, oracle.pc, 1e-8)
+        blocks = list(model.transform(iter([x[:400], [], x[400:]])))
+        np.testing.assert_allclose(
+            np.concatenate(blocks), model.transform(x), atol=1e-9
+        )
