@@ -273,6 +273,8 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
         def predict(series):
             import pandas as pd
 
+            if len(series) == 0:  # empty partition: nothing to score
+                return pd.Series([], dtype=np.float64)
             block = np.stack([np.asarray(v, dtype=np.float64) for v in series])
             return pd.Series(np.asarray(fn(block), dtype=np.float64))
 
@@ -660,6 +662,8 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             def scores(series):
                 import pandas as pd
 
+                if len(series) == 0:
+                    return pd.Series([], dtype=object)
                 block = np.stack(
                     [np.asarray(v, dtype=np.float64) for v in series]
                 )
@@ -1028,6 +1032,173 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 RandomForestClassificationModel.load(_os.path.join(path, "core"))
             )
             return _set_params_from_metadata(model, metadata)
+
+    class _TpuNeighborsBase(SparkEstimator, _TpuPredictorParams):
+        """Shared surface of the neighbor estimators: fit collects the item
+        vectors to the driver chip (the modern spark-rapids-ml deployment
+        shape for its no-Spark-ML-equivalent families), and the model's
+        ``kneighbors`` appends distances/indices array columns to a query
+        DataFrame via one Arrow-batch search per partition.
+
+        UNLIKE the classic families (numpy-only executors), the kneighbors
+        UDF ships the accelerated index/model to executors — searches run
+        the JAX kernels there, exactly as the modern reference requires
+        cuML on its executors for these families."""
+
+        k = Param(Params._dummy(), "k", "neighbors per query", TypeConverters.toInt)
+        inputCol = Param(Params._dummy(), "inputCol", "item/query vector column", TypeConverters.toString)
+
+        def setK(self, value):
+            return self._set(k=value)
+
+        def setInputCol(self, value):
+            return self._set(inputCol=value)
+
+        def _collect_items(self, dataset):
+            col = self.getOrDefault(self.inputCol)
+            xs = [
+                np.asarray(row[0].toArray(), dtype=np.float64)
+                for row in dataset.select(col).rdd.toLocalIterator()
+            ]
+            if not xs:
+                raise ValueError("empty dataset")
+            return np.stack(xs)
+
+    class _TpuNeighborsModelBase(SparkModel, _TpuPredictorParams):
+        k = _TpuNeighborsBase.k
+        inputCol = _TpuNeighborsBase.inputCol
+
+        def __init__(self, core_model=None):
+            super().__init__()
+            self._setDefault(inputCol="features", k=5)
+            self._core = core_model
+
+        def kneighbors(self, dataset, k=None):
+            """Append ``distances`` / ``indices`` array columns (original
+            item row indices) to the query DataFrame."""
+            from pyspark.ml.functions import vector_to_array
+            from pyspark.sql.functions import col, pandas_udf
+
+            core = self._core
+            k_eff = int(k if k is not None else self.getOrDefault(self.k))
+
+            @pandas_udf("array<double>")
+            def knn_pairs(series):
+                import pandas as pd
+
+                if len(series) == 0:  # empty query partition
+                    return pd.Series([], dtype=object)
+                block = np.stack([np.asarray(v, dtype=np.float64) for v in series])
+                d, i = core.kneighbors(block, k=k_eff)
+                packed = np.concatenate(
+                    [np.asarray(d, dtype=np.float64), np.asarray(i, dtype=np.float64)],
+                    axis=1,
+                )
+                return pd.Series(list(packed))
+
+            def slice_arr(lo, hi):
+                @pandas_udf("array<double>")
+                def s(series):
+                    import pandas as pd
+
+                    return pd.Series([np.asarray(v)[lo:hi] for v in series])
+
+                return s
+
+            @pandas_udf("array<long>")
+            def indices_slice(series):
+                import pandas as pd
+
+                return pd.Series(
+                    [
+                        np.asarray(v)[k_eff : 2 * k_eff].astype(np.int64)
+                        for v in series
+                    ]
+                )
+
+            feats = vector_to_array(col(self.getOrDefault(self.inputCol)))
+            tmp = "_tpu_knn"
+            out = dataset.withColumn(tmp, knn_pairs(feats))
+            out = out.withColumn("distances", slice_arr(0, k_eff)(col(tmp)))
+            # Indices surface as INTEGERS (the reference's column type),
+            # not float-coerced doubles.
+            out = out.withColumn("indices", indices_slice(col(tmp)))
+            return out.drop(tmp)
+
+    class TpuNearestNeighbors(_TpuNeighborsBase):
+        """Exact kNN (the modern spark-rapids-ml NearestNeighbors)."""
+
+        metric = Param(Params._dummy(), "metric", "euclidean|sqeuclidean|cosine", TypeConverters.toString)
+
+        def __init__(self, k=5, inputCol="features"):
+            super().__init__()
+            self._setDefault(k=5, inputCol="features", metric="euclidean",
+                             predictionCol="prediction", featuresCol="features",
+                             labelCol="label")
+            self._set(k=k, inputCol=inputCol)
+
+        def setMetric(self, value):
+            return self._set(metric=value)
+
+        def _fit(self, dataset):
+            from spark_rapids_ml_tpu.neighbors import NearestNeighbors
+
+            items = self._collect_items(dataset)
+            core = (
+                NearestNeighbors()
+                .setK(self.getOrDefault(self.k))
+                .setMetric(self.getOrDefault(self.metric))
+                .fit(items)
+            )
+            model = TpuNearestNeighborsModel(core)
+            model._set(
+                k=self.getOrDefault(self.k),
+                inputCol=self.getOrDefault(self.inputCol),
+            )
+            return model
+
+    class TpuNearestNeighborsModel(_TpuNeighborsModelBase):
+        pass
+
+    class TpuApproximateNearestNeighbors(_TpuNeighborsBase):
+        """ANN (ivfflat | ivfpq) — the modern spark-rapids-ml ANN family."""
+
+        algorithm = Param(Params._dummy(), "algorithm", "ivfflat|ivfpq", TypeConverters.toString)
+        algoParams = Param(Params._dummy(), "algoParams", "algorithm parameters", TypeConverters.identity)
+
+        def __init__(self, k=5, inputCol="features"):
+            super().__init__()
+            self._setDefault(k=5, inputCol="features", algorithm="ivfflat",
+                             algoParams={}, predictionCol="prediction",
+                             featuresCol="features", labelCol="label")
+            self._set(k=k, inputCol=inputCol)
+
+        def setAlgorithm(self, value):
+            return self._set(algorithm=value)
+
+        def setAlgoParams(self, value):
+            return self._set(algoParams=value)
+
+        def _fit(self, dataset):
+            from spark_rapids_ml_tpu.neighbors import ApproximateNearestNeighbors
+
+            items = self._collect_items(dataset)
+            core = (
+                ApproximateNearestNeighbors()
+                .setK(self.getOrDefault(self.k))
+                .setAlgorithm(self.getOrDefault(self.algorithm))
+                .setAlgoParams(dict(self.getOrDefault(self.algoParams)))
+                .fit(items)
+            )
+            model = TpuApproximateNearestNeighborsModel(core)
+            model._set(
+                k=self.getOrDefault(self.k),
+                inputCol=self.getOrDefault(self.inputCol),
+            )
+            return model
+
+    class TpuApproximateNearestNeighborsModel(_TpuNeighborsModelBase):
+        pass
 
     class TpuRandomForestRegressor(SparkEstimator, _TpuPredictorParams):
         numTrees = Param(Params._dummy(), "numTrees", "number of trees", TypeConverters.toInt)

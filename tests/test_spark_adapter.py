@@ -372,3 +372,56 @@ class TestDistributedLogistic:
         )
         preds = np.asarray([r.prediction for r in model.transform(df).collect()])
         assert np.mean(preds == y) > 0.9
+
+
+class TestNeighborsAdapters:
+    def test_nearest_neighbors(self, spark_env, rng):
+        adapter, spark = spark_env
+        items = rng.normal(size=(200, 6))
+        df = _vector_df(spark, items)
+        model = adapter.TpuNearestNeighbors(k=4).fit(df)
+        out = model.kneighbors(df)
+        rows = out.collect()
+        idx = np.stack([np.asarray(r.indices) for r in rows]).astype(int)
+        dist = np.stack([np.asarray(r.distances) for r in rows])
+        assert idx.shape == (200, 4)
+        np.testing.assert_array_equal(idx[:, 0], np.arange(200))  # self first
+        np.testing.assert_allclose(dist[:, 0], 0.0, atol=1e-5)
+        # Oracle check on a handful of rows.
+        d2 = ((items[:10, None, :] - items[None]) ** 2).sum(-1)
+        np.testing.assert_array_equal(idx[:10], np.argsort(d2, axis=1)[:, :4])
+
+    def test_approximate_nearest_neighbors(self, spark_env, rng):
+        adapter, spark = spark_env
+        items = rng.normal(size=(300, 8))
+        df = _vector_df(spark, items)
+        model = (
+            adapter.TpuApproximateNearestNeighbors(k=3)
+            .setAlgorithm("ivfflat")
+            .setAlgoParams({"nlist": 6, "nprobe": 6})
+            .fit(df)
+        )
+        out = model.kneighbors(df)
+        rows = out.collect()
+        idx = np.stack([np.asarray(r.indices) for r in rows]).astype(int)
+        assert idx.shape == (300, 3)
+        # nprobe == nlist: exhaustive, so self must be the first hit.
+        np.testing.assert_array_equal(idx[:, 0], np.arange(300))
+
+    def test_kneighbors_empty_partition(self, spark_env, rng):
+        """Empty query partitions (routine after filter/repartition) must
+        not kill the kneighbors job (r2 review)."""
+        adapter, spark = spark_env
+        from pyspark.ml.linalg import Vectors
+        from pyspark.sql import DataFrame as StubDF, Row
+
+        items = rng.normal(size=(50, 4))
+        df = _vector_df(spark, items)
+        model = adapter.TpuNearestNeighbors(k=3).fit(df)
+        rows = [Row(["features"], [Vectors.dense(v)]) for v in items[:10]]
+        lopsided = StubDF(["features"], [rows[:7], [], rows[7:]])
+        out = model.kneighbors(lopsided).collect()
+        assert len(out) == 10
+        idx = np.stack([np.asarray(r.indices) for r in out])
+        assert idx.dtype.kind in "iu" or np.all(idx == idx.astype(int))
+        np.testing.assert_array_equal(idx[:, 0].astype(int), np.arange(10))
