@@ -249,6 +249,18 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                     obj._set(**{name: value})
         return obj
 
+    def _collect_features(dataset, features_col):
+        """Materialize the feature vectors on the driver (partition-
+        streamed fetch) — the fit-side collect of the driver-chip
+        families."""
+        xs = [
+            np.asarray(row[0].toArray(), dtype=np.float64)
+            for row in dataset.select(features_col).rdd.toLocalIterator()
+        ]
+        if not xs:
+            raise ValueError("empty dataset")
+        return np.stack(xs)
+
     def _collect_xy(dataset, features_col, label_col):
         """Materialize (X, y) on the driver via toLocalIterator (partition-
         streamed fetch, avoiding one huge collect() result object). The
@@ -1055,14 +1067,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             return self._set(inputCol=value)
 
         def _collect_items(self, dataset):
-            col = self.getOrDefault(self.inputCol)
-            xs = [
-                np.asarray(row[0].toArray(), dtype=np.float64)
-                for row in dataset.select(col).rdd.toLocalIterator()
-            ]
-            if not xs:
-                raise ValueError("empty dataset")
-            return np.stack(xs)
+            return _collect_features(dataset, self.getOrDefault(self.inputCol))
 
     class _TpuNeighborsModelBase(SparkModel, _TpuPredictorParams):
         k = _TpuNeighborsBase.k
@@ -1199,6 +1204,209 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
 
     class TpuApproximateNearestNeighborsModel(_TpuNeighborsModelBase):
         pass
+
+    class TpuDBSCAN(SparkEstimator, _TpuPredictorParams):
+        """Density clustering (the modern spark-rapids-ml DBSCAN): fit
+        computes labels for the TRAINING rows on the driver chip; the
+        returned model's transform appends the cluster label column
+        (-1 = noise) to the fitted dataset (cuML fit_predict semantics)."""
+
+        eps = Param(Params._dummy(), "eps", "neighborhood radius", TypeConverters.toFloat)
+        minSamples = Param(Params._dummy(), "minSamples", "core point threshold", TypeConverters.toInt)
+
+        def __init__(self, featuresCol="features", predictionCol="prediction"):
+            super().__init__()
+            self._setDefault(
+                eps=0.5, minSamples=5, featuresCol="features",
+                labelCol="label", predictionCol="prediction",
+            )
+            self._set(featuresCol=featuresCol, predictionCol=predictionCol)
+
+        def setEps(self, value):
+            return self._set(eps=value)
+
+        def setMinSamples(self, value):
+            return self._set(minSamples=value)
+
+        def _fit(self, dataset):
+            from spark_rapids_ml_tpu.clustering import DBSCAN
+
+            x = _collect_features(dataset, self.getOrDefault(self.featuresCol))
+            core = (
+                DBSCAN()
+                .setEps(self.getOrDefault(self.eps))
+                .setMinSamples(self.getOrDefault(self.minSamples))
+                .fit(x)
+            )
+            model = TpuDBSCANModel(core)
+            for p in ("featuresCol", "predictionCol"):
+                model._set(**{p: self.getOrDefault(getattr(self, p))})
+            return model
+
+    class TpuDBSCANModel(SparkModel, _TpuPredictorParams):
+        def __init__(self, core_model=None):
+            super().__init__()
+            self._setDefault(
+                featuresCol="features", labelCol="label", predictionCol="prediction"
+            )
+            self._core = core_model
+
+        @property
+        def labels_(self):
+            return self._core.labels_
+
+        def _transform(self, dataset):
+            from pyspark.ml.functions import vector_to_array
+            from pyspark.sql.functions import col
+
+            core = self._core
+            # Training rows must return the labels FIT assigned (border
+            # assignment is expansion-order-dependent; per-batch
+            # nearest-core re-prediction could relabel them). Identical
+            # rows share identical epsilon-graph adjacency, so a value
+            # lookup is exact for DBSCAN.
+            train = np.asarray(core.fitted, dtype=np.float64)
+            labels = np.asarray(core.labels_, dtype=np.float64)
+            lookup = {}
+            for i in range(train.shape[0]):
+                lookup.setdefault(train[i].tobytes(), i)
+
+            def assign(block):
+                block = np.asarray(block, dtype=np.float64)
+                hits = np.asarray(
+                    [lookup.get(row.tobytes(), -1) for row in block]
+                )
+                out = np.empty(block.shape[0])
+                if np.any(hits >= 0):
+                    out[hits >= 0] = labels[hits[hits >= 0]]
+                new = hits < 0
+                if np.any(new):
+                    out[new] = np.asarray(
+                        core.transform(block[new]), dtype=np.float64
+                    )
+                return out
+
+            return dataset.withColumn(
+                self.getOrDefault(self.predictionCol),
+                _prediction_udf(assign)(
+                    vector_to_array(col(self.getOrDefault(self.featuresCol)))
+                ),
+            )
+
+    class TpuUMAP(SparkEstimator, _TpuPredictorParams):
+        """Manifold embedding (the modern spark-rapids-ml UMAP): fit learns
+        the layout on the driver chip; transform appends the embedding
+        array column — training rows return their fitted coordinates, new
+        rows embed against the frozen training layout."""
+
+        nNeighbors = Param(Params._dummy(), "nNeighbors", "neighborhood size", TypeConverters.toInt)
+        nComponents = Param(Params._dummy(), "nComponents", "embedding dimension", TypeConverters.toInt)
+        nEpochs = Param(Params._dummy(), "nEpochs", "optimization epochs (0 = auto)", TypeConverters.toInt)
+        seed = Param(Params._dummy(), "seed", "random seed", TypeConverters.toInt)
+        outputCol = Param(Params._dummy(), "outputCol", "embedding column", TypeConverters.toString)
+
+        def __init__(self, featuresCol="features", outputCol="embedding"):
+            super().__init__()
+            self._setDefault(
+                nNeighbors=15, nComponents=2, nEpochs=0, seed=0,
+                featuresCol="features", labelCol="label",
+                predictionCol="prediction", outputCol="embedding",
+            )
+            self._set(featuresCol=featuresCol, outputCol=outputCol)
+
+        def setNNeighbors(self, value):
+            return self._set(nNeighbors=value)
+
+        def setNComponents(self, value):
+            return self._set(nComponents=value)
+
+        def setNEpochs(self, value):
+            return self._set(nEpochs=value)
+
+        def setSeed(self, value):
+            return self._set(seed=value)
+
+        def setOutputCol(self, value):
+            return self._set(outputCol=value)
+
+        def _fit(self, dataset):
+            from spark_rapids_ml_tpu.manifold import UMAP
+
+            core = (
+                UMAP()
+                .setNNeighbors(self.getOrDefault(self.nNeighbors))
+                .setNComponents(self.getOrDefault(self.nComponents))
+                .setNEpochs(self.getOrDefault(self.nEpochs))
+                .setSeed(self.getOrDefault(self.seed))
+                .fit(_collect_features(dataset, self.getOrDefault(self.featuresCol)))
+            )
+            model = TpuUMAPModel(core)
+            model._set(
+                featuresCol=self.getOrDefault(self.featuresCol),
+                outputCol=self.getOrDefault(self.outputCol),
+            )
+            return model
+
+    class TpuUMAPModel(SparkModel, _TpuPredictorParams):
+        outputCol = TpuUMAP.outputCol
+
+        def __init__(self, core_model=None):
+            super().__init__()
+            self._setDefault(
+                featuresCol="features", labelCol="label",
+                predictionCol="prediction", outputCol="embedding",
+            )
+            self._core = core_model
+
+        @property
+        def embedding(self):
+            return self._core.embedding
+
+        def _transform(self, dataset):
+            from pyspark.ml.functions import array_to_vector, vector_to_array
+            from pyspark.sql.functions import col, pandas_udf
+
+            core = self._core
+            # Training rows must return their FITTED coordinates (the
+            # fit_transform semantics of the reference) even though Arrow
+            # batches slice the dataset below the core model's whole-array
+            # shortcut: index the training rows by value once.
+            train = np.asarray(core.trainData, dtype=np.float64)
+            fitted = np.asarray(core.embedding, dtype=np.float64)
+            # Duplicate feature rows resolve to the FIRST occurrence's
+            # fitted coordinates (value lookup cannot distinguish them).
+            lookup = {}
+            for i in range(train.shape[0]):
+                lookup.setdefault(train[i].tobytes(), i)
+
+            @pandas_udf("array<double>")
+            def embed(series):
+                import pandas as pd
+
+                if len(series) == 0:
+                    return pd.Series([], dtype=object)
+                block = np.stack(
+                    [np.asarray(v, dtype=np.float64) for v in series]
+                )
+                hits = np.asarray(
+                    [lookup.get(row.tobytes(), -1) for row in block]
+                )
+                out = np.empty((block.shape[0], fitted.shape[1]))
+                if np.any(hits >= 0):
+                    out[hits >= 0] = fitted[hits[hits >= 0]]
+                new = hits < 0
+                if np.any(new):
+                    out[new] = np.asarray(
+                        core.transform(block[new]), dtype=np.float64
+                    )
+                return pd.Series(list(out))
+
+            return dataset.withColumn(
+                self.getOrDefault(self.outputCol),
+                array_to_vector(
+                    embed(vector_to_array(col(self.getOrDefault(self.featuresCol))))
+                ),
+            )
 
     class TpuRandomForestRegressor(SparkEstimator, _TpuPredictorParams):
         numTrees = Param(Params._dummy(), "numTrees", "number of trees", TypeConverters.toInt)

@@ -425,3 +425,45 @@ class TestNeighborsAdapters:
         idx = np.stack([np.asarray(r.indices) for r in out])
         assert idx.dtype.kind in "iu" or np.all(idx == idx.astype(int))
         np.testing.assert_array_equal(idx[:, 0].astype(int), np.arange(10))
+
+
+class TestTpuDBSCANAndUMAP:
+    def test_dbscan(self, spark_env, rng):
+        adapter, spark = spark_env
+        x = np.concatenate(
+            [rng.normal(scale=0.2, size=(50, 3)) + c for c in ([0, 0, 0], [4, 4, 0])]
+            + [rng.uniform(-2, 6, size=(8, 3))]
+        )
+        df = _vector_df(spark, x)
+        model = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
+        preds = np.asarray(
+            [r.prediction for r in model.transform(df).collect()]
+        ).astype(int)
+        # Two dense blobs become two clusters; blob labels are uniform.
+        assert len(set(preds[:50])) == 1 and len(set(preds[50:100])) == 1
+        assert preds[0] != preds[50]
+        np.testing.assert_array_equal(preds, model.labels_)
+
+    def test_umap(self, spark_env, rng):
+        adapter, spark = spark_env
+        x = np.concatenate(
+            [rng.normal(size=(40, 6)) + off for off in (0.0, 12.0)]
+        )
+        df = _vector_df(spark, x)
+        model = (
+            adapter.TpuUMAP()
+            .setNNeighbors(8)
+            .setNEpochs(200)
+            .setSeed(0)
+            .fit(df)
+        )
+        rows = model.transform(df).collect()
+        emb = np.stack([np.asarray(r.embedding.toArray()) for r in rows])
+        assert emb.shape == (80, 2)
+        labels = np.repeat([0, 1], 40)
+        c0, c1 = emb[labels == 0].mean(0), emb[labels == 1].mean(0)
+        spread = np.mean(np.linalg.norm(emb[labels == 0] - c0, axis=1)) + 1e-9
+        assert np.linalg.norm(c0 - c1) / spread > 2.0
+        # Training rows return their FITTED coordinates exactly
+        # (fit_transform semantics through per-partition Arrow batches).
+        np.testing.assert_allclose(emb, model.embedding, atol=1e-12)
