@@ -63,8 +63,14 @@ def main() -> None:
 
     # Amortized sync: the tunnel's scalar-readback round trip (~tens of ms)
     # is paid once per batch of queued executions, not once per run, so the
-    # number measures the device, not the relay.
-    elapsed = time_amortized(lambda: fit(x)[1], lambda ev: float(ev[0]), inner=5)
+    # number measures the device, not the relay. Two measurement rounds,
+    # best-of (standard min-time practice): the relay occasionally stalls
+    # for seconds, and a single round would record the stall as the
+    # framework's throughput.
+    elapsed = min(
+        time_amortized(lambda: fit(x)[1], lambda ev: float(ev[0]), inner=5)
+        for _ in range(2)
+    )
     rows_per_sec = N_ROWS / elapsed
 
     # WHOLE-FIT MFU accounting, denominated in the covariance GEMM's

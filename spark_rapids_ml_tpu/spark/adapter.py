@@ -314,6 +314,38 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             [np.asarray(r[col].toArray(), dtype=np.float64) for r in chunk]
         )
 
+    def _fitted_or_transform(train, fitted_values, transform_fn):
+        """Return ``apply(block)`` mapping EXACT training rows to their
+        fitted outputs (labels / coordinates) and everything else through
+        ``transform_fn``. Hashing happens at the TRAIN dtype on both sides
+        — core models may store f32 (no-x64 platforms), and hashing the
+        incoming f64 rows directly would never match. Duplicate training
+        rows resolve to the first occurrence."""
+        train = np.ascontiguousarray(train)
+        fitted_values = np.asarray(fitted_values, dtype=np.float64)
+        lookup = {}
+        for i in range(train.shape[0]):
+            lookup.setdefault(train[i].tobytes(), i)
+
+        def apply(block):
+            block = np.asarray(block, dtype=np.float64)
+            q = np.ascontiguousarray(block.astype(train.dtype))
+            hits = np.asarray([lookup.get(row.tobytes(), -1) for row in q])
+            shape = (
+                (block.shape[0],)
+                if fitted_values.ndim == 1
+                else (block.shape[0], fitted_values.shape[1])
+            )
+            out = np.empty(shape)
+            if np.any(hits >= 0):
+                out[hits >= 0] = fitted_values[hits[hits >= 0]]
+            new = hits < 0
+            if np.any(new):
+                out[new] = np.asarray(transform_fn(block[new]), dtype=np.float64)
+            return out
+
+        return apply
+
     def _sq_dists(x: np.ndarray, centers: np.ndarray) -> np.ndarray:
         """(n, k) squared distances via ||x||^2 - 2 x c^T + ||c||^2: one
         (n, d) x (d, k) matmul, no (n, k, d) intermediate (the memory
@@ -1243,13 +1275,14 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 model._set(**{p: self.getOrDefault(getattr(self, p))})
             return model
 
-    class TpuDBSCANModel(SparkModel, _TpuPredictorParams):
+    class TpuDBSCANModel(SparkModel, _TpuPredictorParams, MLReadable):
         def __init__(self, core_model=None):
             super().__init__()
             self._setDefault(
                 featuresCol="features", labelCol="label", predictionCol="prediction"
             )
             self._core = core_model
+            self._apply = None  # built once; reused across transform calls
 
         @property
         def labels_(self):
@@ -1259,39 +1292,42 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             from pyspark.ml.functions import vector_to_array
             from pyspark.sql.functions import col
 
-            core = self._core
-            # Training rows must return the labels FIT assigned (border
-            # assignment is expansion-order-dependent; per-batch
-            # nearest-core re-prediction could relabel them). Identical
-            # rows share identical epsilon-graph adjacency, so a value
-            # lookup is exact for DBSCAN.
-            train = np.asarray(core.fitted, dtype=np.float64)
-            labels = np.asarray(core.labels_, dtype=np.float64)
-            lookup = {}
-            for i in range(train.shape[0]):
-                lookup.setdefault(train[i].tobytes(), i)
-
-            def assign(block):
-                block = np.asarray(block, dtype=np.float64)
-                hits = np.asarray(
-                    [lookup.get(row.tobytes(), -1) for row in block]
+            if self._apply is None:
+                # Training rows must return the labels FIT assigned
+                # (border assignment is expansion-order-dependent;
+                # per-batch nearest-core re-prediction could relabel
+                # them). Identical rows share identical epsilon-graph
+                # adjacency, so a value lookup is exact for DBSCAN.
+                self._apply = _fitted_or_transform(
+                    np.asarray(self._core.fitted),
+                    np.asarray(self._core.labels_, dtype=np.float64),
+                    self._core.transform,
                 )
-                out = np.empty(block.shape[0])
-                if np.any(hits >= 0):
-                    out[hits >= 0] = labels[hits[hits >= 0]]
-                new = hits < 0
-                if np.any(new):
-                    out[new] = np.asarray(
-                        core.transform(block[new]), dtype=np.float64
-                    )
-                return out
-
             return dataset.withColumn(
                 self.getOrDefault(self.predictionCol),
-                _prediction_udf(assign)(
+                _prediction_udf(self._apply)(
                     vector_to_array(col(self.getOrDefault(self.featuresCol)))
                 ),
             )
+
+        def _save_impl(self, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name="TpuDBSCANModel")
+            self._core.save(_os.path.join(path, "core"))
+
+        @classmethod
+        def load(cls, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+            from spark_rapids_ml_tpu.models.dbscan import DBSCANModel
+
+            metadata = P.load_metadata(path, expected_class="TpuDBSCANModel")
+            model = cls(DBSCANModel.load(_os.path.join(path, "core")))
+            return _set_params_from_metadata(model, metadata)
 
     class TpuUMAP(SparkEstimator, _TpuPredictorParams):
         """Manifold embedding (the modern spark-rapids-ml UMAP): fit learns
@@ -1347,7 +1383,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             )
             return model
 
-    class TpuUMAPModel(SparkModel, _TpuPredictorParams):
+    class TpuUMAPModel(SparkModel, _TpuPredictorParams, MLReadable):
         outputCol = TpuUMAP.outputCol
 
         def __init__(self, core_model=None):
@@ -1357,6 +1393,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 predictionCol="prediction", outputCol="embedding",
             )
             self._core = core_model
+            self._apply = None  # built once; reused across transform calls
 
         @property
         def embedding(self):
@@ -1366,18 +1403,17 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             from pyspark.ml.functions import array_to_vector, vector_to_array
             from pyspark.sql.functions import col, pandas_udf
 
-            core = self._core
-            # Training rows must return their FITTED coordinates (the
-            # fit_transform semantics of the reference) even though Arrow
-            # batches slice the dataset below the core model's whole-array
-            # shortcut: index the training rows by value once.
-            train = np.asarray(core.trainData, dtype=np.float64)
-            fitted = np.asarray(core.embedding, dtype=np.float64)
-            # Duplicate feature rows resolve to the FIRST occurrence's
-            # fitted coordinates (value lookup cannot distinguish them).
-            lookup = {}
-            for i in range(train.shape[0]):
-                lookup.setdefault(train[i].tobytes(), i)
+            if self._apply is None:
+                # Training rows return their FITTED coordinates (the
+                # fit_transform semantics of the reference) even though
+                # Arrow batches slice the dataset below the core model's
+                # whole-array shortcut.
+                self._apply = _fitted_or_transform(
+                    np.asarray(self._core.trainData),
+                    np.asarray(self._core.embedding, dtype=np.float64),
+                    self._core.transform,
+                )
+            apply = self._apply
 
             @pandas_udf("array<double>")
             def embed(series):
@@ -1388,18 +1424,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 block = np.stack(
                     [np.asarray(v, dtype=np.float64) for v in series]
                 )
-                hits = np.asarray(
-                    [lookup.get(row.tobytes(), -1) for row in block]
-                )
-                out = np.empty((block.shape[0], fitted.shape[1]))
-                if np.any(hits >= 0):
-                    out[hits >= 0] = fitted[hits[hits >= 0]]
-                new = hits < 0
-                if np.any(new):
-                    out[new] = np.asarray(
-                        core.transform(block[new]), dtype=np.float64
-                    )
-                return pd.Series(list(out))
+                return pd.Series(list(apply(block)))
 
             return dataset.withColumn(
                 self.getOrDefault(self.outputCol),
@@ -1407,6 +1432,25 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                     embed(vector_to_array(col(self.getOrDefault(self.featuresCol))))
                 ),
             )
+
+        def _save_impl(self, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name="TpuUMAPModel")
+            self._core.save(_os.path.join(path, "core"))
+
+        @classmethod
+        def load(cls, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+            from spark_rapids_ml_tpu.models.umap import UMAPModel
+
+            metadata = P.load_metadata(path, expected_class="TpuUMAPModel")
+            model = cls(UMAPModel.load(_os.path.join(path, "core")))
+            return _set_params_from_metadata(model, metadata)
 
     class TpuRandomForestRegressor(SparkEstimator, _TpuPredictorParams):
         numTrees = Param(Params._dummy(), "numTrees", "number of trees", TypeConverters.toInt)

@@ -467,3 +467,39 @@ class TestTpuDBSCANAndUMAP:
         # Training rows return their FITTED coordinates exactly
         # (fit_transform semantics through per-partition Arrow batches).
         np.testing.assert_allclose(emb, model.embedding, atol=1e-12)
+
+    def test_dbscan_umap_persistence(self, spark_env, rng, tmp_path):
+        adapter, spark = spark_env
+        x = np.concatenate(
+            [rng.normal(scale=0.2, size=(40, 3)) + c for c in ([0, 0, 0], [4, 4, 0])]
+        )
+        df = _vector_df(spark, x)
+        db = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
+        p1 = str(tmp_path / "dbscan")
+        db._save_impl(p1)
+        loaded = adapter.TpuDBSCANModel.load(p1)
+        np.testing.assert_array_equal(loaded.labels_, db.labels_)
+        preds = np.asarray([r.prediction for r in loaded.transform(df).collect()])
+        np.testing.assert_array_equal(preds, db.labels_)
+
+        um = adapter.TpuUMAP().setNNeighbors(8).setNEpochs(50).setSeed(0).fit(df)
+        p2 = str(tmp_path / "umap")
+        um._save_impl(p2)
+        lu = adapter.TpuUMAPModel.load(p2)
+        np.testing.assert_allclose(lu.embedding, um.embedding)
+
+    def test_dbscan_lookup_matches_f32_core_storage(self, spark_env, rng, monkeypatch):
+        """The fitted-row lookup hashes at the CORE dtype: a core model
+        storing f32 (no-x64 platforms) must still match incoming f64 rows
+        (r2 review — with x64 on in tests, simulate by downcasting)."""
+        adapter, spark = spark_env
+        x = np.concatenate(
+            [rng.normal(scale=0.2, size=(30, 3)) + c for c in ([0, 0, 0], [4, 4, 0])]
+        )
+        df = _vector_df(spark, x)
+        model = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
+        # Force the f32 storage a no-x64 platform would produce.
+        model._core.fitted = model._core.fitted.astype(np.float32)
+        model._apply = None
+        preds = np.asarray([r.prediction for r in model.transform(df).collect()])
+        np.testing.assert_array_equal(preds, model.labels_)
