@@ -241,6 +241,53 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
     # Shared adapter plumbing for the non-PCA families
     # ------------------------------------------------------------------
 
+    class _TpuEstimatorPersistence(MLReadable):
+        """Estimator save/load (DefaultParamsWritable parity): metadata
+        JSON holds the params; load restores them by name onto a fresh
+        instance of the concrete class."""
+
+        def _save_impl(self, path):
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name=type(self).__name__)
+
+        @classmethod
+        def load(cls, path):
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            metadata = P.load_metadata(path, expected_class=cls.__name__)
+            est = _set_params_from_metadata(cls(), metadata)
+            est.uid = metadata["uid"]  # DefaultParamsReader restores uid
+            return est
+
+    class _TpuCoreModelPersistence(MLReadable):
+        """Model save/load for adapters that WRAP a core model: metadata
+        at the root, the core model under <path>/core. Subclasses set
+        ``_core_class`` to a zero-arg callable returning the core model
+        class (lazy import keeps executors jax-free)."""
+
+        _core_class = None
+
+        def _save_impl(self, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name=type(self).__name__)
+            self._core.save(_os.path.join(path, "core"))
+
+        @classmethod
+        def load(cls, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            metadata = P.load_metadata(path, expected_class=cls.__name__)
+            core = cls._core_class().load(_os.path.join(path, "core"))
+            model = _set_params_from_metadata(cls(core), metadata)
+            model.uid = metadata["uid"]
+            return model
+
     def _set_params_from_metadata(obj, metadata):
         """Restore pyspark Param values by name from core metadata JSON."""
         for source in (metadata.get("defaultParamMap", {}), metadata.get("paramMap", {})):
@@ -314,37 +361,41 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             [np.asarray(r[col].toArray(), dtype=np.float64) for r in chunk]
         )
 
-    def _fitted_or_transform(train, fitted_values, transform_fn):
-        """Return ``apply(block)`` mapping EXACT training rows to their
-        fitted outputs (labels / coordinates) and everything else through
-        ``transform_fn``. Hashing happens at the TRAIN dtype on both sides
-        — core models may store f32 (no-x64 platforms), and hashing the
-        incoming f64 rows directly would never match. Duplicate training
-        rows resolve to the first occurrence."""
-        train = np.ascontiguousarray(train)
-        fitted_values = np.asarray(fitted_values, dtype=np.float64)
-        lookup = {}
-        for i in range(train.shape[0]):
-            lookup.setdefault(train[i].tobytes(), i)
+    class _FittedOrTransform:
+        """Callable mapping EXACT training rows to their fitted outputs
+        (labels / coordinates) and everything else through the core
+        model's transform. Hashing happens at the TRAIN dtype on both
+        sides — core models may store f32 (no-x64 platforms), and hashing
+        the incoming f64 rows directly would never match. Duplicate
+        training rows resolve to the first occurrence. A plain class (not
+        a closure) so models stay picklable after caching one."""
 
-        def apply(block):
+        def __init__(self, train, fitted_values, transform_fn):
+            self.train = np.ascontiguousarray(train)
+            self.fitted = np.asarray(fitted_values, dtype=np.float64)
+            self.transform_fn = transform_fn
+            self.lookup = {}
+            for i in range(self.train.shape[0]):
+                self.lookup.setdefault(self.train[i].tobytes(), i)
+
+        def __call__(self, block):
             block = np.asarray(block, dtype=np.float64)
-            q = np.ascontiguousarray(block.astype(train.dtype))
-            hits = np.asarray([lookup.get(row.tobytes(), -1) for row in q])
+            q = np.ascontiguousarray(block.astype(self.train.dtype, copy=False))
+            hits = np.asarray([self.lookup.get(row.tobytes(), -1) for row in q])
             shape = (
                 (block.shape[0],)
-                if fitted_values.ndim == 1
-                else (block.shape[0], fitted_values.shape[1])
+                if self.fitted.ndim == 1
+                else (block.shape[0], self.fitted.shape[1])
             )
             out = np.empty(shape)
             if np.any(hits >= 0):
-                out[hits >= 0] = fitted_values[hits[hits >= 0]]
+                out[hits >= 0] = self.fitted[hits[hits >= 0]]
             new = hits < 0
             if np.any(new):
-                out[new] = np.asarray(transform_fn(block[new]), dtype=np.float64)
+                out[new] = np.asarray(
+                    self.transform_fn(block[new]), dtype=np.float64
+                )
             return out
-
-        return apply
 
     def _sq_dists(x: np.ndarray, centers: np.ndarray) -> np.ndarray:
         """(n, k) squared distances via ||x||^2 - 2 x c^T + ||c||^2: one
@@ -375,7 +426,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
     # KMeans — genuinely distributed Lloyd iterations over the RDD
     # ------------------------------------------------------------------
 
-    class TpuKMeans(SparkEstimator, _TpuPredictorParams):
+    class TpuKMeans(SparkEstimator, _TpuPredictorParams, _TpuEstimatorPersistence):
         """Distributed k-means: per-iteration partition-local assignment
         stats (numpy on executors) merged via treeReduce, centers updated
         on the driver — the mllib KMeans aggregation structure with this
@@ -530,7 +581,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
     # LinearRegression — distributed normal-equation moments + fp64 solve
     # ------------------------------------------------------------------
 
-    class TpuLinearRegression(SparkEstimator, _TpuPredictorParams):
+    class TpuLinearRegression(SparkEstimator, _TpuPredictorParams, _TpuEstimatorPersistence):
         """Distributed least squares: executors accumulate the [X|y]
         shifted second moments (numpy, picklable), treeReduce merges, the
         driver solves the normal equations in fp64
@@ -749,7 +800,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
 
         return _apply
 
-    class TpuLogisticRegression(SparkEstimator, _TpuProbabilisticParams):
+    class TpuLogisticRegression(SparkEstimator, _TpuProbabilisticParams, _TpuEstimatorPersistence):
         maxIter = Param(Params._dummy(), "maxIter", "max iterations", TypeConverters.toInt)
         regParam = Param(Params._dummy(), "regParam", "regularization", TypeConverters.toFloat)
         elasticNetParam = Param(Params._dummy(), "elasticNetParam", "L1/L2 mixing", TypeConverters.toFloat)
@@ -913,7 +964,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             )
             return self._wrap(core)
 
-    class TpuLogisticRegressionModel(SparkModel, _TpuProbabilisticParams, MLReadable):
+    class TpuLogisticRegressionModel(SparkModel, _TpuProbabilisticParams, _TpuCoreModelPersistence):
         def __init__(self, core_model=None):
             super().__init__()
             self._setDefault(
@@ -946,28 +997,13 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             )
             return _classifier_transform(forward, self._core.numClasses, self)(dataset)
 
-        def _save_impl(self, path):
-            import os as _os
+        @staticmethod
+        def _core_class():
+            from spark_rapids_ml_tpu.models.logistic_regression import LogisticRegressionModel
 
-            from spark_rapids_ml_tpu.core import persistence as P
+            return LogisticRegressionModel
 
-            P.save_metadata(self, path, class_name="TpuLogisticRegressionModel")
-            self._core.save(_os.path.join(path, "core"))
-
-        @classmethod
-        def load(cls, path):
-            import os as _os
-
-            from spark_rapids_ml_tpu.core import persistence as P
-            from spark_rapids_ml_tpu.models.logistic_regression import (
-                LogisticRegressionModel,
-            )
-
-            metadata = P.load_metadata(path, expected_class="TpuLogisticRegressionModel")
-            model = cls(LogisticRegressionModel.load(_os.path.join(path, "core")))
-            return _set_params_from_metadata(model, metadata)
-
-    class TpuRandomForestClassifier(SparkEstimator, _TpuProbabilisticParams):
+    class TpuRandomForestClassifier(SparkEstimator, _TpuProbabilisticParams, _TpuEstimatorPersistence):
         numTrees = Param(Params._dummy(), "numTrees", "number of trees", TypeConverters.toInt)
         maxDepth = Param(Params._dummy(), "maxDepth", "max tree depth", TypeConverters.toInt)
         maxBins = Param(Params._dummy(), "maxBins", "max feature bins", TypeConverters.toInt)
@@ -1021,7 +1057,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 model._set(**{p: self.getOrDefault(getattr(self, p))})
             return model
 
-    class TpuRandomForestClassificationModel(SparkModel, _TpuProbabilisticParams, MLReadable):
+    class TpuRandomForestClassificationModel(SparkModel, _TpuProbabilisticParams, _TpuCoreModelPersistence):
         def __init__(self, core_model=None):
             super().__init__()
             self._setDefault(
@@ -1052,32 +1088,13 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             )
             return _classifier_transform(forward, self._core.numClasses, self)(dataset)
 
-        def _save_impl(self, path):
-            import os as _os
+        @staticmethod
+        def _core_class():
+            from spark_rapids_ml_tpu.models.random_forest import RandomForestClassificationModel
 
-            from spark_rapids_ml_tpu.core import persistence as P
+            return RandomForestClassificationModel
 
-            P.save_metadata(self, path, class_name="TpuRandomForestClassificationModel")
-            self._core.save(_os.path.join(path, "core"))
-
-        @classmethod
-        def load(cls, path):
-            import os as _os
-
-            from spark_rapids_ml_tpu.core import persistence as P
-            from spark_rapids_ml_tpu.models.random_forest import (
-                RandomForestClassificationModel,
-            )
-
-            metadata = P.load_metadata(
-                path, expected_class="TpuRandomForestClassificationModel"
-            )
-            model = cls(
-                RandomForestClassificationModel.load(_os.path.join(path, "core"))
-            )
-            return _set_params_from_metadata(model, metadata)
-
-    class _TpuNeighborsBase(SparkEstimator, _TpuPredictorParams):
+    class _TpuNeighborsBase(SparkEstimator, _TpuPredictorParams, _TpuEstimatorPersistence):
         """Shared surface of the neighbor estimators: fit collects the item
         vectors to the driver chip (the modern spark-rapids-ml deployment
         shape for its no-Spark-ML-equivalent families), and the model's
@@ -1237,7 +1254,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
     class TpuApproximateNearestNeighborsModel(_TpuNeighborsModelBase):
         pass
 
-    class TpuDBSCAN(SparkEstimator, _TpuPredictorParams):
+    class TpuDBSCAN(SparkEstimator, _TpuPredictorParams, _TpuEstimatorPersistence):
         """Density clustering (the modern spark-rapids-ml DBSCAN): fit
         computes labels for the TRAINING rows on the driver chip; the
         returned model's transform appends the cluster label column
@@ -1275,14 +1292,15 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 model._set(**{p: self.getOrDefault(getattr(self, p))})
             return model
 
-    class TpuDBSCANModel(SparkModel, _TpuPredictorParams, MLReadable):
+    class TpuDBSCANModel(SparkModel, _TpuPredictorParams, _TpuCoreModelPersistence):
         def __init__(self, core_model=None):
             super().__init__()
             self._setDefault(
                 featuresCol="features", labelCol="label", predictionCol="prediction"
             )
             self._core = core_model
-            self._apply = None  # built once; reused across transform calls
+            # (core, callable): rebuilt if _core is ever replaced.
+            self._apply = None
 
         @property
         def labels_(self):
@@ -1292,44 +1310,34 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             from pyspark.ml.functions import vector_to_array
             from pyspark.sql.functions import col
 
-            if self._apply is None:
+            if self._apply is None or self._apply[0] is not self._core:
                 # Training rows must return the labels FIT assigned
                 # (border assignment is expansion-order-dependent;
                 # per-batch nearest-core re-prediction could relabel
                 # them). Identical rows share identical epsilon-graph
                 # adjacency, so a value lookup is exact for DBSCAN.
-                self._apply = _fitted_or_transform(
-                    np.asarray(self._core.fitted),
-                    np.asarray(self._core.labels_, dtype=np.float64),
-                    self._core.transform,
+                self._apply = (
+                    self._core,
+                    _FittedOrTransform(
+                        np.asarray(self._core.fitted),
+                        np.asarray(self._core.labels_, dtype=np.float64),
+                        self._core.transform,
+                    ),
                 )
             return dataset.withColumn(
                 self.getOrDefault(self.predictionCol),
-                _prediction_udf(self._apply)(
+                _prediction_udf(self._apply[1])(
                     vector_to_array(col(self.getOrDefault(self.featuresCol)))
                 ),
             )
 
-        def _save_impl(self, path):
-            import os as _os
-
-            from spark_rapids_ml_tpu.core import persistence as P
-
-            P.save_metadata(self, path, class_name="TpuDBSCANModel")
-            self._core.save(_os.path.join(path, "core"))
-
-        @classmethod
-        def load(cls, path):
-            import os as _os
-
-            from spark_rapids_ml_tpu.core import persistence as P
+        @staticmethod
+        def _core_class():
             from spark_rapids_ml_tpu.models.dbscan import DBSCANModel
 
-            metadata = P.load_metadata(path, expected_class="TpuDBSCANModel")
-            model = cls(DBSCANModel.load(_os.path.join(path, "core")))
-            return _set_params_from_metadata(model, metadata)
+            return DBSCANModel
 
-    class TpuUMAP(SparkEstimator, _TpuPredictorParams):
+    class TpuUMAP(SparkEstimator, _TpuPredictorParams, _TpuEstimatorPersistence):
         """Manifold embedding (the modern spark-rapids-ml UMAP): fit learns
         the layout on the driver chip; transform appends the embedding
         array column — training rows return their fitted coordinates, new
@@ -1383,7 +1391,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             )
             return model
 
-    class TpuUMAPModel(SparkModel, _TpuPredictorParams, MLReadable):
+    class TpuUMAPModel(SparkModel, _TpuPredictorParams, _TpuCoreModelPersistence):
         outputCol = TpuUMAP.outputCol
 
         def __init__(self, core_model=None):
@@ -1393,7 +1401,8 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 predictionCol="prediction", outputCol="embedding",
             )
             self._core = core_model
-            self._apply = None  # built once; reused across transform calls
+            # (core, callable): rebuilt if _core is ever replaced.
+            self._apply = None
 
         @property
         def embedding(self):
@@ -1403,17 +1412,20 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             from pyspark.ml.functions import array_to_vector, vector_to_array
             from pyspark.sql.functions import col, pandas_udf
 
-            if self._apply is None:
+            if self._apply is None or self._apply[0] is not self._core:
                 # Training rows return their FITTED coordinates (the
                 # fit_transform semantics of the reference) even though
                 # Arrow batches slice the dataset below the core model's
                 # whole-array shortcut.
-                self._apply = _fitted_or_transform(
-                    np.asarray(self._core.trainData),
-                    np.asarray(self._core.embedding, dtype=np.float64),
-                    self._core.transform,
+                self._apply = (
+                    self._core,
+                    _FittedOrTransform(
+                        np.asarray(self._core.trainData),
+                        np.asarray(self._core.embedding, dtype=np.float64),
+                        self._core.transform,
+                    ),
                 )
-            apply = self._apply
+            apply = self._apply[1]
 
             @pandas_udf("array<double>")
             def embed(series):
@@ -1433,26 +1445,13 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 ),
             )
 
-        def _save_impl(self, path):
-            import os as _os
-
-            from spark_rapids_ml_tpu.core import persistence as P
-
-            P.save_metadata(self, path, class_name="TpuUMAPModel")
-            self._core.save(_os.path.join(path, "core"))
-
-        @classmethod
-        def load(cls, path):
-            import os as _os
-
-            from spark_rapids_ml_tpu.core import persistence as P
+        @staticmethod
+        def _core_class():
             from spark_rapids_ml_tpu.models.umap import UMAPModel
 
-            metadata = P.load_metadata(path, expected_class="TpuUMAPModel")
-            model = cls(UMAPModel.load(_os.path.join(path, "core")))
-            return _set_params_from_metadata(model, metadata)
+            return UMAPModel
 
-    class TpuRandomForestRegressor(SparkEstimator, _TpuPredictorParams):
+    class TpuRandomForestRegressor(SparkEstimator, _TpuPredictorParams, _TpuEstimatorPersistence):
         numTrees = Param(Params._dummy(), "numTrees", "number of trees", TypeConverters.toInt)
         maxDepth = Param(Params._dummy(), "maxDepth", "max tree depth", TypeConverters.toInt)
         maxBins = Param(Params._dummy(), "maxBins", "max feature bins", TypeConverters.toInt)
@@ -1500,7 +1499,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 model._set(**{p: self.getOrDefault(getattr(self, p))})
             return model
 
-    class TpuRandomForestRegressionModel(SparkModel, _TpuPredictorParams, MLReadable):
+    class TpuRandomForestRegressionModel(SparkModel, _TpuPredictorParams, _TpuCoreModelPersistence):
         def __init__(self, core_model=None):
             super().__init__()
             self._setDefault(
@@ -1533,27 +1532,8 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 ),
             )
 
-        def _save_impl(self, path):
-            import os as _os
+        @staticmethod
+        def _core_class():
+            from spark_rapids_ml_tpu.models.random_forest import RandomForestRegressionModel
 
-            from spark_rapids_ml_tpu.core import persistence as P
-
-            P.save_metadata(self, path, class_name="TpuRandomForestRegressionModel")
-            self._core.save(_os.path.join(path, "core"))
-
-        @classmethod
-        def load(cls, path):
-            import os as _os
-
-            from spark_rapids_ml_tpu.core import persistence as P
-            from spark_rapids_ml_tpu.models.random_forest import (
-                RandomForestRegressionModel,
-            )
-
-            metadata = P.load_metadata(
-                path, expected_class="TpuRandomForestRegressionModel"
-            )
-            model = cls(
-                RandomForestRegressionModel.load(_os.path.join(path, "core"))
-            )
-            return _set_params_from_metadata(model, metadata)
+            return RandomForestRegressionModel

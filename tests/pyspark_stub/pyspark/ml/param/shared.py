@@ -43,6 +43,19 @@ class Param:
         self.doc = doc
         self.typeConverter = typeConverter or TypeConverters.identity
 
+    # Value semantics like real pyspark (param.py __eq__/__hash__ on
+    # str(parent) + name): maps keyed by Param must survive pickling,
+    # where keys are recreated as new objects.
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Param)
+            and self.parent == other.parent
+            and self.name == other.name
+        )
+
+    def __hash__(self) -> int:
+        return hash(f"{self.parent}__{self.name}")
+
     def __repr__(self) -> str:
         return f"Param({self.parent}__{self.name})"
 

@@ -499,7 +499,63 @@ class TestTpuDBSCANAndUMAP:
         df = _vector_df(spark, x)
         model = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
         # Force the f32 storage a no-x64 platform would produce.
-        model._core.fitted = model._core.fitted.astype(np.float32)
-        model._apply = None
+        from spark_rapids_ml_tpu.models.dbscan import DBSCANModel
+
+        # Swap in a core with f32 storage; the cache keys on core identity
+        # so no manual reset is needed (r2 review).
+        model._core = DBSCANModel(
+            None,
+            model._core.fitted.astype(np.float32),
+            model._core.labels_,
+            model._core.core_mask_,
+        )
         preds = np.asarray([r.prediction for r in model.transform(df).collect()])
         np.testing.assert_array_equal(preds, model.labels_)
+
+
+class TestEstimatorPersistence:
+    def test_every_estimator_roundtrips(self, spark_env, tmp_path):
+        """Nine estimator classes round-trip their params here (the
+        DefaultParamsWritable contract); TpuPCA's round-trip is covered by
+        TestTpuPCA.test_estimator_persistence — ten families total."""
+        adapter, spark = spark_env
+        cases = [
+            (adapter.TpuKMeans(k=4).setSeed(7), "k", 4),
+            (adapter.TpuLinearRegression().setRegParam(0.5), "regParam", 0.5),
+            (adapter.TpuLogisticRegression().setMaxIter(33), "maxIter", 33),
+            (adapter.TpuRandomForestClassifier().setNumTrees(9), "numTrees", 9),
+            (adapter.TpuRandomForestRegressor().setMaxDepth(7), "maxDepth", 7),
+            (adapter.TpuDBSCAN().setEps(0.9), "eps", 0.9),
+            (adapter.TpuUMAP().setNNeighbors(11), "nNeighbors", 11),
+            (adapter.TpuNearestNeighbors(k=6), "k", 6),
+            (adapter.TpuApproximateNearestNeighbors(k=7), "k", 7),
+        ]
+        for i, (est, pname, expected) in enumerate(cases):
+            path = str(tmp_path / f"est_{i}")
+            est._save_impl(path)
+            loaded = type(est).load(path)
+            assert loaded.getOrDefault(loaded.getParam(pname)) == expected, type(est)
+
+    def test_model_picklable_after_transform(self, spark_env, rng):
+        """Caching the fitted-row lookup must not break model pickling
+        (Spark broadcasts models to executors) — r2 review."""
+        adapter, spark = spark_env
+        x = np.concatenate(
+            [rng.normal(scale=0.2, size=(30, 3)) + c for c in ([0, 0, 0], [4, 4, 0])]
+        )
+        df = _vector_df(spark, x)
+        model = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
+        model.transform(df).collect()  # builds + caches the lookup
+        import cloudpickle
+
+        clone = cloudpickle.loads(cloudpickle.dumps(model))
+        preds = np.asarray([r.prediction for r in clone.transform(df).collect()])
+        np.testing.assert_array_equal(preds, model.labels_)
+
+    def test_estimator_load_restores_uid(self, spark_env, tmp_path):
+        adapter, spark = spark_env
+        est = adapter.TpuKMeans(k=3)
+        path = str(tmp_path / "uid_est")
+        est._save_impl(path)
+        loaded = adapter.TpuKMeans.load(path)
+        assert loaded.uid == est.uid
