@@ -45,14 +45,19 @@ def validate_precision(value: str) -> str:
     return value
 
 
-def resolve_precision(requested: str, input_dtype=None, x64_enabled=None) -> str:
+def resolve_precision(
+    requested: str, input_dtype=None, x64_enabled=None, platform=None
+) -> str:
     """Resolve a user-facing precision request to a concrete mode.
 
     ``"auto"`` picks ``"dd"`` (double-float fp64 emulation,
-    ops.doubledouble) when the input carries fp64 data but the platform
-    cannot compute in fp64 (x64 disabled — the real-TPU case), matching the
-    reference's all-``double[]`` JNI numerics (JniRAPIDSML.java:64-69);
-    otherwise ``"highest"``. Explicit requests pass through unchanged.
+    ops.doubledouble) when the input carries fp64 data AND the compute
+    platform is an ACCELERATOR with x64 off — the real-TPU case, where
+    no native fp64 exists and emulation is the only route to the
+    reference's all-``double[]`` numerics (JniRAPIDSML.java:64-69). On
+    CPU the hardware does fp64 natively, so auto resolves "highest" and
+    the right fix for fp64 semantics is enabling x64, not paying 4-5x
+    for emulation. Explicit requests pass through unchanged.
     """
     if requested not in PRECISIONS:
         raise ValueError(
@@ -62,8 +67,12 @@ def resolve_precision(requested: str, input_dtype=None, x64_enabled=None) -> str
         return requested
     if x64_enabled is None:
         x64_enabled = bool(jax.config.jax_enable_x64)
+    if platform is None:
+        platform = jax.default_backend()
     wants_f64 = input_dtype is not None and np.dtype(input_dtype) == np.float64
-    return "dd" if (wants_f64 and not x64_enabled) else "highest"
+    return (
+        "dd" if (wants_f64 and not x64_enabled and platform != "cpu") else "highest"
+    )
 
 
 @partial(jax.jit, static_argnames=("precision",))

@@ -49,7 +49,69 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
     from spark_rapids_ml_tpu.core.persistence import MLReadable
     from spark_rapids_ml_tpu.spark.resources import resolve_device_ordinal
 
-    class TpuPCA(SparkEstimator, MLReadable):
+    class _TpuEstimatorPersistence(MLReadable):
+        """Estimator save/load (DefaultParamsWritable parity): metadata
+        JSON holds the params; load restores them by name onto a fresh
+        instance of the concrete class."""
+
+        def _save_impl(self, path):
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name=type(self).__name__)
+
+        @classmethod
+        def load(cls, path):
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            metadata = P.load_metadata(path, expected_class=cls.__name__)
+            est = _set_params_from_metadata(cls(), metadata)
+            est.uid = metadata["uid"]  # DefaultParamsReader restores uid
+            return est
+
+    class _TpuCoreModelPersistence(MLReadable):
+        """Model save/load for adapters that WRAP a core model: metadata
+        at the root, the core model under <path>/core. Subclasses set
+        ``_core_class`` to a zero-arg callable returning the core model
+        class (lazy import keeps executors jax-free)."""
+
+        _core_class = None
+
+        def _save_impl(self, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            P.save_metadata(self, path, class_name=type(self).__name__)
+            self._core.save(_os.path.join(path, "core"))
+
+        @classmethod
+        def load(cls, path):
+            import os as _os
+
+            from spark_rapids_ml_tpu.core import persistence as P
+
+            metadata = P.load_metadata(path, expected_class=cls.__name__)
+            core = cls._core_class().load(_os.path.join(path, "core"))
+            model = _set_params_from_metadata(cls(core), metadata)
+            model.uid = metadata["uid"]
+            return model
+
+    def _set_params_from_metadata(obj, metadata):
+        """Restore pyspark Param values by name from core metadata JSON —
+        defaults go back into the DEFAULT map (DefaultParamsReader
+        semantics: a load-save round trip must not migrate defaults into
+        paramMap or flip isSet())."""
+        for name, value in metadata.get("defaultParamMap", {}).items():
+            if obj.hasParam(name):
+                param = obj.getParam(name)
+                obj._defaultParamMap[param] = param.typeConverter(value)
+        for name, value in metadata.get("paramMap", {}).items():
+            if obj.hasParam(name):
+                obj._set(**{name: value})
+        return obj
+
+
+    class TpuPCA(SparkEstimator, _TpuEstimatorPersistence):
         """Drop-in PCA estimator: ``TpuPCA(k=3, inputCol="features")``.
 
         Public-surface parity with com.nvidia.spark.ml.feature.PCA
@@ -95,15 +157,6 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
 
         def setGpuId(self, value):
             return self._set(gpuId=value)
-
-        @classmethod
-        def load(cls, path):
-            # Overrides MLReadable.load: pyspark's Param typeConverter API
-            # differs from the core Params', so values are set by name.
-            from spark_rapids_ml_tpu.core import persistence as P
-
-            metadata = P.load_metadata(path, expected_class="TpuPCA")
-            return _set_params_from_metadata(cls(), metadata)
 
         def _fit(self, dataset):
             in_col = self.getOrDefault(self.inputCol)
@@ -240,61 +293,6 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
     # ------------------------------------------------------------------
     # Shared adapter plumbing for the non-PCA families
     # ------------------------------------------------------------------
-
-    class _TpuEstimatorPersistence(MLReadable):
-        """Estimator save/load (DefaultParamsWritable parity): metadata
-        JSON holds the params; load restores them by name onto a fresh
-        instance of the concrete class."""
-
-        def _save_impl(self, path):
-            from spark_rapids_ml_tpu.core import persistence as P
-
-            P.save_metadata(self, path, class_name=type(self).__name__)
-
-        @classmethod
-        def load(cls, path):
-            from spark_rapids_ml_tpu.core import persistence as P
-
-            metadata = P.load_metadata(path, expected_class=cls.__name__)
-            est = _set_params_from_metadata(cls(), metadata)
-            est.uid = metadata["uid"]  # DefaultParamsReader restores uid
-            return est
-
-    class _TpuCoreModelPersistence(MLReadable):
-        """Model save/load for adapters that WRAP a core model: metadata
-        at the root, the core model under <path>/core. Subclasses set
-        ``_core_class`` to a zero-arg callable returning the core model
-        class (lazy import keeps executors jax-free)."""
-
-        _core_class = None
-
-        def _save_impl(self, path):
-            import os as _os
-
-            from spark_rapids_ml_tpu.core import persistence as P
-
-            P.save_metadata(self, path, class_name=type(self).__name__)
-            self._core.save(_os.path.join(path, "core"))
-
-        @classmethod
-        def load(cls, path):
-            import os as _os
-
-            from spark_rapids_ml_tpu.core import persistence as P
-
-            metadata = P.load_metadata(path, expected_class=cls.__name__)
-            core = cls._core_class().load(_os.path.join(path, "core"))
-            model = _set_params_from_metadata(cls(core), metadata)
-            model.uid = metadata["uid"]
-            return model
-
-    def _set_params_from_metadata(obj, metadata):
-        """Restore pyspark Param values by name from core metadata JSON."""
-        for source in (metadata.get("defaultParamMap", {}), metadata.get("paramMap", {})):
-            for name, value in source.items():
-                if obj.hasParam(name):
-                    obj._set(**{name: value})
-        return obj
 
     def _collect_features(dataset, features_col):
         """Materialize the feature vectors on the driver (partition-

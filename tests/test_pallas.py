@@ -105,7 +105,7 @@ class TestPallasBackendSelection:
             rm_mod,
             "resolve_precision",
             lambda req, input_dtype=None: resolve_precision(
-                req, input_dtype=input_dtype, x64_enabled=False
+                req, input_dtype=input_dtype, x64_enabled=False, platform="tpu"
             ),
         )
         x = rng.normal(size=(60, 4))  # float64 input on a "no-x64 platform"

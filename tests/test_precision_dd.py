@@ -32,10 +32,17 @@ def _ill_conditioned(rng, n=20_000, d=8, mean_scale=1e4):
 
 class TestResolvePrecision:
     def test_auto_routes_dd_only_for_f64_without_x64(self):
-        assert resolve_precision("auto", np.float64, x64_enabled=False) == "dd"
-        assert resolve_precision("auto", np.float64, x64_enabled=True) == "highest"
-        assert resolve_precision("auto", np.float32, x64_enabled=False) == "highest"
-        assert resolve_precision("auto", None, x64_enabled=False) == "highest"
+        # dd auto-routing targets ACCELERATORS (no native fp64); on CPU
+        # the fix for fp64 semantics is enabling x64, not emulation.
+        kw = dict(x64_enabled=False, platform="tpu")
+        assert resolve_precision("auto", np.float64, **kw) == "dd"
+        assert resolve_precision("auto", np.float64, x64_enabled=True, platform="tpu") == "highest"
+        assert resolve_precision("auto", np.float32, **kw) == "highest"
+        assert resolve_precision("auto", None, **kw) == "highest"
+        assert (
+            resolve_precision("auto", np.float64, x64_enabled=False, platform="cpu")
+            == "highest"
+        )
 
     def test_explicit_passthrough(self):
         for p in ("default", "high", "highest", "dd"):

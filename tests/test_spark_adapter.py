@@ -501,14 +501,20 @@ class TestTpuDBSCANAndUMAP:
         # Force the f32 storage a no-x64 platform would produce.
         from spark_rapids_ml_tpu.models.dbscan import DBSCANModel
 
-        # Swap in a core with f32 storage; the cache keys on core identity
-        # so no manual reset is needed (r2 review).
-        model._core = DBSCANModel(
+        # Swap in a core whose STORAGE is genuinely f32 — the ctor casts
+        # to the platform dtype (f64 under the x64 test harness), so the
+        # f32 array is assigned post-construction to emulate the no-x64
+        # platform exactly. The cache keys on core identity, so the swap
+        # rebuilds the lookup.
+        core32 = DBSCANModel(
             None,
-            model._core.fitted.astype(np.float32),
+            model._core.fitted,
             model._core.labels_,
             model._core.core_mask_,
         )
+        core32.fitted = np.asarray(model._core.fitted, dtype=np.float32)
+        assert core32.fitted.dtype == np.float32
+        model._core = core32
         preds = np.asarray([r.prediction for r in model.transform(df).collect()])
         np.testing.assert_array_equal(preds, model.labels_)
 
@@ -559,3 +565,15 @@ class TestEstimatorPersistence:
         est._save_impl(path)
         loaded = adapter.TpuKMeans.load(path)
         assert loaded.uid == est.uid
+
+    def test_roundtrip_preserves_default_vs_set(self, spark_env, tmp_path):
+        """Defaults must come back as DEFAULTS (isSet False) after a
+        save/load round trip — DefaultParamsReader semantics (r2 review)."""
+        adapter, spark = spark_env
+        est = adapter.TpuKMeans(k=3)  # k set explicitly; maxIter a default
+        path = str(tmp_path / "def_est")
+        est._save_impl(path)
+        loaded = adapter.TpuKMeans.load(path)
+        assert loaded.isSet(loaded.k)
+        assert not loaded.isSet(loaded.maxIter)
+        assert loaded.getOrDefault(loaded.maxIter) == 20
