@@ -8,12 +8,18 @@ RapidsRowMatrix.scala:170-200), partials merge through treeAggregate
 (:207-233), and the driver finishes with the accelerated eigendecomposition
 (cuSolver-on-driver analogue, :88-95) via this framework's XLA path.
 
-Executors need numpy only — no JAX, no TPU: the per-partition work is fp64
-moment accumulation in row batches (the numbers that actually travel are
-d×d, tiny). The driver finishes with the eigendecomposition: on the chip
-resolved from ``gpuId``/task resources when ``useCuSolverSVD=True`` (the
+For the classic families (PCA, KMeans, LinearRegression, L2
+LogisticRegression) executors need numpy only — no JAX, no TPU: the
+per-partition work is fp64 moment / gradient accumulation in row batches
+(the numbers that actually travel are d×d, tiny), and transform UDFs
+close over plain numpy parameters + ``spark/executor_math.py``. The
+driver finishes with the eigendecomposition/solve: on the chip resolved
+from ``gpuId``/task resources when ``useCuSolverSVD=True`` (the
 calSVD-on-driver analogue), or NumPy on the driver CPU when False (the
-reference's breeze-SVD fallback, RapidsRowMatrix.scala:110-123).
+reference's breeze-SVD fallback, RapidsRowMatrix.scala:110-123). The
+NEIGHBOR families are the exception: their kneighbors UDFs ship the
+accelerated index to executors, as the modern reference requires cuML
+on its executors for the same families.
 ``useGemm`` is accepted for parity and recorded in params; both covariance
 routes share the one streaming accumulator here (the reference's spr/gemm
 split reflected a cuBLAS API choice with no TPU analogue — both its paths
