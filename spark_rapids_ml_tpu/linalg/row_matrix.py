@@ -227,16 +227,20 @@ class RowMatrix:
         with TraceRange("compute cov", TraceColor.RED):
             if self.mesh is not None:
                 return self._covariance_mesh()[1]  # honors mean_centering
+            if not self.use_gemm:
+                # The explicitly requested packed path outranks auto-dd:
+                # with the native runtime it is TRUE fp64 (never less
+                # accurate than dd); its no-native fallback routes dd
+                # itself when dd precision was resolved.
+                return self._covariance_packed()
             if self.precision == "dd":
                 return self._covariance_dd()
-            if self.use_gemm:
-                mean = (
-                    self.column_means()
-                    if self.mean_centering
-                    else jnp.zeros(self.num_cols, dtype=self.dtype)
-                )
-                return self._covariance_gemm(mean)
-            return self._covariance_packed()
+            mean = (
+                self.column_means()
+                if self.mean_centering
+                else jnp.zeros(self.num_cols, dtype=self.dtype)
+            )
+            return self._covariance_gemm(mean)
 
     def _covariance_gemm(self, mean: jnp.ndarray) -> jnp.ndarray:
         """Per-partition fused centered Gram + host partial sum (:168-201)."""
@@ -267,6 +271,32 @@ class RowMatrix:
             acc = gram if acc is None else acc + gram
         return acc / (self.num_rows - 1)
 
+    @staticmethod
+    def _native_spr_covariance(blocks, center: bool):
+        """Stream dense host blocks through the native fp64 Kahan
+        accumulator; returns ``(cov fp64 UNCAST, n_rows)``. ONE home for
+        the cap/accumulate/finalize sequence shared by the materialized
+        packed path and its streaming twin — the uncast return is the
+        contract that keeps the fp64 accuracy through the eigensolve on
+        no-x64 platforms."""
+        from spark_rapids_ml_tpu import native
+
+        acc = None
+        for b in blocks:
+            if b.shape[0] == 0:
+                continue
+            if acc is None:
+                if b.shape[1] > 65535:
+                    raise ValueError(
+                        f"packed path caps features at 65535, got {b.shape[1]}"
+                    )
+                acc = native.SprAccumulator(b.shape[1])
+            acc.add_block(b)
+        if acc is None:
+            raise ValueError("need at least 2 rows to compute a covariance, got 0")
+        cov, _ = acc.finalize(center=center)
+        return cov, int(acc.n_rows)
+
     def _covariance_packed(self) -> jnp.ndarray:
         """Packed-upper aggregation path (spr/treeAggregate, :202-251).
 
@@ -284,12 +314,14 @@ class RowMatrix:
         from spark_rapids_ml_tpu import native
 
         if native.available():
-            acc = native.SprAccumulator(n_cols)
-            for part in self.partitions:
-                if part.shape[0]:
-                    acc.add_block(part)
-            cov, _ = acc.finalize(center=self.mean_centering)
-            return jnp.asarray(cov, dtype=self.dtype)
+            cov, _ = self._native_spr_covariance(
+                iter(self.partitions), self.mean_centering
+            )
+            return cov
+        if self.precision == "dd":
+            # No native runtime: the packed layout is a compatibility shim
+            # here; dd precision still needs the dd kernels.
+            return self._covariance_dd()
         mean = (
             self.column_means()
             if self.mean_centering
@@ -351,6 +383,25 @@ class RowMatrix:
             self._num_rows = int(n)
             self._num_cols = int(cov.shape[0])
             return jnp.asarray(cov, dtype=self.dtype)
+        if not self.use_gemm:
+            # Packed-path semantics for streams: the native fp64 Kahan
+            # accumulator (tpuml_host.cpp) consumes blocks one at a time —
+            # true fp64 at constant memory, the streamed twin of the
+            # materialized spr path (RapidsRowMatrix.scala:202-251).
+            from spark_rapids_ml_tpu import native
+
+            if native.available():
+                with TraceRange("compute cov (stream, native spr)", TraceColor.RED):
+                    from spark_rapids_ml_tpu.core.data import _block_to_dense
+
+                    cov, n = self._native_spr_covariance(
+                        (_block_to_dense(blk) for blk in blocks),
+                        self.mean_centering,
+                    )
+                self._num_rows = n
+                self._num_cols = int(cov.shape[0])
+                return cov
+            # No native runtime: fall through to the jitted streaming path.
         with TraceRange("compute cov (stream)", TraceColor.RED):
             if self.precision == "dd":
                 from spark_rapids_ml_tpu.ops.doubledouble import (
@@ -449,12 +500,18 @@ class RowMatrix:
         n_cols = self.num_cols
         if not shape_known and not 1 <= k <= n_cols:
             raise ValueError(f"k must be in [1, {n_cols}], got {k}")
-        if self.precision == "dd":
-            # The covariance is exact-fp64 host data; a device eigensolve
-            # would round it to fp32 on a no-x64 platform. Host LAPACK
-            # keeps the dd accuracy end to end (d x d only — O(d^3) off
-            # the critical data path). An explicit topk request is honored
-            # at fp64 via ARPACK rather than silently ignored.
+        # Host-exact fp64 covariances (dd emulation, or the native Kahan
+        # accumulator's packed/streamed paths): a device eigensolve would
+        # round them to fp32 on a no-x64 platform — host LAPACK/ARPACK
+        # keeps the fp64 accuracy end to end (d x d only, off the critical
+        # data path). With x64 on, the device solve is equally exact and
+        # keeps useCuSolverSVD semantics.
+        host_f64_cov = isinstance(cov, np.ndarray) and cov.dtype == np.float64 and not (
+            jax.config.jax_enable_x64
+        )
+        if self.precision == "dd" or host_f64_cov:
+            # An explicit topk request is honored at fp64 via ARPACK
+            # rather than silently ignored.
             if self.eigen_solver == "topk" and k < n_cols:
                 with TraceRange("host fp64 topk", TraceColor.BLUE):
                     w_k, u_k = eigh_topk_host(np.asarray(cov), k)

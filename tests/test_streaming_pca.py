@@ -251,3 +251,50 @@ class TestStreamingTransform:
         np.testing.assert_allclose(
             np.concatenate(blocks), model.transform(x), atol=1e-9
         )
+
+
+class TestStreamingPackedPath:
+    @pytest.mark.skipif(
+        not native.available(), reason="native library unavailable"
+    )
+    def test_use_gemm_false_streams_into_native_accumulator(self, rng):
+        """useGemm=False on a streaming source routes through the native
+        fp64 Kahan accumulator block by block — the streamed twin of the
+        materialized packed path."""
+        x = rng.normal(size=(4_000, 6)) * np.linspace(1, 2, 6) + 1e3
+        gen = (x[i : i + 700] for i in range(0, 4_000, 700))
+        rm = RowMatrix(gen, use_gemm=False)
+        cov = np.asarray(rm.compute_covariance())
+        np.testing.assert_allclose(cov, np.cov(x, rowvar=False), atol=1e-8)
+        assert rm.num_rows == 4_000 and rm.num_cols == 6
+
+    @pytest.mark.skipif(
+        not native.available(), reason="native library unavailable"
+    )
+    def test_pca_usegemm_false_reader(self, rng, tmp_path):
+        x = rng.normal(size=(2_048, 5)) + 50.0
+        path = str(tmp_path / "pk.npy")
+        np.save(path, x)
+        reader = native.NpyBlockReader(path, block_rows=300)
+        try:
+            model = PCA().setK(2).setUseGemm(False).fit(reader)
+        finally:
+            reader.close()
+        oracle = PCA().setK(2).fit(x)
+        _pc_close(model.pc, oracle.pc, 1e-8)
+
+    @pytest.mark.skipif(
+        not native.available(), reason="native library unavailable"
+    )
+    def test_native_cov_not_downcast(self, rng):
+        """The native accumulator's fp64 covariance must reach the
+        eigensolve UNCAST — on no-x64 platforms a device-dtype cast would
+        round it to f32, wasting the Kahan accumulation (the f32 device
+        dtype is forced via the ctor's dtype argument)."""
+        import jax.numpy as jnp
+
+        x = rng.normal(size=(2_000, 5)) + 1e3
+        rm = RowMatrix([x], use_gemm=False, dtype=jnp.float32)
+        cov = rm.compute_covariance()
+        assert isinstance(cov, np.ndarray) and cov.dtype == np.float64
+        np.testing.assert_allclose(cov, np.cov(x, rowvar=False), atol=1e-8)
