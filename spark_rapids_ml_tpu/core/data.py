@@ -98,6 +98,21 @@ def _row_to_array(row: Any) -> np.ndarray:
     return np.asarray(row, dtype=np.float64).ravel()
 
 
+def is_device_array(data: Any) -> bool:
+    """True for ``jax.Array`` inputs — the device-resident fast path: the
+    estimators consume the array in place (no host round-trip, no float64
+    coercion, whole fit as one XLA program). numpy arrays are NOT device
+    arrays — they take the partition path. This is the input mode the
+    reference cannot express (every JNI call copies host arrays,
+    rapidsml_jni.cu:112,179) and the one `bench.py` measures.
+    """
+    try:
+        import jax
+    except ImportError:  # pragma: no cover
+        return False
+    return isinstance(data, jax.Array)
+
+
 def infer_input_dtype(data: Any):
     """Best-effort dtype of the USER's raw feature container, inspected
     BEFORE the densification pipeline (``as_partitions``/``as_matrix``)
@@ -112,6 +127,9 @@ def infer_input_dtype(data: Any):
     """
     if isinstance(data, np.ndarray):
         return data.dtype if np.issubdtype(data.dtype, np.floating) else None
+    if is_device_array(data):
+        dt = np.dtype(data.dtype)
+        return dt if np.issubdtype(dt, np.floating) else None
     if _sp is not None and _sp.issparse(data):
         return data.dtype if np.issubdtype(data.dtype, np.floating) else None
     if isinstance(data, (SparseVector, DenseVector)):
@@ -368,8 +386,8 @@ def extract_weights(dataset: Any, weight_col: Optional[str]) -> Optional[np.ndar
 def num_features(data: Any) -> int:
     """Feature count by PEEKING at the first partition/row only — never
     densifies the dataset (used for cheap routing decisions)."""
-    if isinstance(data, np.ndarray):
-        return data.shape[1] if data.ndim == 2 else data.shape[0]
+    if isinstance(data, np.ndarray) or is_device_array(data):
+        return int(data.shape[1] if data.ndim == 2 else data.shape[0])
     if _sp is not None and _sp.issparse(data):
         return data.shape[1]
     if isinstance(data, (list, tuple)) and data:

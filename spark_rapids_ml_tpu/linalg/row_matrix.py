@@ -23,6 +23,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu.core.data import (
     as_partitions,
+    is_device_array,
     is_streaming_source,
     iter_stream_blocks,
 )
@@ -34,6 +35,8 @@ from spark_rapids_ml_tpu.ops.covariance import (
     welford_init,
 )
 from spark_rapids_ml_tpu.ops.eigh import (
+    auto_max_iters,
+    eigh_auto,
     eigh_descending,
     eigh_descending_host,
     eigh_topk,
@@ -44,6 +47,44 @@ from spark_rapids_ml_tpu.ops.linalg import resolve_precision, triu_to_full
 from spark_rapids_ml_tpu.parallel.distributed_cov import distributed_mean_and_covariance
 from spark_rapids_ml_tpu.parallel.mesh import shard_rows_from_partitions
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+from functools import partial as _partial
+
+
+@_partial(
+    jax.jit,
+    static_argnames=("k", "center", "precision", "eigen_solver", "eigen_iters"),
+)
+def _pca_fit_device(x, k, center, precision, eigen_solver, eigen_iters):
+    """The whole PCA fit as ONE XLA program on a device-resident array:
+    column means + fused centered covariance GEMM + eigensolve + explained
+    variance — nothing leaves the device, nothing re-traces across calls
+    (module-level jit keyed on shape + the static config). This is the
+    path `bench.py` measures through the public estimator API; the
+    reference's equivalent spans four JNI calls with host copies between
+    each (RapidsRowMatrix.scala:149-257, rapidsml_jni.cu:159-356).
+    """
+    n, d = x.shape
+    mean = jnp.mean(x, axis=0) if center else jnp.zeros((d,), dtype=x.dtype)
+    cov = centered_gram(x, mean, precision=precision) / (n - 1)
+
+    def ratio(w, total):
+        # Zero-variance input (constant rows) must yield zeros, not NaN —
+        # the same `total > 0` guard every host path applies.
+        return jnp.where(total > 0, w / jnp.where(total > 0, total, 1), w)
+
+    if eigen_solver == "auto" and k < d:
+        w, v, _ = eigh_auto(cov, k, max_iters=auto_max_iters(eigen_iters))
+        w = jnp.maximum(w, 0)
+        return v, ratio(w, jnp.trace(cov))
+    if eigen_solver == "topk" and k < d:
+        w, v = eigh_topk(cov, k, iters=eigen_iters)
+        w = jnp.maximum(w, 0)
+        return v, ratio(w, jnp.trace(cov))
+    w, v = eigh_descending(cov)
+    w = jnp.maximum(w, 0)
+    return v[:, :k], ratio(w, jnp.sum(w))[:k]
 
 
 class RowMatrix:
@@ -75,9 +116,26 @@ class RowMatrix:
         # factories) are never materialized: the covariance runs as a
         # one-pass shifted accumulation at constant memory — the
         # reference's streamed mapPartitions contract
-        # (RapidsRowMatrix.scala:170).
-        if is_streaming_source(rows):
+        # (RapidsRowMatrix.scala:170). jax.Array input is the
+        # device-resident mode: the whole fit runs as ONE XLA program on
+        # the array in place — no host round-trip, no float64 coercion
+        # (the input path the reference cannot express: every JNI call
+        # copies host arrays, rapidsml_jni.cu:112,179).
+        self._device_x = None
+        self._num_rows: Optional[int] = None
+        self._num_cols: Optional[int] = None
+        if is_device_array(rows):
+            if rows.ndim != 2:
+                raise ValueError(
+                    f"device-array input must be 2-D (n, d), got shape {rows.shape}"
+                )
             self.partitions: Optional[List[np.ndarray]] = None
+            self._stream = None
+            self._device_x = rows
+            self._num_rows = int(rows.shape[0])
+            self._num_cols = int(rows.shape[1])
+        elif is_streaming_source(rows):
+            self.partitions = None
             self._stream = rows
         else:
             self.partitions = as_partitions(rows)
@@ -90,6 +148,18 @@ class RowMatrix:
         self.precision = self.resolve(
             precision, mesh=mesh, input_dtype=input_dtype, backend=backend
         )
+        if self.precision == "dd" and self._device_x is not None:
+            raise ValueError(
+                "precision='dd' is the host-streaming fp64 emulation; a "
+                "device-resident jax.Array is already in its compute dtype "
+                "— pass host partitions (or enable x64) for dd semantics"
+            )
+        if not use_gemm and self._device_x is not None:
+            raise ValueError(
+                "useGemm=False (the packed spr-layout path) consumes host "
+                "partitions; device-resident input runs the fused GEMM "
+                "covariance (useGemm=True)"
+            )
         if self.precision == "dd" and mesh is not None:
             # dd composes with a mesh ONLY as the per-executor streaming
             # merge (each process runs the dd scan on its local blocks;
@@ -113,7 +183,7 @@ class RowMatrix:
             # only the materialized single-device GEMM route consults it.
             if mesh is not None:
                 raise ValueError("backend='pallas' has no mesh path; use 'xla'")
-            if self.partitions is None:
+            if self.partitions is None and self._device_x is None:
                 raise ValueError(
                     "backend='pallas' has no streaming path; use 'xla'"
                 )
@@ -122,17 +192,15 @@ class RowMatrix:
                     "backend='pallas' applies to the GEMM path (useGemm=True)"
                 )
         self.backend = backend
-        if eigen_solver not in ("full", "topk"):
+        if eigen_solver not in ("auto", "full", "topk"):
             raise ValueError(
-                f"eigen_solver must be 'full' or 'topk', got {eigen_solver!r}"
+                f"eigen_solver must be 'auto', 'full' or 'topk', got {eigen_solver!r}"
             )
         self.eigen_solver = eigen_solver
         if eigen_iters < 1:
             raise ValueError(f"eigen_iters must be >= 1, got {eigen_iters}")
         self.eigen_iters = int(eigen_iters)
         self._dtype = dtype
-        self._num_rows: Optional[int] = None
-        self._num_cols: Optional[int] = None
 
     @staticmethod
     def resolve(precision: str, mesh=None, input_dtype=None, backend: str = "xla") -> str:
@@ -189,6 +257,9 @@ class RowMatrix:
     def dtype(self):
         if self._dtype is not None:
             return self._dtype
+        if self._device_x is not None:
+            # Device-resident input computes in ITS dtype — no coercion.
+            return self._device_x.dtype
         return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
     def _device(self):
@@ -200,6 +271,9 @@ class RowMatrix:
     # --- column stats (Statistics.colStats analogue, :156) ---
 
     def column_means(self) -> jnp.ndarray:
+        if self._device_x is not None:
+            with TraceRange("mean center", TraceColor.ORANGE):
+                return jnp.mean(self._device_x, axis=0)
         if self.partitions is None:
             raise RuntimeError(
                 "streaming input: column means are computed inside the "
@@ -214,6 +288,8 @@ class RowMatrix:
     # --- covariance (computeCovariance, :149-257) ---
 
     def compute_covariance(self) -> jnp.ndarray:
+        if self._device_x is not None:
+            return self._covariance_device()
         if self.partitions is None:
             return self._covariance_streaming()
         if not (self.mesh is not None and jax.process_count() > 1):
@@ -241,6 +317,53 @@ class RowMatrix:
                 else jnp.zeros(self.num_cols, dtype=self.dtype)
             )
             return self._covariance_gemm(mean)
+
+    def _covariance_device(self) -> jnp.ndarray:
+        """Covariance of a device-resident array — one fused XLA program,
+        no host round-trip (the standalone-covariance sibling of
+        :func:`_pca_fit_device`)."""
+        x = self._device_array_on_mesh()
+        n = self.num_rows
+        if n < 2:
+            raise ValueError(f"need at least 2 rows, got {n}")
+        with TraceRange("compute cov", TraceColor.RED):
+            mean = (
+                jnp.mean(x, axis=0)
+                if self.mean_centering
+                else jnp.zeros((self.num_cols,), dtype=x.dtype)
+            )
+            if self.backend == "pallas":
+                from spark_rapids_ml_tpu.ops.pallas.covariance import (
+                    centered_gram_pallas,
+                )
+
+                interpret = jax.default_backend() != "tpu"
+                return centered_gram_pallas(x, mean, interpret=interpret) / (n - 1)
+            return centered_gram(x, mean, precision=self.precision) / (n - 1)
+
+    def _device_array_on_mesh(self):
+        """The device input honoring a configured mesh: with a mesh set,
+        the array is placed row-sharded over the data axis (an explicit
+        mesh choice must never be silently dropped — the same stance as
+        the pallas guard above), so the fused program runs under GSPMD
+        with its covariance psum riding ICI. Without a mesh the array
+        computes wherever it lives."""
+        x = self._device_x
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+        dp = int(self.mesh.shape[DATA_AXIS])
+        if x.shape[0] % dp != 0:
+            raise ValueError(
+                f"device-array input with a mesh needs rows divisible by "
+                f"the data axis ({dp}), got {x.shape[0]}; pad/trim the "
+                f"array or pass host partitions (which pad with masking)"
+            )
+        sharding = NamedSharding(self.mesh, PartitionSpec(DATA_AXIS, None))
+        return jax.device_put(x, sharding)
 
     def _covariance_gemm(self, mean: jnp.ndarray) -> jnp.ndarray:
         """Per-partition fused centered Gram + host partial sum (:168-201)."""
@@ -489,9 +612,26 @@ class RowMatrix:
         # up front. Streaming sources learn d only during the pass, and a
         # multi-process fit only learns the GLOBAL width from the
         # placement allgather (a zero-row executor has no local width).
-        shape_known = self.partitions is not None and not (
-            self.mesh is not None and jax.process_count() > 1
-        )
+        if self._device_x is not None and self.use_accel_svd and self.backend != "pallas":
+            # Device-resident fused fit: one XLA program end to end.
+            n, n_cols = self.num_rows, self.num_cols
+            if n < 2:
+                raise ValueError(f"need at least 2 rows, got {n}")
+            if not 1 <= k <= n_cols:
+                raise ValueError(f"k must be in [1, {n_cols}], got {k}")
+            with TraceRange("fused device fit", TraceColor.RED):
+                u, explained = _pca_fit_device(
+                    self._device_array_on_mesh(),
+                    k,
+                    center=self.mean_centering,
+                    precision=self.precision,
+                    eigen_solver=self.eigen_solver,
+                    eigen_iters=self.eigen_iters,
+                )
+            return u, explained  # device arrays — the caller decides on host
+        shape_known = (
+            self.partitions is not None or self._device_x is not None
+        ) and not (self.mesh is not None and jax.process_count() > 1)
         if shape_known:
             n_cols = self.num_cols
             if not 1 <= k <= n_cols:
@@ -511,7 +651,8 @@ class RowMatrix:
         )
         if self.precision == "dd" or host_f64_cov:
             # An explicit topk request is honored at fp64 via ARPACK
-            # rather than silently ignored.
+            # rather than silently ignored ("auto" stays with the exact
+            # host solve: the fp64 path exists for accuracy, not speed).
             if self.eigen_solver == "topk" and k < n_cols:
                 with TraceRange("host fp64 topk", TraceColor.BLUE):
                     w_k, u_k = eigh_topk_host(np.asarray(cov), k)
@@ -527,6 +668,17 @@ class RowMatrix:
             # variance RATIOS come from the trace, so nothing is lost.
             with TraceRange("topk eigh", TraceColor.BLUE):
                 w_k, u_k = eigh_topk(jnp.asarray(cov), k, iters=self.eigen_iters)
+                w_k = np.clip(np.asarray(w_k), 0, None)
+                total = float(np.trace(np.asarray(cov)))
+                explained = w_k / total if total > 0 else w_k
+                return np.asarray(u_k), explained
+        elif self.eigen_solver == "auto" and k < n_cols and self.use_accel_svd:
+            # Self-selecting: subspace iteration that promotes itself to
+            # the full eigensolver when the spectrum defeats it (eigh_auto).
+            with TraceRange("auto eigh", TraceColor.BLUE):
+                w_k, u_k, _ = eigh_auto(
+                    jnp.asarray(cov), k, max_iters=auto_max_iters(self.eigen_iters)
+                )
                 w_k = np.clip(np.asarray(w_k), 0, None)
                 total = float(np.trace(np.asarray(cov)))
                 explained = w_k / total if total > 0 else w_k

@@ -71,7 +71,8 @@ class _PCAParams(HasInputCol, HasOutputCol):
     eigenSolver = Param(
         "_",
         "eigenSolver",
-        "full (exact eigh, default) | topk (subspace iteration, k << d)",
+        "auto (self-selecting, default) | full (exact eigh) | "
+        "topk (subspace iteration, k << d)",
         toString,
     )
     eigenIters = Param(
@@ -87,7 +88,7 @@ class _PCAParams(HasInputCol, HasOutputCol):
         self._setDefault(
             meanCentering=True, useGemm=True, useCuSolverSVD=True, gpuId=-1,
             solver="auto", precision="auto", covarianceBackend="xla",
-            eigenSolver="full", eigenIters=8,
+            eigenSolver="auto", eigenIters=8,
         )
 
     def getK(self) -> int:
@@ -173,19 +174,30 @@ class PCA(_PCAParams, Estimator, MLReadable):
         return self
 
     def setEigenSolver(self, value: str) -> "PCA":
-        """``"topk"`` replaces the full O(d^3) eigensolve with subspace
-        iteration + Rayleigh-Ritz (O(d^2 k) MXU matmuls) — the right
-        choice when k << d and the spectrum decays; explained-variance
-        ratios stay exact (trace-normalized). Convergence depends on the
-        eigengap: subspace error shrinks like (lambda_{k+1}/lambda_k)^iters,
-        so raise ``eigenIters`` (default 8) for slowly decaying spectra.
-        ``"full"`` (default) is the reference-parity exact eigh."""
-        if value not in ("full", "topk"):
-            raise ValueError(f"eigenSolver must be full|topk, got {value!r}")
+        """``"auto"`` (default) is self-selecting: subspace iteration that
+        stops when its captured-variance objective stagnates and promotes
+        itself to the full eigensolver when it runs out of iterations
+        unconverged (ops.eigh.eigh_auto — the runtime check that replaces
+        a static solver choice). ``"topk"`` forces subspace iteration +
+        Rayleigh-Ritz (O(d^2 k) MXU matmuls instead of the full O(d^3)
+        eigensolve): the right explicit choice when k << d and the
+        spectrum decays; explained-variance ratios stay exact
+        (trace-normalized). Convergence depends on the eigengap: subspace
+        error shrinks like (lambda_{k+1}/lambda_k)^iters, so raise
+        ``eigenIters`` (default 8) for slowly decaying spectra. ``"full"``
+        is the reference-parity exact eigh (calSVD's eigDC,
+        rapidsml_jni.cu:302-356)."""
+        if value not in ("auto", "full", "topk"):
+            raise ValueError(f"eigenSolver must be auto|full|topk, got {value!r}")
         self.set(self.eigenSolver, value)
         return self
 
     def setEigenIters(self, value: int) -> "PCA":
+        """Iteration budget for the subspace eigensolvers. ``"topk"`` runs
+        exactly this many; ``"auto"`` treats it as a CAP on an
+        early-exiting loop and enforces a quality floor of
+        ``ops.eigh.AUTO_MIN_ITERS`` (12) — below that the accept/promote
+        check cannot separate converged from degenerate."""
         if value < 1:
             raise ValueError(f"eigenIters must be >= 1, got {value}")
         self.set(self.eigenIters, value)
@@ -290,7 +302,11 @@ class PCA(_PCAParams, Estimator, MLReadable):
             eigen_iters=self.getEigenIters(),
         )
         pc, explained = mat.compute_principal_components_and_explained_variance(self.getK())
-        model = PCAModel(self.uid, np.asarray(pc), np.asarray(explained))
+        # Device-resident fits return device arrays; PCAModel converts to
+        # host float64 LAZILY, so a device-input fit never pays a host
+        # transfer the caller didn't ask for (the fit stays fully async
+        # until someone reads the model).
+        model = PCAModel(self.uid, pc, explained)
         return self._copyValues(model)
 
     def _fit_randomized(self, rows) -> "PCAModel":
@@ -298,28 +314,32 @@ class PCA(_PCAParams, Estimator, MLReadable):
         import jax
         import jax.numpy as jnp
 
+        from spark_rapids_ml_tpu.core.data import is_device_array
         from spark_rapids_ml_tpu.ops.randomized import randomized_pca
 
-        x_host = as_matrix(rows)
-        n, d = x_host.shape
         k = self.getK()
-        if not 1 <= k <= min(n, d):
-            raise ValueError(f"k must be in [1, {min(n, d)}], got {k}")
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        # Honor the chip-ordinal param the way the covariance path does
-        # (RowMatrix._device); the sketch SEED stays fixed so the fitted
-        # model never depends on placement.
-        gpu_id = self.getGpuId()
-        device = jax.devices()[gpu_id] if gpu_id >= 0 else jax.devices()[0]
-        x = jax.device_put(jnp.asarray(x_host, dtype=dtype), device)
+        if is_device_array(rows):
+            # Already resident: sketch in place, stay async (lazy model).
+            n, d = rows.shape
+            if not 1 <= k <= min(n, d):
+                raise ValueError(f"k must be in [1, {min(n, d)}], got {k}")
+            x = rows
+        else:
+            x_host = as_matrix(rows)
+            n, d = x_host.shape
+            if not 1 <= k <= min(n, d):
+                raise ValueError(f"k must be in [1, {min(n, d)}], got {k}")
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            # Honor the chip-ordinal param the way the covariance path does
+            # (RowMatrix._device); the sketch SEED stays fixed so the fitted
+            # model never depends on placement.
+            gpu_id = self.getGpuId()
+            device = jax.devices()[gpu_id] if gpu_id >= 0 else jax.devices()[0]
+            x = jax.device_put(jnp.asarray(x_host, dtype=dtype), device)
         comps, ratio, _ = randomized_pca(
             x, k, jax.random.key(0), center=self.getMeanCentering()
         )
-        model = PCAModel(
-            self.uid,
-            np.asarray(comps, dtype=np.float64),
-            np.asarray(ratio, dtype=np.float64),
-        )
+        model = PCAModel(self.uid, comps, ratio)
         return self._copyValues(model)
 
 class PCAModel(_PCAParams, Model):
@@ -335,10 +355,29 @@ class PCAModel(_PCAParams, Model):
         explainedVariance: Optional[np.ndarray] = None,
     ):
         super().__init__(uid)
-        self.pc = None if pc is None else np.asarray(pc, dtype=np.float64)
-        self.explainedVariance = (
-            None if explainedVariance is None else np.asarray(explainedVariance, dtype=np.float64)
-        )
+        # Raw fitted state may be host numpy OR a jax.Array from a
+        # device-resident fit; the public `pc`/`explainedVariance` host
+        # float64 views convert lazily (and cache) so a device fit stays
+        # async until the model is actually read.
+        self._pc_raw = pc
+        self._ev_raw = explainedVariance
+        self._pc_np: Optional[np.ndarray] = None
+        self._ev_np: Optional[np.ndarray] = None
+
+    @property
+    def pc(self) -> Optional[np.ndarray]:
+        """Principal components (d, k) as host float64 (Spark's
+        DenseMatrix surface, RapidsPCA.scala:146-150)."""
+        if self._pc_np is None and self._pc_raw is not None:
+            self._pc_np = np.asarray(self._pc_raw, dtype=np.float64)
+        return self._pc_np
+
+    @property
+    def explainedVariance(self) -> Optional[np.ndarray]:
+        """Explained-variance ratios (k,) as host float64."""
+        if self._ev_np is None and self._ev_raw is not None:
+            self._ev_np = np.asarray(self._ev_raw, dtype=np.float64)
+        return self._ev_np
 
     def setInputCol(self, value: str) -> "PCAModel":
         self.set(self.inputCol, value)
@@ -350,7 +389,7 @@ class PCAModel(_PCAParams, Model):
 
     def copy(self, extra=None) -> "PCAModel":
         """Model.copy preserves fitted state (Spark's Model.copy contract)."""
-        that = PCAModel(self.uid, self.pc, self.explainedVariance)
+        that = PCAModel(self.uid, self._pc_raw, self._ev_raw)
         return self._copyValues(that, extra)
 
     def transform(self, dataset: Any) -> Any:
@@ -361,13 +400,27 @@ class PCAModel(_PCAParams, Model):
         container family as the input: DataFrame shim -> DataFrame with
         outputCol appended; array-like -> (n, k) ndarray.
         """
-        if self.pc is None:
+        if self._pc_raw is None:
             raise RuntimeError("model has no principal components")
         rows = extract_column(dataset, self.getInputCol())
         from spark_rapids_ml_tpu.core.data import (
+            is_device_array,
             is_streaming_source,
             iter_stream_blocks,
         )
+
+        if is_device_array(rows):
+            # Device-resident projection: X·pc as one jitted MXU matmul,
+            # result stays on device (the symmetric counterpart of the
+            # device-resident fit; the batched path the reference disabled,
+            # RapidsPCA.scala:172-185).
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops.linalg import project_rows
+
+            pc_dev = jnp.asarray(self._pc_raw).astype(rows.dtype)
+            with TraceRange("device transform", TraceColor.GREEN):
+                return project_rows(rows, pc_dev)
 
         if is_streaming_source(rows):
             # Streaming in, streaming out: project block by block at

@@ -87,6 +87,16 @@ def gemm_syrk(b: jax.Array, precision: str = "highest") -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("precision",))
+def project_rows(x: jax.Array, pc: jax.Array, precision: str = "highest") -> jax.Array:
+    """C = X·pc — the device-resident row projection (the jitted twin of
+    :func:`gemm_project` for inputs already laid out (n, d); transposing a
+    concrete device array outside jit would materialize a copy, so this
+    takes X directly). Same kernel the reference's disabled batch
+    transform wanted (``dgemm_b``, rapidsml_jni.cu:269-276)."""
+    return jnp.matmul(x, pc, precision=_dot_precision(precision))
+
+
+@partial(jax.jit, static_argnames=("precision",))
 def gemm_project(a: jax.Array, b: jax.Array, precision: str = "highest") -> jax.Array:
     """C = AᵀB — the batched projection kernel.
 
