@@ -33,6 +33,37 @@ _trapezoid = getattr(np, "trapezoid", None) or np.trapz
 _DEVICE_THRESHOLD = 1_000_000
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _gate_probe_jit():
+    """Build (once) the jitted multiclass-gate probe — the jit wrapper
+    must be cached at module scope or every evaluate() call would
+    re-trace and recompile it; jax stays a lazy import."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def probe(y, p):
+        integral = jnp.logical_and(
+            jnp.all(y == jnp.round(y)), jnp.all(p == jnp.round(p))
+        )
+        lo = jnp.minimum(jnp.min(y), jnp.min(p))
+        hi = jnp.maximum(jnp.max(y), jnp.max(p))
+        return jnp.stack(
+            [integral.astype(y.dtype), lo.astype(y.dtype), hi.astype(y.dtype)]
+        )
+
+    return probe
+
+
+def _multiclass_gate_probe(y, p):
+    """One fused device reduction for the multiclass device-route gate:
+    returns [integral, min, max] as a 3-vector (single readback)."""
+    return _gate_probe_jit()(y, p)
+
+
 def _device_pair(dataset):
     """If ``dataset`` is a (y, scores/preds) tuple that should score on
     device, return it as jax arrays; else None."""
@@ -202,12 +233,11 @@ class MulticlassClassificationEvaluator(Evaluator):
             y_d, p_d = dev
             # The bincount confusion matrix needs dense small non-negative
             # integer labels; anything else falls back to the host path
-            # (np.unique handles sparse/float IDs, at collect cost).
-            integral = bool(
-                jnp.all(y_d == jnp.round(y_d)) & jnp.all(p_d == jnp.round(p_d))
-            )
-            lo = float(jnp.minimum(jnp.min(y_d), jnp.min(p_d)))
-            hi = float(jnp.maximum(jnp.max(y_d), jnp.max(p_d)))
+            # (np.unique handles sparse/float IDs, at collect cost). The
+            # integrality/min/max probe is ONE fused jitted reduction and
+            # one 3-scalar readback — not three full device passes.
+            probe = np.asarray(_multiclass_gate_probe(y_d, p_d))
+            integral, lo, hi = bool(probe[0]), float(probe[1]), float(probe[2])
             if integral and lo >= 0 and hi < 4096:
                 return multiclass_metrics_device(
                     y_d.astype(jnp.int32), p_d.astype(jnp.int32), int(hi) + 1

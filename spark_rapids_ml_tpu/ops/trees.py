@@ -205,6 +205,74 @@ def _node_totals(
     return tot
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "level", "impurity", "feat_subset", "min_instances", "min_info_gain"
+    ),
+)
+def split_level(
+    hist: jax.Array,  # (T, M, d, B, S) level histogram (already merged)
+    key: jax.Array,
+    level: int,
+    *,
+    impurity: str,
+    feat_subset: int,
+    min_instances: int = 1,
+    min_info_gain: float = 0.0,
+):
+    """Split decision for one tree level from its merged histogram — THE
+    single home of split selection: :func:`grow_forest` calls it on
+    device-local (psum-merged) histograms, and the pyspark adapter's
+    distributed fit calls it on driver-merged executor partials
+    (spark/adapter.py), so both deployments decide splits with literally
+    the same math (the treeAggregate-then-driver-decide structure of
+    RapidsRowMatrix.scala:207-233, applied to trees).
+
+    Returns ``(best_f, best_b, best_gain, split_ok, total, w_parent)``
+    with shapes (T, M) / (T, M, S) for total.
+    """
+    T, m_nodes, d, n_bins, _ = hist.shape
+    min_w = float(min_instances)
+    left = jnp.cumsum(hist, axis=3)
+    total = left[:, :, 0, -1, :]  # (T, M, S): same for every feature
+    right = total[:, :, None, None, :] - left
+    imp_parent, w_parent = _impurity(total, impurity)  # (T, M)
+    imp_l, w_l = _impurity(left, impurity)  # (T, M, d, B)
+    imp_r, w_r = _impurity(right, impurity)
+    gain = imp_parent[:, :, None, None] - (
+        w_l * imp_l + w_r * imp_r
+    ) / jnp.maximum(w_parent, 1e-12)[:, :, None, None]
+
+    # Per-node random feature subset: exactly feat_subset features, at
+    # zero extra histogram cost (all features were counted anyway).
+    if feat_subset < d:
+        u = jax.random.uniform(jax.random.fold_in(key, level), (T, m_nodes, d))
+        kth = lax.top_k(u, feat_subset)[0][..., -1:]
+        f_mask = u >= kth
+    else:
+        f_mask = jnp.ones((T, m_nodes, d), dtype=bool)
+
+    valid = (
+        (w_l >= min_w)
+        & (w_r >= min_w)
+        & (jnp.arange(n_bins) < n_bins - 1)[None, None, None, :]
+        & f_mask[:, :, :, None]
+    )
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(T, m_nodes, d * n_bins)
+    best = jnp.argmax(flat, axis=2)
+    best_gain = jnp.take_along_axis(flat, best[..., None], axis=2)[..., 0]
+    best_f = (best // n_bins).astype(jnp.int32)
+    best_b = (best % n_bins).astype(jnp.int32)
+    split_ok = (
+        (best_gain > 0)
+        & (best_gain >= min_info_gain)
+        & (w_parent > 0)
+    )
+    return best_f, best_b, best_gain, split_ok, total, w_parent
+
+
 def _select_feature(x: jax.Array, f_r: jax.Array) -> jax.Array:
     """out[t, r] = x[r, f_r[t, r]] without a 2-D gather.
 
@@ -284,7 +352,6 @@ def grow_forest(
     S = row_stats.shape[1]
     n_total = 2 ** (max_depth + 1) - 1
     s_out = S if impurity in ("gini", "entropy") else 1
-    min_w = float(min_instances)
     # Classification histogram entries are small-integer counts (one-hot x
     # Poisson weights <= ~hundreds): EXACT even under one-pass bf16
     # multiplies with fp32 accumulation, so the 6-pass HIGHEST route would
@@ -317,43 +384,10 @@ def grow_forest(
         )  # (T, M, d, B, S)
         if axis_name is not None:
             hist = lax.psum(hist, axis_name)
-        left = jnp.cumsum(hist, axis=3)
-        total = left[:, :, 0, -1, :]  # (T, M, S): same for every feature
-        right = total[:, :, None, None, :] - left
-        imp_parent, w_parent = _impurity(total, impurity)  # (T, M)
-        imp_l, w_l = _impurity(left, impurity)  # (T, M, d, B)
-        imp_r, w_r = _impurity(right, impurity)
-        gain = imp_parent[:, :, None, None] - (
-            w_l * imp_l + w_r * imp_r
-        ) / jnp.maximum(w_parent, 1e-12)[:, :, None, None]
-
-        # Per-node random feature subset: exactly feat_subset features, at
-        # zero extra histogram cost (all features were counted anyway).
-        if feat_subset < d:
-            u = jax.random.uniform(
-                jax.random.fold_in(key, level), (T, m_nodes, d)
-            )
-            kth = lax.top_k(u, feat_subset)[0][..., -1:]
-            f_mask = u >= kth
-        else:
-            f_mask = jnp.ones((T, m_nodes, d), dtype=bool)
-
-        valid = (
-            (w_l >= min_w)
-            & (w_r >= min_w)
-            & (jnp.arange(n_bins) < n_bins - 1)[None, None, None, :]
-            & f_mask[:, :, :, None]
-        )
-        gain = jnp.where(valid, gain, -jnp.inf)
-        flat = gain.reshape(T, m_nodes, d * n_bins)
-        best = jnp.argmax(flat, axis=2)
-        best_gain = jnp.take_along_axis(flat, best[..., None], axis=2)[..., 0]
-        best_f = (best // n_bins).astype(jnp.int32)
-        best_b = (best % n_bins).astype(jnp.int32)
-        split_ok = (
-            (best_gain > 0)
-            & (best_gain >= min_info_gain)
-            & (w_parent > 0)
+        best_f, best_b, best_gain, split_ok, total, w_parent = split_level(
+            hist, key, level,
+            impurity=impurity, feat_subset=feat_subset,
+            min_instances=min_instances, min_info_gain=min_info_gain,
         )
 
         sl = slice(offset, offset + m_nodes)

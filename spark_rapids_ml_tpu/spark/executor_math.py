@@ -138,9 +138,159 @@ def forest_forward_reg(
     return np.take_along_axis(leaf_value[:, :, 0], idx, axis=1).mean(axis=0)
 
 
+# ----------------------------------------------------------------------
+# Distributed random-forest fit: executor units of work (VERDICT r2 #3).
+# Per level, each partition routes ITS rows through the broadcast partial
+# forest and returns an additive histogram partial; treeReduce sums them
+# and the driver decides splits with ops.trees.split_level — the same
+# mapPartitions+treeAggregate structure as the covariance
+# (RapidsRowMatrix.scala:170-233), applied per tree level.
+# ----------------------------------------------------------------------
+
+
+def bin_columns(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """(n, d) bin ids: bin = #{edges e : x > e} per feature — the numpy
+    twin of ops/trees.bin_features (same convention, so raw thresholds
+    are the winning bin's upper edge on both sides)."""
+    out = np.empty(x.shape, dtype=np.int64)
+    for f in range(x.shape[1]):
+        out[:, f] = np.searchsorted(edges[f], x[:, f], side="left")
+    return out
+
+
+def forest_route(
+    feature: np.ndarray,  # (T, N) int, -1 = no split
+    threshold: np.ndarray,  # (T, N)
+    x: np.ndarray,  # (n, d)
+    level: int,
+) -> np.ndarray:
+    """(T, n) heap node ids of each row at ``level``; -1 = retired (the
+    row's path hit a leaf above this level). Twins the routing step of
+    ops/trees.grow_forest: descend LEFT on x[feature] <= threshold, which
+    by the binning convention equals bin <= split bin."""
+    T = feature.shape[0]
+    n = x.shape[0]
+    idx = np.zeros((T, n), dtype=np.int64)
+    rows = np.arange(n)[None, :]
+    for _ in range(level):
+        active = idx >= 0
+        safe = np.maximum(idx, 0)
+        f = np.take_along_axis(feature, safe, axis=1)
+        ok = f >= 0
+        thr = np.take_along_axis(threshold, safe, axis=1)
+        xv = x[rows, np.maximum(f, 0)]
+        child = 2 * idx + 1 + (xv > thr)
+        idx = np.where(active & ok, child, np.where(active, -1, idx))
+    return idx
+
+
+def level_histogram_partial(
+    node_idx: np.ndarray,  # (T, n) from forest_route
+    weights: np.ndarray,  # (T, n) per-tree sample weights
+    x_binned: np.ndarray,  # (n, d)
+    row_stats: np.ndarray,  # (n, S)
+    offset: int,
+    m_nodes: int,
+    n_bins: int,
+) -> np.ndarray:
+    """(T, M, d, B, S) float64 histogram partial for one partition's rows
+    — additive across partitions (the executor half of split_level)."""
+    T, n = node_idx.shape
+    d = x_binned.shape[1]
+    S = row_stats.shape[1]
+    hist = np.zeros((T, m_nodes * d * n_bins, S))
+    feat_off = np.arange(d)[None, :] * n_bins
+    for t in range(T):
+        local = node_idx[t] - offset
+        sel = (local >= 0) & (local < m_nodes) & (weights[t] > 0)
+        if not np.any(sel):
+            continue
+        codes = (
+            local[sel, None] * (d * n_bins) + feat_off + x_binned[sel]
+        ).ravel()  # (n_sel * d,)
+        for s in range(S):
+            wts = np.repeat(weights[t, sel] * row_stats[sel, s], d)
+            hist[t, :, s] += np.bincount(
+                codes, weights=wts, minlength=m_nodes * d * n_bins
+            )
+    return hist.reshape(T, m_nodes, d, n_bins, S)
+
+
+def node_totals_partial(
+    node_idx: np.ndarray,
+    weights: np.ndarray,
+    row_stats: np.ndarray,
+    offset: int,
+    m_nodes: int,
+) -> np.ndarray:
+    """(T, M, S) per-node stat totals for one partition's rows (the
+    bottom-level leaf statistics; additive across partitions)."""
+    T = node_idx.shape[0]
+    S = row_stats.shape[1]
+    tot = np.zeros((T, m_nodes, S))
+    for t in range(T):
+        local = node_idx[t] - offset
+        sel = (local >= 0) & (local < m_nodes) & (weights[t] > 0)
+        if not np.any(sel):
+            continue
+        for s in range(S):
+            tot[t, :, s] += np.bincount(
+                local[sel], weights=weights[t, sel] * row_stats[sel, s],
+                minlength=m_nodes,
+            )
+    return tot
+
+
+def tree_weight_rng(seed: int, part_index: int):
+    """Per-partition RNG for bootstrap weights, deterministic in
+    (seed, partition index): every level's pass re-creates it and draws
+    chunk by chunk in the same order, so executors re-derive identical
+    weights without shipping state across Spark jobs."""
+    return np.random.default_rng((int(seed) << 20) ^ (part_index + 1))
+
+
+def draw_tree_weights(
+    rng, n_trees: int, n_rows: int, rate: float, bootstrap: bool
+) -> np.ndarray:
+    """(T, n_rows) per-tree sample weights for one row chunk. Poisson(rate)
+    with replacement / Bernoulli(rate) without — the scheme of
+    ops/trees.sample_weights (the draw differs from the core's jax PRNG
+    stream; both are valid bootstrap resamplings, and rate=1 without
+    bootstrap is exactly all-ones on both sides)."""
+    if not bootstrap and rate >= 1.0:
+        return np.ones((n_trees, n_rows))
+    if bootstrap:
+        return rng.poisson(rate, (n_trees, n_rows)).astype(np.float64)
+    return (rng.random((n_trees, n_rows)) < rate).astype(np.float64)
+
+
+def soft_threshold(v: np.ndarray, t: float) -> np.ndarray:
+    """Elementwise soft-threshold — the numpy twin of the L1 prox in
+    ops/logistic.fit_logistic_elastic_net's FISTA step."""
+    return np.sign(v) * np.maximum(np.abs(v) - t, 0.0)
+
+
+def gram_matvec_partial(
+    xs: np.ndarray, v: np.ndarray
+) -> np.ndarray:
+    """XsᵀXs·v partial for one standardized block — the executor unit of
+    the distributed power iteration bounding the FISTA Lipschitz constant
+    (the spectral-norm estimate of ops/logistic, one pass per step)."""
+    return xs.T @ (xs @ v)
+
+
 __all__ = [
     "logistic_forward",
     "forest_forward",
     "forest_forward_reg",
     "forest_apply_leaves",
+    "logistic_loss_grad",
+    "bin_columns",
+    "forest_route",
+    "level_histogram_partial",
+    "node_totals_partial",
+    "tree_weight_rng",
+    "draw_tree_weights",
+    "soft_threshold",
+    "gram_matvec_partial",
 ]

@@ -39,6 +39,18 @@ class Column:
         self.args = list(args or [])
 
 
+# Driver-fetch instrumentation for the no-full-collect contract tests:
+# every row that crosses executor->driver through a row-materializing op
+# (collect / toLocalIterator / take / takeSample) is counted here. Reset
+# with FETCHED_ROWS.clear(); treeReduce is NOT counted — merged
+# accumulators are the point of the distributed paths.
+FETCHED_ROWS = {"rows": 0}
+
+
+def _count_fetch(n: int) -> None:
+    FETCHED_ROWS["rows"] = FETCHED_ROWS.get("rows", 0) + n
+
+
 class RDD:
     def __init__(self, partitions: List[list]):
         self._parts = [list(p) for p in partitions]
@@ -50,6 +62,31 @@ class RDD:
     def mapPartitions(self, f) -> "RDD":
         f = _pickle_roundtrip(f)
         return RDD([list(f(iter(p))) for p in self._parts])
+
+    def mapPartitionsWithIndex(self, f) -> "RDD":
+        """pyspark 3.5 RDD.mapPartitionsWithIndex: ``f(index, iterator)``
+        with the partition's ordinal as the first argument."""
+        f = _pickle_roundtrip(f)
+        return RDD([list(f(i, iter(p))) for i, p in enumerate(self._parts)])
+
+    def sample(self, withReplacement: bool, fraction: float, seed: int = None) -> "RDD":
+        """pyspark 3.5 RDD.sample: per-element Bernoulli(fraction) without
+        replacement / Poisson(fraction) draws with replacement; the result
+        size is random, NOT exactly fraction * count (documented pyspark
+        behavior). Seeded per partition for determinism."""
+        import numpy as _np
+
+        base = 17 if seed is None else int(seed)
+        out = []
+        for i, p in enumerate(self._parts):
+            rng = _np.random.default_rng((base << 16) ^ (i + 1))
+            if withReplacement:
+                counts = rng.poisson(fraction, len(p))
+                out.append([x for x, c in zip(p, counts) for _ in range(c)])
+            else:
+                keep = rng.random(len(p)) < fraction
+                out.append([x for x, k in zip(p, keep) if k])
+        return RDD(out)
 
     def persist(self, *_) -> "RDD":
         return self  # local lists are already materialized
@@ -63,6 +100,7 @@ class RDD:
     def first(self):
         for p in self._parts:
             if p:
+                _count_fetch(1)  # first() materializes one row at the driver
                 return p[0]
         raise ValueError("empty RDD")
 
@@ -71,8 +109,10 @@ class RDD:
         for p in self._parts:
             for x in p:
                 if len(out) >= n:
+                    _count_fetch(len(out))
                     return out
                 out.append(x)
+        _count_fetch(len(out))
         return out
 
     def takeSample(self, withReplacement: bool, num: int, seed: int = 0) -> list:
@@ -89,11 +129,15 @@ class RDD:
         return [all_rows[i] for i in idx]
 
     def collect(self) -> list:
-        return [x for p in self._parts for x in p]
+        out = [x for p in self._parts for x in p]
+        _count_fetch(len(out))
+        return out
 
     def toLocalIterator(self):
         for p in self._parts:
-            yield from p
+            for x in p:
+                _count_fetch(1)
+                yield x
 
     def count(self) -> int:
         return sum(len(p) for p in self._parts)

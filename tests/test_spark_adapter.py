@@ -358,7 +358,7 @@ class TestDistributedLogistic:
         preds = np.asarray([r.prediction for r in model.transform(df).collect()])
         assert np.mean(preds == y) > 0.8
 
-    def test_elastic_net_falls_back_to_collected(self, spark_env, rng):
+    def test_elastic_net_distributed_quality(self, spark_env, rng):
         adapter, spark = spark_env
         x = rng.normal(size=(200, 4))
         y = (x[:, 0] > 0).astype(float)
@@ -372,6 +372,180 @@ class TestDistributedLogistic:
         )
         preds = np.asarray([r.prediction for r in model.transform(df).collect()])
         assert np.mean(preds == y) > 0.9
+
+    def test_elastic_net_distributed_matches_core_optimum(self, spark_env, rng):
+        """Driver-side FISTA over executor gradient sums optimizes the
+        same strictly convex objective as the core solver — coefficients
+        must agree to optimizer tolerance (VERDICT r2 #3)."""
+        adapter, spark = spark_env
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        x = rng.normal(size=(300, 5))
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(float)
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
+        m_dist = (
+            adapter.TpuLogisticRegression()
+            .setMaxIter(500)
+            .setRegParam(0.1)
+            .setElasticNetParam(0.5)
+            .fit(df)
+        )
+        m_core = (
+            LogisticRegression()
+            .setMaxIter(500)
+            .setRegParam(0.1)
+            .setElasticNetParam(0.5)
+            .fit((x, y))
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_dist.coefficients.toArray()),
+            m_core.coefficients,
+            atol=2e-3,
+        )
+        assert m_dist.intercept == pytest.approx(m_core.intercept, abs=5e-3)
+        # L1 sparsity must survive the distributed route: both solvers
+        # zero the same noise features (or neither does).
+        dist_zero = np.asarray(m_dist.coefficients.toArray()) == 0
+        core_zero = np.asarray(m_core.coefficients) == 0
+        np.testing.assert_array_equal(dist_zero, core_zero)
+
+    def test_fractional_label_raises(self, spark_env, rng):
+        adapter, spark = spark_env
+        x = rng.normal(size=(60, 3))
+        y = np.where(np.arange(60) == 7, 1.5, (x[:, 0] > 0).astype(float))
+        df = _vector_df(spark, x, extra={"label": list(y)})
+        with pytest.raises(ValueError, match="non-negative integers"):
+            adapter.TpuLogisticRegression().fit(df)
+
+
+class TestNoDriverCollect:
+    """VERDICT r2 #3 done-criterion: instrument the stub RDD and assert
+    the forest / elastic-net fits never collect the dataset to the driver
+    (only the bounded quantile sample for forests)."""
+
+    def _fetch_counter(self):
+        from pyspark.sql import FETCHED_ROWS
+
+        return FETCHED_ROWS
+
+    def test_forest_fit_fetches_only_bounded_sample(
+        self, spark_env, rng, monkeypatch
+    ):
+        adapter, spark = spark_env
+        monkeypatch.setattr(adapter, "_QUANTILE_SAMPLE_CAP", 64)
+        n = 600
+        x = rng.normal(size=(n, 4))
+        y = (x[:, 0] > 0).astype(float)
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
+        counter = self._fetch_counter()
+        counter["rows"] = 0
+        model = (
+            adapter.TpuRandomForestClassifier()
+            .setNumTrees(8)
+            .setMaxDepth(3)
+            .fit(df)
+        )
+        # Bernoulli sampling at fraction 64/600 fetches ~64 rows; 3x
+        # headroom still proves no full collect (600 would fail).
+        assert counter["rows"] <= 192, counter["rows"]
+        preds = np.asarray(
+            [r.prediction for r in model.transform(df).collect()]
+        )
+        assert np.mean(preds == y) > 0.9
+
+    def test_forest_regressor_fit_fetches_only_bounded_sample(
+        self, spark_env, rng, monkeypatch
+    ):
+        adapter, spark = spark_env
+        monkeypatch.setattr(adapter, "_QUANTILE_SAMPLE_CAP", 64)
+        n = 500
+        x = rng.uniform(0, 1, size=(n, 3))
+        y = 2.0 * x[:, 0] - x[:, 1]
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
+        counter = self._fetch_counter()
+        counter["rows"] = 0
+        adapter.TpuRandomForestRegressor().setNumTrees(10).setMaxDepth(4).fit(df)
+        assert counter["rows"] <= 192, counter["rows"]
+
+    def test_elastic_net_fit_fetches_no_rows(self, spark_env, rng):
+        adapter, spark = spark_env
+        x = rng.normal(size=(400, 4))
+        y = (x[:, 0] > 0).astype(float)
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
+        counter = self._fetch_counter()
+        counter["rows"] = 0
+        adapter.TpuLogisticRegression().setMaxIter(50).setRegParam(
+            0.05
+        ).setElasticNetParam(0.5).fit(df)
+        # The only driver fetch allowed is first() probing the width.
+        assert counter["rows"] <= 2, counter["rows"]
+
+
+class TestForestDistributedMatchesCore:
+    def test_no_bootstrap_matches_core_predictions(self, spark_env, rng):
+        """bootstrap=False at rate 1.0 makes the sample weights all-ones
+        on both sides, the quantile sample covers the full (small)
+        dataset, and split selection is literally shared
+        (ops.trees.split_level) — so the distributed adapter fit and the
+        core fit must agree on every training prediction."""
+        adapter, spark = spark_env
+        from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+        x = rng.normal(size=(240, 4))
+        y = ((x[:, 0] > 0.3) | (x[:, 1] < -0.5)).astype(float)
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=3)
+        m_dist = (
+            adapter.TpuRandomForestClassifier()
+            .setNumTrees(6)
+            .setMaxDepth(4)
+            .setBootstrap(False)
+            .setFeatureSubsetStrategy("all")
+            .setSeed(3)
+            .fit(df)
+        )
+        m_core = (
+            RandomForestClassifier()
+            .setNumTrees(6)
+            .setMaxDepth(4)
+            .setBootstrap(False)
+            .setFeatureSubsetStrategy("all")
+            .setSeed(3)
+            .fit((x, y))
+        )
+        preds = np.asarray(
+            [r.prediction for r in m_dist.transform(df).collect()]
+        )
+        np.testing.assert_array_equal(preds, m_core.predict(x))
+
+    def test_regressor_no_bootstrap_matches_core(self, spark_env, rng):
+        adapter, spark = spark_env
+        from spark_rapids_ml_tpu.regression import RandomForestRegressor
+
+        x = rng.uniform(0, 1, size=(200, 3))
+        y = 3.0 * x[:, 0] - 2.0 * x[:, 1] + 0.1 * rng.normal(size=200)
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=3)
+        m_dist = (
+            adapter.TpuRandomForestRegressor()
+            .setNumTrees(5)
+            .setMaxDepth(4)
+            .setBootstrap(False)
+            .setFeatureSubsetStrategy("all")
+            .setSeed(1)
+            .fit(df)
+        )
+        m_core = (
+            RandomForestRegressor()
+            .setNumTrees(5)
+            .setMaxDepth(4)
+            .setBootstrap(False)
+            .setFeatureSubsetStrategy("all")
+            .setSeed(1)
+            .fit((x, y))
+        )
+        preds = np.asarray(
+            [r.prediction for r in m_dist.transform(df).collect()]
+        )
+        np.testing.assert_allclose(preds, m_core.predict(x), atol=1e-4)
 
 
 class TestNeighborsAdapters:
