@@ -46,14 +46,17 @@ def _gate_probe_jit():
 
     @jax.jit
     def probe(y, p):
+        # Promoted dtype (widened to at least f32): a narrow y.dtype
+        # (bf16/int16) would round the min/max the gate sizes n_classes by.
+        dt = jnp.promote_types(jnp.promote_types(y.dtype, p.dtype), jnp.float32)
+        y = y.astype(dt)
+        p = p.astype(dt)
         integral = jnp.logical_and(
             jnp.all(y == jnp.round(y)), jnp.all(p == jnp.round(p))
         )
         lo = jnp.minimum(jnp.min(y), jnp.min(p))
         hi = jnp.maximum(jnp.max(y), jnp.max(p))
-        return jnp.stack(
-            [integral.astype(y.dtype), lo.astype(y.dtype), hi.astype(y.dtype)]
-        )
+        return jnp.stack([integral.astype(dt), lo, hi])
 
     return probe
 

@@ -477,11 +477,15 @@ class RowMatrix:
                 )
 
                 with TraceRange("compute cov (stream, multiproc)", TraceColor.RED):
+                    # merge="auto": non-dd moments merge as a psum riding
+                    # ICI (the mesh is the fabric); dd stays on the exact
+                    # fp64 host allgather.
                     _, cov, n = streaming_covariance_process_local(
                         blocks,
                         center=self.mean_centering,
                         dtype=self.dtype,
                         precision=self.precision,
+                        mesh=self.mesh,
                     )
                 if self.precision == "dd":
                     # Keep the exact-fp64 host covariance — a device-dtype
@@ -581,13 +585,14 @@ class RowMatrix:
                 shard_rows_process_local,
             )
 
-            xs, mask, n_global = shard_rows_process_local(
+            xs, mask, n_global, d = shard_rows_process_local(
                 self.partitions, self.mesh, dtype=np.dtype(self.dtype)
             )
             # Shape facts must be GLOBAL after a distributed placement (a
             # process may hold zero local rows), and the <2 check happens
             # here — consistently on every process, after the allgather.
-            d = int(xs.shape[1])  # model axis is 1 in this mode: no padding
+            # ``d`` is the TRUE width (2-D meshes zero-pad features to the
+            # model axis; the padded columns are stripped below).
             self._num_rows = int(n_global)
             self._num_cols = d
             if n_global < 2:

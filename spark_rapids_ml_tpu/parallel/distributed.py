@@ -25,6 +25,7 @@ Deployment shape (mirrors the reference's executor model):
 
 from __future__ import annotations
 
+import functools as _functools
 import os
 from typing import List, Optional, Sequence, Tuple
 
@@ -126,7 +127,7 @@ def _allgather_counts_and_width(n_local: int, d_local: int):
 
 def shard_rows_process_local(
     partitions: List[np.ndarray], mesh: Mesh, dtype=None
-) -> Tuple[jax.Array, jax.Array, int]:
+) -> Tuple[jax.Array, jax.Array, int, int]:
     """Assemble a GLOBAL row-sharded array from per-process LOCAL blocks.
 
     Each process passes only the rows it loaded (its executor-local
@@ -134,7 +135,19 @@ def shard_rows_process_local(
     counts may differ: every process pads its local rows to the globally
     agreed per-process maximum (one tiny allgather of the counts), and the
     row mask zeroes the padding inside the compiled reductions, so results
-    are exact. Returns ``(x_sharded, row_mask_sharded, n_true_rows_global)``.
+    are exact.
+
+    Supports 2-D (data × model) meshes (VERDICT r2 #4): features are
+    zero-padded to the model-axis multiple and split across each process's
+    OWN devices, so a process's addressable shards stay one contiguous row
+    block × the full model axis. That requires the process's local device
+    count to be a multiple of the model axis (jax.devices() orders a
+    process's devices consecutively, so ``make_mesh``'s row-major reshape
+    gives every process whole mesh rows exactly when model | local_devices).
+
+    Returns ``(x_sharded, row_mask_sharded, n_true_rows_global, d_true)``
+    — ``d_true`` is the unpadded feature width (padded columns are exactly
+    zero; callers slice them off the results).
     """
     parts = [np.asarray(p) for p in partitions]
     if dtype is not None:
@@ -152,28 +165,31 @@ def shard_rows_process_local(
     local_dev = jax.local_device_count()
     dp = mesh.shape[DATA_AXIS]
     mp = mesh.shape[MODEL_AXIS]
-    if mp != 1:
+    if dp * mp != n_proc * local_dev:
         raise ValueError(
-            "process-local sharding currently supports data-parallel meshes "
-            f"(model axis 1), got model={mp}"
-        )
-    if dp != n_proc * local_dev:
-        raise ValueError(
-            f"mesh data axis {dp} != process_count*local_devices "
+            f"mesh {dp}x{mp} != process_count*local_devices "
             f"{n_proc}*{local_dev}"
         )
-    # Equal per-process row count, padded to the local device count, so the
-    # even GSPMD slicing of the global array lines up with what each
-    # process actually holds.
+    if local_dev % mp != 0:
+        raise ValueError(
+            f"model axis {mp} must divide the per-process device count "
+            f"{local_dev}: each process's addressable shards must span "
+            "whole mesh rows (consecutive-device mesh layout)"
+        )
+    d_tot = d + ((-d) % mp)
+    # Equal per-process row count, padded so it slices evenly across this
+    # process's local_dev/mp mesh rows — the even GSPMD slicing of the
+    # global array must line up with what each process actually holds.
+    rows_per_proc_of_mesh = local_dev // mp
     per_proc = int(counts.max())
-    per_proc += (-per_proc) % local_dev
+    per_proc += (-per_proc) % rows_per_proc_of_mesh
 
-    x_local = np.zeros((per_proc, d), dtype=np_dtype)
+    x_local = np.zeros((per_proc, d_tot), dtype=np_dtype)
     off = 0
     for p in parts:
         if p.shape[0] == 0:
             continue
-        x_local[off : off + p.shape[0]] = p
+        x_local[off : off + p.shape[0], :d] = p
         off += p.shape[0]
     mask_local = np.zeros(per_proc, dtype=np_dtype)
     mask_local[:n_local] = 1.0
@@ -181,29 +197,44 @@ def shard_rows_process_local(
     x_sharding = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
     m_sharding = NamedSharding(mesh, P(DATA_AXIS))
     xs = jax.make_array_from_process_local_data(
-        x_sharding, x_local, (per_proc * n_proc, d)
+        x_sharding, x_local, (per_proc * n_proc, d_tot)
     )
     ms = jax.make_array_from_process_local_data(
         m_sharding, mask_local, (per_proc * n_proc,)
     )
-    return xs, ms, n_true
+    return xs, ms, n_true, d
 
 
 def streaming_covariance_process_local(
-    blocks, center: bool = True, dtype=None, precision: str = "highest"
+    blocks, center: bool = True, dtype=None, precision: str = "highest",
+    mesh: Optional[Mesh] = None, merge: str = "auto",
 ):
     """Each process streams ITS OWN local blocks through the one-pass
     shifted accumulation (device Gram per block on its chip — or the dd
-    double-float kernels for ``precision="dd"``), then ONE allgather of
-    the O(d²) per-process moments merges them exactly — the reference's
+    double-float kernels for ``precision="dd"``), then the O(d²)
+    per-process moments merge across processes — the reference's
     executor-local compute + cross-process reduce
     (RapidsRowMatrix.scala:170-201) at constant memory per process.
 
-    Per-process shifts differ (each uses its first block's means); the
-    merge rebases every process's moments onto a common shift with the
-    exact closed-form corrections (the ShiftedMoments.merge algebra,
-    core/moments.py). Zero-block processes contribute nothing and strand
-    nobody. Returns host fp64 ``(mean, cov, n_global)`` on every process.
+    Two merge backends (VERDICT r2 #4):
+      - ``"psum"`` (the default with a mesh, non-dd): a tiny O(d) host
+        allgather agrees on a COMMON shift (the count-weighted mean of
+        the per-process shifts — any common value is exact, the choice
+        only conditions the algebra), each process rebases its moments
+        onto it with the closed-form correction, and the (d, d) payload
+        merges as ONE jitted replicated-sum whose cross-process reduce
+        XLA lowers to a psum riding ICI — the O(d²) traffic never touches
+        the host network.
+      - ``"allgather"`` (the default without a mesh, and always for
+        ``precision="dd"``): host allgather of the per-process moments +
+        exact fp64 ShiftedMoments merge; dd payloads carry ~48 mantissa
+        bits that a device-dtype psum would squash on no-x64 platforms,
+        so dd stays here by construction.
+
+    Per-process shifts differ (each uses its first block's means); both
+    backends rebase exactly (the ShiftedMoments algebra, core/moments.py).
+    Zero-block processes contribute nothing and strand nobody. Returns
+    host fp64 ``(mean, cov, n_global)`` on every process.
     """
     import jax.numpy as jnp
 
@@ -230,6 +261,16 @@ def streaming_covariance_process_local(
                 precision=precision,
             )
 
+    if merge not in ("auto", "psum", "allgather"):
+        raise ValueError(f"merge must be auto|psum|allgather, got {merge!r}")
+    if merge == "auto":
+        merge = "psum" if (mesh is not None and precision != "dd") else "allgather"
+    if merge == "psum" and precision == "dd":
+        raise ValueError(
+            "merge='psum' would squash the dd moments to the device dtype; "
+            "dd uses merge='allgather'"
+        )
+
     # min_rows=0: a process with zero (or one) local rows still returns
     # its partial moments and joins the merge instead of raising.
     shift, gram, s, n_local = shifted_block_scan(blocks, center, gram_fn, min_rows=0)
@@ -242,6 +283,11 @@ def streaming_covariance_process_local(
         shift = np.zeros(d)
         gram = np.zeros((d, d))
         s = np.zeros(d)
+
+    if merge == "psum":
+        return _psum_merge_moments(
+            shift, gram, s, n_local, counts, d, center, dtype
+        )
 
     # One allgather of the packed per-process moments: [shift | s | gram].
     # The wire must not squash the fp64 payload: without x64,
@@ -285,6 +331,87 @@ def streaming_covariance_process_local(
         raise ValueError(f"need at least 2 rows to compute a covariance, got {n_tot}")
     cov, mean = acc.finalize(center=center)
     return mean, cov, acc.n_rows
+
+
+def _psum_merge_moments(shift, gram, s, n_local, counts, d, center, dtype):
+    """Device-collective moment merge: rebase local moments onto a common
+    shift (exact closed form, fp64 on host), then ONE jitted replicated
+    sum over a flat all-devices mesh — XLA lowers the cross-process
+    reduce to a psum over ICI, so the O(d²) payload never rides the host
+    network. The payload travels at the device dtype: on no-x64 platforms
+    that matches the f32 grams' own information content (dd, which
+    carries more, is excluded by the caller)."""
+    import jax.numpy as jnp
+
+    from jax.experimental import multihost_utils
+
+    # Common shift: count-weighted mean of the per-process shifts. Any
+    # COMMON value keeps the algebra exact — an f32-rounded wire here
+    # only affects conditioning — so one tiny O(d) allgather suffices.
+    gathered_shift = np.asarray(
+        multihost_utils.process_allgather(shift.astype(np.float32)),
+        dtype=np.float64,
+    ).reshape(-1, d)
+    weights = counts.astype(np.float64)
+    total = max(weights.sum(), 1.0)
+    common = (gathered_shift * weights[:, None]).sum(axis=0) / total
+
+    # Exact rebase of THIS process's moments from its shift a to common c:
+    # x − c = (x − a) + δ with δ = a − c.
+    delta = np.asarray(shift, dtype=np.float64) - common
+    s64 = np.asarray(s, dtype=np.float64)
+    s_c = s64 + n_local * delta
+    gram_c = (
+        np.asarray(gram, dtype=np.float64)
+        + np.outer(delta, s64)
+        + np.outer(s64, delta)
+        + n_local * np.outer(delta, delta)
+    )
+
+    # One payload slot per process ([gram | s | n] flattened on device
+    # slot 0, zeros elsewhere); replicated-sum over a flat device mesh.
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    local_dev = jax.local_device_count()
+    n_dev = len(jax.devices())
+    width = d * d + d
+    payload = np.zeros((local_dev, width), dtype=np.dtype(dtype))
+    payload[0, : d * d] = gram_c.ravel()
+    payload[0, d * d :] = s_c
+
+    flat = Mesh(np.asarray(jax.devices()), ("proc",))
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(flat, P("proc")), payload, (n_dev, width)
+    )
+    out = np.asarray(_replicated_sum_jit(flat)(arr), dtype=np.float64)
+
+    from spark_rapids_ml_tpu.core.moments import ShiftedMoments
+
+    # The exact integer row count rides the HOST counts allgather (already
+    # in hand), never the float device payload — a bf16/f32 payload would
+    # round it.
+    n_tot = int(counts.sum())
+    if n_tot < 2:
+        raise ValueError(
+            f"need at least 2 rows to compute a covariance, got {n_tot}"
+        )
+    acc = ShiftedMoments(d)
+    acc.n_rows = n_tot
+    acc.shift = common
+    acc.sum = out[d * d :].copy()
+    acc.gram = out[: d * d].reshape(d, d).copy()
+    cov, mean = acc.finalize(center=center)
+    return mean, cov, acc.n_rows
+
+
+@_functools.lru_cache(maxsize=4)
+def _replicated_sum_jit(mesh: Mesh):
+    """One cached jitted replicated-sum per flat mesh — a fresh lambda per
+    call would miss the jit cache and recompile every fit."""
+    return jax.jit(
+        lambda a: a.sum(axis=0),
+        out_shardings=NamedSharding(mesh, P()),
+    )
 
 
 __all__ = [

@@ -41,7 +41,7 @@ def main() -> None:
     # Deterministic global dataset; every worker derives the same one and
     # takes a DIFFERENT (deliberately uneven) slice as its local data.
     rng = np.random.default_rng(0)
-    n, d = 1003, 12
+    n, d = 1003, int(os.environ.get("TPUML_TEST_D", "12"))
     x = rng.normal(size=(n, d)) * np.linspace(1.0, 2.0, d) + 100.0
     if os.environ.get("TPUML_TEST_EMPTY_LAST") == "1" and n_proc > 1:
         # Deployment reality: one executor may hold no rows; the fit must
@@ -51,7 +51,9 @@ def main() -> None:
         bounds = np.linspace(0, n, n_proc + 1).astype(int)
     local = x[bounds[pid] : bounds[pid + 1]]
 
-    mesh = dist.global_mesh()
+    shape_env = os.environ.get("TPUML_TEST_MESH_SHAPE")
+    shape = tuple(int(v) for v in shape_env.split(",")) if shape_env else None
+    mesh = dist.global_mesh(shape)
     if os.environ.get("TPUML_TEST_STREAMING") == "1":
         # Stream the local rows as a one-shot generator of small blocks —
         # per-process constant-memory scan + cross-process moment merge.

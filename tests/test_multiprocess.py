@@ -117,6 +117,28 @@ class TestMultiProcess:
         full-dataset oracle in every process."""
         self._run(4)
 
+    def test_4x2_data_model_mesh(self):
+        """VERDICT r2 #4: a 4-process x 2-device fit on a (4, 2)
+        data x model mesh — features sharded across each process's own
+        devices, rows across processes — must match the oracle in every
+        process. d=13 does NOT divide the model axis, so the zero-pad +
+        strip path is genuinely exercised."""
+        self._run(
+            4,
+            extra_env={"TPUML_TEST_MESH_SHAPE": "4,2", "TPUML_TEST_D": "13"},
+        )
+
+    def test_streaming_psum_merge(self):
+        """Streamed multi-process fit with the device-collective moment
+        merge (merge='auto' routes non-dd + mesh to the psum backend)."""
+        self._run(
+            3,
+            extra_env={
+                "TPUML_TEST_STREAMING": "1",
+                "TPUML_TEST_MESH_SHAPE": "6,1",
+            },
+        )
+
     def test_empty_executor_does_not_strand_peers(self):
         """One process holds zero local rows; the fit must still complete
         on every process with the identical oracle-checked model (the
