@@ -44,6 +44,7 @@ def initialize(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[Sequence[int]] = None,
+    heartbeat_timeout_seconds: Optional[int] = None,
 ) -> None:
     """Bring up the jax.distributed runtime for this process (idempotent).
 
@@ -52,6 +53,13 @@ def initialize(
     and from there to JAX's own auto-detection (which covers TPU pods,
     where the runtime publishes the coordinator itself). Call BEFORE any
     other JAX API touches the backend.
+
+    ``heartbeat_timeout_seconds`` (env ``TPUML_HEARTBEAT_TIMEOUT``) bounds
+    FAILURE DETECTION: when a peer process dies mid-job, the surviving
+    processes' next collective raises a distributed-runtime error within
+    roughly this window instead of hanging (jax's default is 100 s). The
+    recovery recipe is relaunch-and-refit — see docs/PARITY.md §5 (the
+    Spark barrier-task retry analogue).
     """
     global _initialized
     if _initialized:
@@ -61,11 +69,20 @@ def initialize(
         num_processes = int(os.environ["TPUML_NUM_PROCESSES"])
     if process_id is None and "TPUML_PROCESS_ID" in os.environ:
         process_id = int(os.environ["TPUML_PROCESS_ID"])
+    if (
+        heartbeat_timeout_seconds is None
+        and "TPUML_HEARTBEAT_TIMEOUT" in os.environ
+    ):
+        heartbeat_timeout_seconds = int(os.environ["TPUML_HEARTBEAT_TIMEOUT"])
+    kwargs = {}
+    if heartbeat_timeout_seconds is not None:
+        kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout_seconds
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
         local_device_ids=local_device_ids,
+        **kwargs,
     )
     _initialized = True
 
@@ -75,6 +92,7 @@ def bringup_executor(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     chip_ordinal: Optional[int] = None,
+    heartbeat_timeout_seconds: Optional[int] = None,
 ) -> None:
     """One-call executor entry for the one-process-per-chip deployment:
     resolve this process's chip (explicit ordinal > Spark task resource >
@@ -95,7 +113,12 @@ def bringup_executor(
         -1 if chip_ordinal is None else chip_ordinal
     )
     pin_process_to_chip(ordinal)
-    initialize(coordinator_address, num_processes, process_id)
+    initialize(
+        coordinator_address,
+        num_processes,
+        process_id,
+        heartbeat_timeout_seconds=heartbeat_timeout_seconds,
+    )
 
 
 def global_mesh(shape: Optional[Tuple[int, int]] = None) -> Mesh:

@@ -54,6 +54,38 @@ def main() -> None:
     shape_env = os.environ.get("TPUML_TEST_MESH_SHAPE")
     shape = tuple(int(v) for v in shape_env.split(",")) if shape_env else None
     mesh = dist.global_mesh(shape)
+    victim = os.environ.get("TPUML_TEST_FAULT_VICTIM")
+    if victim is not None and int(victim) == pid:
+        # Fault injection: this executor dies mid-stream (after two
+        # blocks, before the merge collective) — the hard-kill an OOM
+        # or preemption delivers, with no cleanup.
+        def dying_blocks():
+            for i, start in enumerate(range(0, local.shape[0], 97)):
+                if i == 2:
+                    os._exit(42)
+                yield local[start : start + 97]
+
+        PCA(mesh=mesh).setK(3).fit(dying_blocks())
+        raise AssertionError("victim must have exited")  # pragma: no cover
+    if victim is not None:
+        # Survivor of the fault-injection run: the fit must RAISE a
+        # distributed-runtime error within the (tightened) heartbeat
+        # window — not hang, not return a wrong model.
+        import time
+
+        blocks = (local[i : i + 97] for i in range(0, local.shape[0], 97))
+        t0 = time.monotonic()
+        try:
+            PCA(mesh=mesh).setK(3).fit(blocks)
+        except Exception as e:  # noqa: BLE001 - the assertion IS the raise
+            elapsed = time.monotonic() - t0
+            print(
+                f"SURVIVOR_RAISED {type(e).__name__} after {elapsed:.1f}s: "
+                f"{(str(e).splitlines() or [''])[0][:200]}"
+            )
+            sys.exit(3)
+        print("SURVIVOR_COMPLETED_UNEXPECTEDLY")
+        sys.exit(4)
     if os.environ.get("TPUML_TEST_STREAMING") == "1":
         # Stream the local rows as a one-shot generator of small blocks —
         # per-process constant-memory scan + cross-process moment merge.
