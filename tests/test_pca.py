@@ -323,13 +323,15 @@ class TestRandomizedSolver:
         assert np.all(rand.explainedVariance > 0)
         assert float(np.sum(rand.explainedVariance)) <= 1.0
 
-    def test_mesh_rejects_randomized(self, rng):
+    def test_mesh_randomized_is_a_real_path(self, rng):
+        # Round 3: the mesh restriction is gone — the sketch shards like
+        # the covariance (full coverage in tests/test_wide_features.py).
         from spark_rapids_ml_tpu.feature import PCA
         from spark_rapids_ml_tpu.parallel.mesh import make_mesh
 
-        x = rng.normal(size=(40, 8))
-        with pytest.raises(ValueError, match="single-device"):
-            PCA(mesh=make_mesh((8, 1))).setK(2).setSolver("randomized").fit(x)
+        x = rng.normal(size=(256, 8)) * np.linspace(1, 3, 8)
+        model = PCA(mesh=make_mesh((8, 1))).setK(2).setSolver("randomized").fit(x)
+        assert model.pc.shape == (8, 2)
 
 
 class TestTopkEigenSolver:

@@ -90,8 +90,11 @@ class TestStreamingPCA:
         with pytest.raises(ValueError, match="k must be in"):
             PCA().setK(7).fit(iter([x]))
 
-    def test_randomized_solver_rejects_stream(self, rng):
-        with pytest.raises(ValueError, match="materialized"):
+    def test_randomized_solver_rejects_one_shot_stream(self, rng):
+        # Re-iterable streams are a real sketch path now
+        # (tests/test_wide_features.py); only one-shot generators — which
+        # a multi-pass algorithm cannot re-read — are refused.
+        with pytest.raises(ValueError, match="one-shot"):
             PCA().setK(2).setSolver("randomized").fit(iter([np.ones((4, 3))]))
 
     def test_mesh_stream_fit(self, rng):
