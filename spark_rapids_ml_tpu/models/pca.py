@@ -318,6 +318,16 @@ class PCA(_PCAParams, Estimator, MLReadable):
                 )
             else:
                 wide = num_features(rows) >= self._RANDOMIZED_AUTO_DIM
+                if wide and self.mesh is not None:
+                    # auto must pick a WORKING path: the sketch does not
+                    # shard the model axis, so a 2-D mesh whose model
+                    # axis would pad the features keeps the mesh
+                    # covariance (explicit solver='randomized' raises
+                    # loudly instead).
+                    from spark_rapids_ml_tpu.parallel.mesh import MODEL_AXIS
+
+                    mp = int(self.mesh.shape[MODEL_AXIS])
+                    wide = num_features(rows) % mp == 0
             if wide:
                 return self._fit_randomized(rows)
         mat = RowMatrix(

@@ -76,7 +76,11 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
 
             metadata = P.load_metadata(path, expected_class=cls.__name__)
             est = _set_params_from_metadata(cls(), metadata)
-            est.uid = metadata["uid"]  # DefaultParamsReader restores uid
+            # DefaultParamsReader restores the uid via _resetUid, which
+            # also re-parents the instance params and rebuilds the maps —
+            # a bare `.uid = ...` would orphan every param (pyspark
+            # Params._shouldOwn rejects them afterwards).
+            est._resetUid(metadata["uid"])
             return est
 
     class _TpuCoreModelPersistence(MLReadable):
@@ -104,7 +108,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             metadata = P.load_metadata(path, expected_class=cls.__name__)
             core = cls._core_class().load(_os.path.join(path, "core"))
             model = _set_params_from_metadata(cls(core), metadata)
-            model.uid = metadata["uid"]
+            model._resetUid(metadata["uid"])  # see _TpuEstimatorPersistence.load
             return model
 
     def _set_params_from_metadata(obj, metadata):

@@ -62,13 +62,34 @@ class Param:
 
 class Params:
     """Like pyspark, the value maps (`_paramMap` / `_defaultParamMap`) are
-    keyed by the Param OBJECTS (shared class attributes), not by name —
-    consumers such as persistence writers iterate `p.name for p in map`."""
+    keyed by the Param OBJECTS, not by name — consumers such as
+    persistence writers iterate `p.name for p in map`.
+
+    Pinned to pyspark 3.5 ``pyspark/ml/param/__init__.py`` semantics
+    (VERDICT r2 #5a): ``Params.__init__`` COPIES every class-level Param
+    onto the instance with ``parent = self.uid`` (``_copy_params``), so
+    ``TpuPCA().k is not TpuPCA.k`` and ``param.parent == instance.uid`` —
+    adapter code that assumed shared class-level Param identity would
+    pass a naive stub and break on a real cluster. Param equality stays
+    VALUE equality on (parent, name) (pyspark's ``__eq__``/``__hash__``
+    on ``str(parent) + name``), which is what makes pickled maps work.
+    """
 
     def __init__(self):
         self.uid = f"{type(self).__name__}_{uuid.uuid4().hex[:12]}"
         self._paramMap: Dict[Param, Any] = {}
         self._defaultParamMap: Dict[Param, Any] = {}
+        self._copy_params()
+
+    def _copy_params(self) -> None:
+        """pyspark 3.5 Params.__init__ behavior: instance-owned copies of
+        the class-level Param declarations (parent = this uid)."""
+        for name, cls_param in self._class_params().items():
+            setattr(
+                self,
+                name,
+                Param(self, cls_param.name, cls_param.doc, cls_param.typeConverter),
+            )
 
     @classmethod
     def _dummy(cls) -> "Params":
@@ -76,16 +97,23 @@ class Params:
         dummy.uid = "undefined"
         return dummy
 
-    def _params_by_name(self) -> Dict[str, Param]:
+    @classmethod
+    def _class_params(cls) -> Dict[str, Param]:
         out = {}
-        for klass in type(self).__mro__:
+        for klass in cls.__mro__:
             for name, value in vars(klass).items():
                 if isinstance(value, Param) and name not in out:
                     out[name] = value
         return out
 
+    def _params_by_name(self) -> Dict[str, Param]:
+        # Instance-owned params (getattr resolves the per-instance copy).
+        return {
+            name: getattr(self, name) for name in self._class_params()
+        }
+
     def hasParam(self, name: str) -> bool:
-        return name in self._params_by_name()
+        return name in self._class_params()
 
     def getParam(self, name: str) -> Param:
         try:
@@ -93,8 +121,46 @@ class Params:
         except KeyError as e:
             raise AttributeError(f"no param {name}") from e
 
+    def _shouldOwn(self, param: "Param") -> None:
+        """pyspark 3.5 Params._shouldOwn: 'Validates that the input param
+        belongs to this Params instance' — parent must equal this uid."""
+        if not (param.parent == self.uid and self.hasParam(param.name)):
+            raise ValueError(f"Param {param} does not belong to {self.uid}.")
+
+    def _resolveParam(self, param) -> Param:
+        """pyspark 3.5 Params._resolveParam: a Param is ownership-checked
+        and resolved to the INSTANCE copy; a string goes through
+        getParam; anything else is a TypeError."""
+        if isinstance(param, Param):
+            self._shouldOwn(param)
+            return getattr(self, param.name)
+        if isinstance(param, str):
+            return self.getParam(param)
+        raise TypeError(f"Cannot resolve {param!r} as a param.")
+
     def _resolve(self, param) -> Param:
-        return param if isinstance(param, Param) else self.getParam(param)
+        return self._resolveParam(param)
+
+    def _resetUid(self, newUid: str) -> "Params":
+        """pyspark 3.5 Params._resetUid: 'Changes the uid of this
+        instance. This updates both the stored uid and the parent uid of
+        params and param maps' — the maps must be REBUILT because Param
+        hash/equality include the parent. DefaultParamsReader restores a
+        persisted uid through this, never by assigning ``.uid``."""
+        newUid = str(newUid)
+        self.uid = newUid
+        new_default: Dict[Param, Any] = {}
+        new_map: Dict[Param, Any] = {}
+        for name, param in self._params_by_name().items():
+            new_param = Param(self, param.name, param.doc, param.typeConverter)
+            if param in self._defaultParamMap:
+                new_default[new_param] = self._defaultParamMap[param]
+            if param in self._paramMap:
+                new_map[new_param] = self._paramMap[param]
+            setattr(self, name, new_param)
+        self._defaultParamMap = new_default
+        self._paramMap = new_map
+        return self
 
     def _set(self, **kwargs) -> "Params":
         for name, value in kwargs.items():

@@ -160,8 +160,57 @@ class RDD:
             acc = op(acc, x)
         return acc
 
+    def treeAggregate(self, zeroValue, seqOp, combOp, depth: int = 2):
+        """pyspark 3.5 RDD.treeAggregate(zeroValue, seqOp, combOp,
+        depth=2): 'Aggregates the elements of this RDD in a multi-level
+        tree pattern' — each partition folds from its OWN copy of
+        zeroValue with seqOp, partials tree-merge with combOp, and an
+        empty RDD returns zeroValue (unlike treeReduce, which raises
+        ValueError('Cannot reduce() empty RDD')). Zero value and the ops
+        cross the serialization boundary like any closure."""
+        seqOp = _pickle_roundtrip(seqOp)
+        combOp = _pickle_roundtrip(combOp)
+        partials = []
+        for p in self._parts:
+            acc = _pickle_roundtrip(zeroValue)  # fresh copy per partition
+            for x in p:
+                acc = seqOp(acc, x)
+            partials.append(_pickle_roundtrip(acc))
+        if not partials:
+            return zeroValue
+        acc = partials[0]
+        for x in partials[1:]:
+            acc = combOp(acc, x)
+        return acc
+
     def getNumPartitions(self) -> int:
         return len(self._parts)
+
+
+def _arrow_series(values: list):
+    """pyspark 3.5 pandas_udf input typing (the SQL Arrow serializer,
+    ``pyspark.sql.pandas.serializers.ArrowStreamPandasUDFSerializer``):
+    a ``double`` column arrives as a float64-dtype Series; an
+    ``array<double>`` column arrives as an object-dtype Series whose
+    ELEMENTS are numpy float64 ndarrays (never Python lists) — udf code
+    that assumes list elements passes a naive stub and breaks on a real
+    cluster, so the stub pins Arrow's actual typing."""
+    import numpy as _np
+    import pandas as pd
+
+    if values and all(
+        isinstance(v, (int, float, _np.integer, _np.floating))
+        and not isinstance(v, bool)
+        for v in values
+    ):
+        return pd.Series(_np.asarray(values, dtype=_np.float64))
+    out = [
+        _np.asarray(v, dtype=_np.float64)
+        if isinstance(v, (list, tuple, _np.ndarray))
+        else v
+        for v in values
+    ]
+    return pd.Series(out, dtype=object)
 
 
 class DataFrame:
@@ -195,11 +244,8 @@ class DataFrame:
         if column.kind == "ref":
             i = self._schema.index(column.name)
             return [r[i] for r in part]
-        import pandas as pd
-
         args = [
-            pd.Series(self._eval_column(a, part), dtype=object)
-            for a in column.args
+            _arrow_series(self._eval_column(a, part)) for a in column.args
         ]
         out = column.fn(*args)
         return list(out)

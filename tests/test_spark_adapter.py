@@ -5,15 +5,26 @@ API surface the adapter consumes (with real partition semantics and
 cloudpickle serialization boundaries), so every line of
 ``spark_rapids_ml_tpu.spark.adapter`` executes here — fit on an RDD with
 mapPartitions/treeReduce, Arrow-batch pandas_udf transforms, and
-save/load round-trips (VERDICT r1 item 1, stub alternative).
+save/load round-trips (VERDICT r1 item 1, stub alternative). The test
+classes live in ``tests/spark_contract_suite.py`` and are shared with
+``tests/test_spark_real.py``, which runs the same assertions against
+genuine pyspark when installed.
 """
 
 import importlib
 import os
 import sys
 
-import numpy as np
 import pytest
+
+import spark_contract_suite as _suite
+
+# Pull EVERY Test* class from the shared suite into this module's
+# namespace so pytest collects it here — programmatic, so a class added
+# to the suite can never be silently dropped by a stale import list.
+for _name in dir(_suite):
+    if _name.startswith("Test"):
+        globals()[_name] = getattr(_suite, _name)
 
 _STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)), "pyspark_stub")
 
@@ -47,707 +58,3 @@ def spark_env():
             sys.modules["spark_rapids_ml_tpu.spark.adapter"] = adapter_was
         else:
             sys.modules.pop("spark_rapids_ml_tpu.spark.adapter", None)
-
-
-def _vector_df(spark, x, extra=None, n_parts=3):
-    from pyspark.ml.linalg import Vectors
-
-    cols = ["features"] + (list(extra) if extra else [])
-    rows = []
-    for i in range(x.shape[0]):
-        row = [Vectors.dense(x[i])]
-        if extra:
-            row += [extra[c][i] for c in extra]
-        rows.append(row)
-    return spark.createDataFrame(rows, cols, numPartitions=n_parts)
-
-
-class TestTpuPCA:
-    def test_fit_transform_save_load(self, spark_env, rng, tmp_path):
-        adapter, spark = spark_env
-        x = rng.normal(size=(300, 6)) * np.linspace(1, 2, 6) + 5.0
-        df = _vector_df(spark, x)
-        est = adapter.TpuPCA(k=2, inputCol="features", outputCol="pca")
-        model = est.fit(df)
-
-        # Oracle: numpy eigh of the covariance, sign-invariant.
-        from spark_rapids_ml_tpu.utils.testing import assert_components_close
-
-        cov = np.cov(x, rowvar=False)
-        w, v = np.linalg.eigh(cov)
-        v = v[:, ::-1]
-        pc = np.asarray(model.pc.toArray())
-        assert_components_close(pc, v[:, :2], 1e-9)
-
-        out = model.transform(df)
-        proj = np.stack([np.asarray(r.pca.toArray()) for r in out.collect()])
-        np.testing.assert_allclose(proj, x @ pc, atol=1e-9)
-
-        path = str(tmp_path / "tpupca_model")
-        model._save_impl(path)
-        loaded = adapter.TpuPCAModel.load(path)
-        np.testing.assert_allclose(np.asarray(loaded.pc.toArray()), pc)
-        out2 = loaded.transform(df)
-        proj2 = np.stack([np.asarray(r.pca.toArray()) for r in out2.collect()])
-        np.testing.assert_allclose(proj2, proj)
-
-    def test_estimator_persistence(self, spark_env, tmp_path):
-        adapter, spark = spark_env
-        est = adapter.TpuPCA(k=3, inputCol="features").setGpuId(0)
-        path = str(tmp_path / "tpupca_est")
-        est._save_impl(path)
-        loaded = adapter.TpuPCA.load(path)
-        assert loaded.getOrDefault(loaded.k) == 3
-        assert loaded.getOrDefault(loaded.gpuId) == 0
-
-
-class TestTpuKMeans:
-    def test_distributed_lloyd_clusters(self, spark_env, rng, tmp_path):
-        adapter, spark = spark_env
-        centers_true = np.array([[0.0, 0.0], [8.0, 8.0], [0.0, 8.0]])
-        x = np.concatenate(
-            [c + rng.normal(scale=0.4, size=(80, 2)) for c in centers_true]
-        )
-        df = _vector_df(spark, x)
-        model = adapter.TpuKMeans(k=3).setSeed(1).setMaxIter(20).fit(df)
-        found = np.stack(model.clusterCenters())
-        # Each true center has a found center within a small radius.
-        for c in centers_true:
-            assert np.min(np.linalg.norm(found - c, axis=1)) < 0.3
-
-        out = model.transform(df)
-        preds = np.asarray([r.prediction for r in out.collect()])
-        # Points from one blob share a label.
-        for g in range(3):
-            blob = preds[g * 80 : (g + 1) * 80]
-            assert len(np.unique(blob)) == 1
-
-        path = str(tmp_path / "kmeans_model")
-        model._save_impl(path)
-        loaded = adapter.TpuKMeansModel.load(path)
-        np.testing.assert_allclose(np.stack(loaded.clusterCenters()), found)
-
-
-class TestTpuLinearRegression:
-    def test_distributed_normal_equations(self, spark_env, rng, tmp_path):
-        adapter, spark = spark_env
-        d = 5
-        x = rng.normal(size=(400, d)) + 10.0
-        beta = np.arange(1.0, d + 1.0)
-        y = x @ beta + 2.5 + 0.01 * rng.normal(size=400)
-        df = _vector_df(spark, x, extra={"label": list(y)})
-        model = adapter.TpuLinearRegression().fit(df)
-
-        xi = np.concatenate([x, np.ones((400, 1))], axis=1)
-        ref = np.linalg.lstsq(xi, y, rcond=None)[0]
-        np.testing.assert_allclose(
-            np.asarray(model.coefficients.toArray()), ref[:d], atol=1e-6
-        )
-        assert model.intercept == pytest.approx(ref[d], abs=1e-4)
-
-        out = model.transform(df)
-        preds = np.asarray([r.prediction for r in out.collect()])
-        np.testing.assert_allclose(preds, xi @ ref, atol=1e-3)
-
-        path = str(tmp_path / "linreg_model")
-        model._save_impl(path)
-        loaded = adapter.TpuLinearRegressionModel.load(path)
-        np.testing.assert_allclose(
-            np.asarray(loaded.coefficients.toArray()),
-            np.asarray(model.coefficients.toArray()),
-        )
-
-    def test_rejects_elastic_net(self, spark_env, rng):
-        adapter, spark = spark_env
-        x = rng.normal(size=(20, 2))
-        df = _vector_df(spark, x, extra={"label": list(x.sum(axis=1))})
-        with pytest.raises(ValueError, match="elasticNetParam"):
-            adapter.TpuLinearRegression().setElasticNetParam(0.5).fit(df)
-
-
-class TestTpuLogisticRegression:
-    def test_fit_transform_save_load(self, spark_env, rng, tmp_path):
-        adapter, spark = spark_env
-        x = rng.normal(size=(300, 4))
-        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(float)
-        df = _vector_df(spark, x, extra={"label": list(y)})
-        model = adapter.TpuLogisticRegression().setMaxIter(60).fit(df)
-
-        out = model.transform(df)
-        rows = out.collect()
-        preds = np.asarray([r.prediction for r in rows])
-        assert np.mean(preds == y) > 0.95
-        probs = np.stack([np.asarray(r.probability.toArray()) for r in rows])
-        assert probs.shape == (300, 2)
-        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-6)
-        raw = np.stack([np.asarray(r.rawPrediction.toArray()) for r in rows])
-        assert raw.shape[0] == 300
-
-        path = str(tmp_path / "logreg_model")
-        model._save_impl(path)
-        loaded = adapter.TpuLogisticRegressionModel.load(path)
-        np.testing.assert_allclose(
-            np.asarray(loaded.coefficients.toArray()),
-            np.asarray(model.coefficients.toArray()),
-            atol=1e-12,
-        )
-        out2 = loaded.transform(df)
-        preds2 = np.asarray([r.prediction for r in out2.collect()])
-        np.testing.assert_array_equal(preds2, preds)
-
-
-class TestExecutorMath:
-    """The numpy-only executor forwards must agree with the core (JAX)
-    models bit-for-tolerance — they are what transform ships to executors
-    that have no JAX at all."""
-
-    def test_logistic_forward_matches_core(self, rng):
-        from spark_rapids_ml_tpu.classification import LogisticRegression
-        from spark_rapids_ml_tpu.spark.executor_math import logistic_forward
-
-        x = rng.normal(size=(200, 4))
-        y = (x[:, 0] - x[:, 2] > 0).astype(float)
-        core = LogisticRegression().setMaxIter(40).fit((x, y))
-        raw, probs, pred = logistic_forward(
-            np.asarray(core.weights, dtype=np.float64),
-            np.asarray(core.intercepts, dtype=np.float64),
-            core.getThreshold(),
-            x,
-        )
-        np.testing.assert_allclose(probs, core.predictProbability(x), atol=1e-6)
-        np.testing.assert_allclose(raw, core.predictRaw(x), atol=1e-6)
-        np.testing.assert_array_equal(pred, core.predict(x).astype(float))
-        # raw really is margins: symmetric around zero for binomial.
-        np.testing.assert_allclose(raw[:, 0], -raw[:, 1], atol=1e-12)
-
-    def test_forest_forward_matches_core(self, rng):
-        from spark_rapids_ml_tpu.classification import RandomForestClassifier
-        from spark_rapids_ml_tpu.models.random_forest import _forest_depth
-        from spark_rapids_ml_tpu.spark.executor_math import forest_forward
-
-        x = rng.normal(size=(200, 5))
-        y = ((x[:, 0] > 0) & (x[:, 1] > 0)).astype(float)
-        core = RandomForestClassifier().setNumTrees(8).setMaxDepth(4).setSeed(3).fit((x, y))
-        f = core._forest
-        raw, probs, pred = forest_forward(
-            np.asarray(f.feature),
-            np.asarray(f.threshold, dtype=np.float64),
-            np.asarray(f.is_leaf),
-            np.asarray(f.leaf_value, dtype=np.float64),
-            _forest_depth(f),
-            x,
-        )
-        np.testing.assert_allclose(probs, core.predictProbability(x), atol=1e-6)
-        np.testing.assert_allclose(raw, core.predictRaw(x), atol=1e-5)
-        np.testing.assert_array_equal(pred, core.predict(x).astype(float))
-
-    def test_executor_math_imports_no_jax(self):
-        """Executors must be able to import the module without JAX: verify
-        in a subprocess that blocks the jax import outright."""
-        import subprocess
-        import sys as _sys
-
-        code = (
-            "import sys; sys.path.insert(0, %r); "
-            "sys.modules['jax'] = None; "  # any jax import -> ImportError
-            "import spark_rapids_ml_tpu.spark.executor_math as m; "
-            "import numpy as np; "
-            "r, p, y = m.logistic_forward(np.ones((3, 1)), np.zeros(1), 0.5, np.ones((2, 3))); "
-            "print('NOJAX_OK', p.shape)"
-        ) % os.path.dirname(os.path.dirname(_STUB))
-        out = subprocess.run(
-            [_sys.executable, "-c", code], capture_output=True, text=True
-        )
-        assert out.returncode == 0, out.stderr[-1500:]
-        assert "NOJAX_OK" in out.stdout
-
-
-class TestTpuRandomForest:
-    def test_fit_transform_save_load(self, spark_env, rng, tmp_path):
-        adapter, spark = spark_env
-        x = rng.normal(size=(300, 4))
-        y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(float)  # XOR: needs depth
-        df = _vector_df(spark, x, extra={"label": list(y)})
-        model = (
-            adapter.TpuRandomForestClassifier()
-            .setNumTrees(15)
-            .setMaxDepth(5)
-            .setSeed(0)
-            .fit(df)
-        )
-        assert model.numClasses == 2
-        out = model.transform(df)
-        rows = out.collect()
-        preds = np.asarray([r.prediction for r in rows])
-        assert np.mean(preds == y) > 0.9
-        probs = np.stack([np.asarray(r.probability.toArray()) for r in rows])
-        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
-
-        path = str(tmp_path / "rf_model")
-        model._save_impl(path)
-        loaded = adapter.TpuRandomForestClassificationModel.load(path)
-        out2 = loaded.transform(df)
-        preds2 = np.asarray([r.prediction for r in out2.collect()])
-        np.testing.assert_array_equal(preds2, preds)
-
-
-class TestTpuRandomForestRegressor:
-    def test_fit_transform_save_load(self, spark_env, rng, tmp_path):
-        adapter, spark = spark_env
-        x = rng.uniform(0, 1, size=(300, 3))
-        y = 3.0 * x[:, 0] - 2.0 * x[:, 1]
-        df = _vector_df(spark, x, extra={"label": list(y)})
-        model = (
-            adapter.TpuRandomForestRegressor()
-            .setNumTrees(20)
-            .setMaxDepth(6)
-            .setSeed(0)
-            .fit(df)
-        )
-        out = model.transform(df)
-        preds = np.asarray([r.prediction for r in out.collect()])
-        rmse = float(np.sqrt(np.mean((preds - y) ** 2)))
-        assert rmse < 0.4, rmse
-        # Executor forward must equal the core (JAX) model's predictions.
-        np.testing.assert_allclose(preds, model._core.predict(x), atol=1e-6)
-
-        path = str(tmp_path / "rfr_model")
-        model._save_impl(path)
-        loaded = adapter.TpuRandomForestRegressionModel.load(path)
-        preds2 = np.asarray(
-            [r.prediction for r in loaded.transform(df).collect()]
-        )
-        np.testing.assert_allclose(preds2, preds)
-
-
-class TestDistributedLogistic:
-    def test_distributed_matches_core_optimum(self, spark_env, rng):
-        """The per-iteration executor loss/grad fit (scipy L-BFGS-B on the
-        driver, numpy treeReduce on executors) must land on the same
-        convex optimum as the core single-machine solver."""
-        adapter, spark = spark_env
-        from spark_rapids_ml_tpu.classification import LogisticRegression
-
-        x = rng.normal(size=(400, 5)) + 2.0
-        y = (x[:, 0] - x[:, 1] > 2.0).astype(float)
-        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
-        m_dist = (
-            adapter.TpuLogisticRegression()
-            .setMaxIter(200)
-            .setRegParam(0.01)
-            .fit(df)
-        )
-        m_core = (
-            LogisticRegression().setMaxIter(400).setRegParam(0.01).fit((x, y))
-        )
-        # Tight: both optimize the identical objective (population-std
-        # standardization matches the core scaler exactly).
-        np.testing.assert_allclose(
-            np.asarray(m_dist.coefficients.toArray()),
-            m_core.coefficients,
-            atol=5e-4,
-        )
-        assert m_dist.intercept == pytest.approx(m_core.intercept, abs=5e-3)
-
-    def test_multinomial_distributed(self, spark_env, rng):
-        adapter, spark = spark_env
-        x = rng.normal(size=(450, 4))
-        y = np.argmax(x[:, :3] + 0.3 * rng.normal(size=(450, 3)), axis=1).astype(float)
-        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=3)
-        model = adapter.TpuLogisticRegression().setMaxIter(150).fit(df)
-        preds = np.asarray([r.prediction for r in model.transform(df).collect()])
-        assert np.mean(preds == y) > 0.8
-
-    def test_elastic_net_distributed_quality(self, spark_env, rng):
-        adapter, spark = spark_env
-        x = rng.normal(size=(200, 4))
-        y = (x[:, 0] > 0).astype(float)
-        df = _vector_df(spark, x, extra={"label": list(y)})
-        model = (
-            adapter.TpuLogisticRegression()
-            .setMaxIter(100)
-            .setRegParam(0.05)
-            .setElasticNetParam(0.5)
-            .fit(df)
-        )
-        preds = np.asarray([r.prediction for r in model.transform(df).collect()])
-        assert np.mean(preds == y) > 0.9
-
-    def test_elastic_net_distributed_matches_core_optimum(self, spark_env, rng):
-        """Driver-side FISTA over executor gradient sums optimizes the
-        same strictly convex objective as the core solver — coefficients
-        must agree to optimizer tolerance (VERDICT r2 #3)."""
-        adapter, spark = spark_env
-        from spark_rapids_ml_tpu.classification import LogisticRegression
-
-        x = rng.normal(size=(300, 5))
-        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(float)
-        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
-        m_dist = (
-            adapter.TpuLogisticRegression()
-            .setMaxIter(500)
-            .setRegParam(0.1)
-            .setElasticNetParam(0.5)
-            .fit(df)
-        )
-        m_core = (
-            LogisticRegression()
-            .setMaxIter(500)
-            .setRegParam(0.1)
-            .setElasticNetParam(0.5)
-            .fit((x, y))
-        )
-        np.testing.assert_allclose(
-            np.asarray(m_dist.coefficients.toArray()),
-            m_core.coefficients,
-            atol=2e-3,
-        )
-        assert m_dist.intercept == pytest.approx(m_core.intercept, abs=5e-3)
-        # L1 sparsity must survive the distributed route: both solvers
-        # zero the same noise features (or neither does).
-        dist_zero = np.asarray(m_dist.coefficients.toArray()) == 0
-        core_zero = np.asarray(m_core.coefficients) == 0
-        np.testing.assert_array_equal(dist_zero, core_zero)
-
-    def test_fractional_label_raises(self, spark_env, rng):
-        adapter, spark = spark_env
-        x = rng.normal(size=(60, 3))
-        y = np.where(np.arange(60) == 7, 1.5, (x[:, 0] > 0).astype(float))
-        df = _vector_df(spark, x, extra={"label": list(y)})
-        with pytest.raises(ValueError, match="non-negative integers"):
-            adapter.TpuLogisticRegression().fit(df)
-
-
-class TestNoDriverCollect:
-    """VERDICT r2 #3 done-criterion: instrument the stub RDD and assert
-    the forest / elastic-net fits never collect the dataset to the driver
-    (only the bounded quantile sample for forests)."""
-
-    def _fetch_counter(self):
-        from pyspark.sql import FETCHED_ROWS
-
-        return FETCHED_ROWS
-
-    def test_forest_fit_fetches_only_bounded_sample(
-        self, spark_env, rng, monkeypatch
-    ):
-        adapter, spark = spark_env
-        monkeypatch.setattr(adapter, "_QUANTILE_SAMPLE_CAP", 64)
-        n = 600
-        x = rng.normal(size=(n, 4))
-        y = (x[:, 0] > 0).astype(float)
-        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
-        counter = self._fetch_counter()
-        counter["rows"] = 0
-        model = (
-            adapter.TpuRandomForestClassifier()
-            .setNumTrees(8)
-            .setMaxDepth(3)
-            .fit(df)
-        )
-        # Bernoulli sampling at fraction 64/600 fetches ~64 rows; 3x
-        # headroom still proves no full collect (600 would fail).
-        assert counter["rows"] <= 192, counter["rows"]
-        preds = np.asarray(
-            [r.prediction for r in model.transform(df).collect()]
-        )
-        assert np.mean(preds == y) > 0.9
-
-    def test_forest_regressor_fit_fetches_only_bounded_sample(
-        self, spark_env, rng, monkeypatch
-    ):
-        adapter, spark = spark_env
-        monkeypatch.setattr(adapter, "_QUANTILE_SAMPLE_CAP", 64)
-        n = 500
-        x = rng.uniform(0, 1, size=(n, 3))
-        y = 2.0 * x[:, 0] - x[:, 1]
-        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
-        counter = self._fetch_counter()
-        counter["rows"] = 0
-        adapter.TpuRandomForestRegressor().setNumTrees(10).setMaxDepth(4).fit(df)
-        assert counter["rows"] <= 192, counter["rows"]
-
-    def test_elastic_net_fit_fetches_no_rows(self, spark_env, rng):
-        adapter, spark = spark_env
-        x = rng.normal(size=(400, 4))
-        y = (x[:, 0] > 0).astype(float)
-        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=4)
-        counter = self._fetch_counter()
-        counter["rows"] = 0
-        adapter.TpuLogisticRegression().setMaxIter(50).setRegParam(
-            0.05
-        ).setElasticNetParam(0.5).fit(df)
-        # The only driver fetch allowed is first() probing the width.
-        assert counter["rows"] <= 2, counter["rows"]
-
-
-class TestForestDistributedMatchesCore:
-    def test_no_bootstrap_matches_core_predictions(self, spark_env, rng):
-        """bootstrap=False at rate 1.0 makes the sample weights all-ones
-        on both sides, the quantile sample covers the full (small)
-        dataset, and split selection is literally shared
-        (ops.trees.split_level) — so the distributed adapter fit and the
-        core fit must agree on every training prediction."""
-        adapter, spark = spark_env
-        from spark_rapids_ml_tpu.classification import RandomForestClassifier
-
-        x = rng.normal(size=(240, 4))
-        y = ((x[:, 0] > 0.3) | (x[:, 1] < -0.5)).astype(float)
-        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=3)
-        m_dist = (
-            adapter.TpuRandomForestClassifier()
-            .setNumTrees(6)
-            .setMaxDepth(4)
-            .setBootstrap(False)
-            .setFeatureSubsetStrategy("all")
-            .setSeed(3)
-            .fit(df)
-        )
-        m_core = (
-            RandomForestClassifier()
-            .setNumTrees(6)
-            .setMaxDepth(4)
-            .setBootstrap(False)
-            .setFeatureSubsetStrategy("all")
-            .setSeed(3)
-            .fit((x, y))
-        )
-        preds = np.asarray(
-            [r.prediction for r in m_dist.transform(df).collect()]
-        )
-        np.testing.assert_array_equal(preds, m_core.predict(x))
-
-    def test_regressor_no_bootstrap_matches_core(self, spark_env, rng):
-        adapter, spark = spark_env
-        from spark_rapids_ml_tpu.regression import RandomForestRegressor
-
-        x = rng.uniform(0, 1, size=(200, 3))
-        y = 3.0 * x[:, 0] - 2.0 * x[:, 1] + 0.1 * rng.normal(size=200)
-        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=3)
-        m_dist = (
-            adapter.TpuRandomForestRegressor()
-            .setNumTrees(5)
-            .setMaxDepth(4)
-            .setBootstrap(False)
-            .setFeatureSubsetStrategy("all")
-            .setSeed(1)
-            .fit(df)
-        )
-        m_core = (
-            RandomForestRegressor()
-            .setNumTrees(5)
-            .setMaxDepth(4)
-            .setBootstrap(False)
-            .setFeatureSubsetStrategy("all")
-            .setSeed(1)
-            .fit((x, y))
-        )
-        preds = np.asarray(
-            [r.prediction for r in m_dist.transform(df).collect()]
-        )
-        np.testing.assert_allclose(preds, m_core.predict(x), atol=1e-4)
-
-
-class TestNeighborsAdapters:
-    def test_nearest_neighbors(self, spark_env, rng):
-        adapter, spark = spark_env
-        items = rng.normal(size=(200, 6))
-        df = _vector_df(spark, items)
-        model = adapter.TpuNearestNeighbors(k=4).fit(df)
-        out = model.kneighbors(df)
-        rows = out.collect()
-        idx = np.stack([np.asarray(r.indices) for r in rows]).astype(int)
-        dist = np.stack([np.asarray(r.distances) for r in rows])
-        assert idx.shape == (200, 4)
-        np.testing.assert_array_equal(idx[:, 0], np.arange(200))  # self first
-        np.testing.assert_allclose(dist[:, 0], 0.0, atol=1e-5)
-        # Oracle check on a handful of rows.
-        d2 = ((items[:10, None, :] - items[None]) ** 2).sum(-1)
-        np.testing.assert_array_equal(idx[:10], np.argsort(d2, axis=1)[:, :4])
-
-    def test_approximate_nearest_neighbors(self, spark_env, rng):
-        adapter, spark = spark_env
-        items = rng.normal(size=(300, 8))
-        df = _vector_df(spark, items)
-        model = (
-            adapter.TpuApproximateNearestNeighbors(k=3)
-            .setAlgorithm("ivfflat")
-            .setAlgoParams({"nlist": 6, "nprobe": 6})
-            .fit(df)
-        )
-        out = model.kneighbors(df)
-        rows = out.collect()
-        idx = np.stack([np.asarray(r.indices) for r in rows]).astype(int)
-        assert idx.shape == (300, 3)
-        # nprobe == nlist: exhaustive, so self must be the first hit.
-        np.testing.assert_array_equal(idx[:, 0], np.arange(300))
-
-    def test_kneighbors_empty_partition(self, spark_env, rng):
-        """Empty query partitions (routine after filter/repartition) must
-        not kill the kneighbors job (r2 review)."""
-        adapter, spark = spark_env
-        from pyspark.ml.linalg import Vectors
-        from pyspark.sql import DataFrame as StubDF, Row
-
-        items = rng.normal(size=(50, 4))
-        df = _vector_df(spark, items)
-        model = adapter.TpuNearestNeighbors(k=3).fit(df)
-        rows = [Row(["features"], [Vectors.dense(v)]) for v in items[:10]]
-        lopsided = StubDF(["features"], [rows[:7], [], rows[7:]])
-        out = model.kneighbors(lopsided).collect()
-        assert len(out) == 10
-        idx = np.stack([np.asarray(r.indices) for r in out])
-        assert idx.dtype.kind in "iu" or np.all(idx == idx.astype(int))
-        np.testing.assert_array_equal(idx[:, 0].astype(int), np.arange(10))
-
-
-class TestTpuDBSCANAndUMAP:
-    def test_dbscan(self, spark_env, rng):
-        adapter, spark = spark_env
-        x = np.concatenate(
-            [rng.normal(scale=0.2, size=(50, 3)) + c for c in ([0, 0, 0], [4, 4, 0])]
-            + [rng.uniform(-2, 6, size=(8, 3))]
-        )
-        df = _vector_df(spark, x)
-        model = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
-        preds = np.asarray(
-            [r.prediction for r in model.transform(df).collect()]
-        ).astype(int)
-        # Two dense blobs become two clusters; blob labels are uniform.
-        assert len(set(preds[:50])) == 1 and len(set(preds[50:100])) == 1
-        assert preds[0] != preds[50]
-        np.testing.assert_array_equal(preds, model.labels_)
-
-    def test_umap(self, spark_env, rng):
-        adapter, spark = spark_env
-        x = np.concatenate(
-            [rng.normal(size=(40, 6)) + off for off in (0.0, 12.0)]
-        )
-        df = _vector_df(spark, x)
-        model = (
-            adapter.TpuUMAP()
-            .setNNeighbors(8)
-            .setNEpochs(200)
-            .setSeed(0)
-            .fit(df)
-        )
-        rows = model.transform(df).collect()
-        emb = np.stack([np.asarray(r.embedding.toArray()) for r in rows])
-        assert emb.shape == (80, 2)
-        labels = np.repeat([0, 1], 40)
-        c0, c1 = emb[labels == 0].mean(0), emb[labels == 1].mean(0)
-        spread = np.mean(np.linalg.norm(emb[labels == 0] - c0, axis=1)) + 1e-9
-        assert np.linalg.norm(c0 - c1) / spread > 2.0
-        # Training rows return their FITTED coordinates exactly
-        # (fit_transform semantics through per-partition Arrow batches).
-        np.testing.assert_allclose(emb, model.embedding, atol=1e-12)
-
-    def test_dbscan_umap_persistence(self, spark_env, rng, tmp_path):
-        adapter, spark = spark_env
-        x = np.concatenate(
-            [rng.normal(scale=0.2, size=(40, 3)) + c for c in ([0, 0, 0], [4, 4, 0])]
-        )
-        df = _vector_df(spark, x)
-        db = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
-        p1 = str(tmp_path / "dbscan")
-        db._save_impl(p1)
-        loaded = adapter.TpuDBSCANModel.load(p1)
-        np.testing.assert_array_equal(loaded.labels_, db.labels_)
-        preds = np.asarray([r.prediction for r in loaded.transform(df).collect()])
-        np.testing.assert_array_equal(preds, db.labels_)
-
-        um = adapter.TpuUMAP().setNNeighbors(8).setNEpochs(50).setSeed(0).fit(df)
-        p2 = str(tmp_path / "umap")
-        um._save_impl(p2)
-        lu = adapter.TpuUMAPModel.load(p2)
-        np.testing.assert_allclose(lu.embedding, um.embedding)
-
-    def test_dbscan_lookup_matches_f32_core_storage(self, spark_env, rng, monkeypatch):
-        """The fitted-row lookup hashes at the CORE dtype: a core model
-        storing f32 (no-x64 platforms) must still match incoming f64 rows
-        (r2 review — with x64 on in tests, simulate by downcasting)."""
-        adapter, spark = spark_env
-        x = np.concatenate(
-            [rng.normal(scale=0.2, size=(30, 3)) + c for c in ([0, 0, 0], [4, 4, 0])]
-        )
-        df = _vector_df(spark, x)
-        model = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
-        # Force the f32 storage a no-x64 platform would produce.
-        from spark_rapids_ml_tpu.models.dbscan import DBSCANModel
-
-        # Swap in a core whose STORAGE is genuinely f32 — the ctor casts
-        # to the platform dtype (f64 under the x64 test harness), so the
-        # f32 array is assigned post-construction to emulate the no-x64
-        # platform exactly. The cache keys on core identity, so the swap
-        # rebuilds the lookup.
-        core32 = DBSCANModel(
-            None,
-            model._core.fitted,
-            model._core.labels_,
-            model._core.core_mask_,
-        )
-        core32.fitted = np.asarray(model._core.fitted, dtype=np.float32)
-        assert core32.fitted.dtype == np.float32
-        model._core = core32
-        preds = np.asarray([r.prediction for r in model.transform(df).collect()])
-        np.testing.assert_array_equal(preds, model.labels_)
-
-
-class TestEstimatorPersistence:
-    def test_every_estimator_roundtrips(self, spark_env, tmp_path):
-        """Nine estimator classes round-trip their params here (the
-        DefaultParamsWritable contract); TpuPCA's round-trip is covered by
-        TestTpuPCA.test_estimator_persistence — ten families total."""
-        adapter, spark = spark_env
-        cases = [
-            (adapter.TpuKMeans(k=4).setSeed(7), "k", 4),
-            (adapter.TpuLinearRegression().setRegParam(0.5), "regParam", 0.5),
-            (adapter.TpuLogisticRegression().setMaxIter(33), "maxIter", 33),
-            (adapter.TpuRandomForestClassifier().setNumTrees(9), "numTrees", 9),
-            (adapter.TpuRandomForestRegressor().setMaxDepth(7), "maxDepth", 7),
-            (adapter.TpuDBSCAN().setEps(0.9), "eps", 0.9),
-            (adapter.TpuUMAP().setNNeighbors(11), "nNeighbors", 11),
-            (adapter.TpuNearestNeighbors(k=6), "k", 6),
-            (adapter.TpuApproximateNearestNeighbors(k=7), "k", 7),
-        ]
-        for i, (est, pname, expected) in enumerate(cases):
-            path = str(tmp_path / f"est_{i}")
-            est._save_impl(path)
-            loaded = type(est).load(path)
-            assert loaded.getOrDefault(loaded.getParam(pname)) == expected, type(est)
-
-    def test_model_picklable_after_transform(self, spark_env, rng):
-        """Caching the fitted-row lookup must not break model pickling
-        (Spark broadcasts models to executors) — r2 review."""
-        adapter, spark = spark_env
-        x = np.concatenate(
-            [rng.normal(scale=0.2, size=(30, 3)) + c for c in ([0, 0, 0], [4, 4, 0])]
-        )
-        df = _vector_df(spark, x)
-        model = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
-        model.transform(df).collect()  # builds + caches the lookup
-        import cloudpickle
-
-        clone = cloudpickle.loads(cloudpickle.dumps(model))
-        preds = np.asarray([r.prediction for r in clone.transform(df).collect()])
-        np.testing.assert_array_equal(preds, model.labels_)
-
-    def test_estimator_load_restores_uid(self, spark_env, tmp_path):
-        adapter, spark = spark_env
-        est = adapter.TpuKMeans(k=3)
-        path = str(tmp_path / "uid_est")
-        est._save_impl(path)
-        loaded = adapter.TpuKMeans.load(path)
-        assert loaded.uid == est.uid
-
-    def test_roundtrip_preserves_default_vs_set(self, spark_env, tmp_path):
-        """Defaults must come back as DEFAULTS (isSet False) after a
-        save/load round trip — DefaultParamsReader semantics (r2 review)."""
-        adapter, spark = spark_env
-        est = adapter.TpuKMeans(k=3)  # k set explicitly; maxIter a default
-        path = str(tmp_path / "def_est")
-        est._save_impl(path)
-        loaded = adapter.TpuKMeans.load(path)
-        assert loaded.isSet(loaded.k)
-        assert not loaded.isSet(loaded.maxIter)
-        assert loaded.getOrDefault(loaded.maxIter) == 20

@@ -174,6 +174,15 @@ class TestRandomizedMesh:
         pc_o, _ = _oracle(x, 3)
         assert_components_close(model.pc, pc_o, 1e-4)
 
+    def test_auto_2d_mesh_indivisible_width_falls_back(self, rng, monkeypatch):
+        # auto must pick a WORKING path: wide d that the model axis would
+        # pad keeps the mesh covariance instead of crashing in the sketch.
+        monkeypatch.setattr(PCA, "_RANDOMIZED_AUTO_DIM", 64)
+        x = rng.normal(size=(160, 65)) * np.linspace(1, 2, 65)
+        model = PCA(mesh=make_mesh((4, 2))).setK(3).fit(x)
+        pc_o, _ = _oracle(x, 3)
+        assert_components_close(model.pc, pc_o, 1e-6)
+
     def test_model_axis_padding_rejected(self, rng):
         x = rng.normal(size=(160, 31))  # 31 pads on a model axis of 2
         with pytest.raises(ValueError, match="model axis"):
