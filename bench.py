@@ -73,13 +73,16 @@ def main() -> None:
     rows_per_sec = N_ROWS / elapsed
 
     # WHOLE-FIT MFU accounting, denominated in the covariance GEMM's
-    # 2 n d^2 FLOPs (eigh/mean add ~0 FLOPs but real seconds).
-    # fp32-HIGHEST runs ~6 bf16 MXU passes, so its ceiling is peak/6.
-    from benchmarks.common import PEAK_BF16_TFLOPS
+    # 2 n d^2 FLOPs (eigh/mean add ~0 FLOPs but real seconds). The
+    # fp32-HIGHEST ceiling divisor lives in ONE place —
+    # benchmarks.common._PRECISION_PASSES — shared with every per-config
+    # pct_ceiling figure.
+    from benchmarks.common import PEAK_BF16_TFLOPS, _PRECISION_PASSES
 
     flop = 2.0 * N_ROWS * N_COLS * N_COLS
     tflops = flop / elapsed / 1e12
     peak_bf16 = PEAK_BF16_TFLOPS
+    ceiling = peak_bf16 / _PRECISION_PASSES["highest"]
     print(
         json.dumps(
             {
@@ -88,7 +91,7 @@ def main() -> None:
                 "unit": "rows/s",
                 "vs_baseline": round(rows_per_sec / _baseline_rows_per_sec(), 3),
                 "whole_fit_tflops": round(tflops, 2),
-                "whole_fit_mfu_vs_fp32_highest_ceiling": round(tflops / (peak_bf16 / 6.0), 3),
+                "whole_fit_mfu_vs_fp32_highest_ceiling": round(tflops / ceiling, 3),
                 "whole_fit_mfu_vs_bf16_peak": round(tflops / peak_bf16, 3),
                 "through_estimator_api": True,
             }

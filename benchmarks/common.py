@@ -55,6 +55,28 @@ def _timed(fn: Callable[[], None]) -> float:
     return time.perf_counter() - t0
 
 
+# MXU ceiling divisor per matmul precision: HIGHEST runs ~6 bf16 passes,
+# HIGH 3, DEFAULT 1 (BASELINE.md precision sweep) — the denominator every
+# per-config MFU figure uses (VERDICT r2 #8).
+_PRECISION_PASSES = {"default": 1, "high": 3, "highest": 6}
+
+
+def roofline(flop: float, elapsed: float, precision: str | None = "highest") -> dict:
+    """{tflops, pct_ceiling} for a kernel of ``flop`` FLOPs that took
+    ``elapsed`` seconds at the given matmul precision — so every
+    benchmarked family reports how much of the chip it uses, not just
+    rows/s. ``flop`` should count the DOMINANT documented GEMMs
+    (undercounting auxiliary ops makes the reported MFU conservative).
+    ``precision=None`` emits tflops only (off-accelerator runs, where the
+    MXU ceiling constant does not apply)."""
+    tflops = flop / elapsed / 1e12
+    out = {"tflops": round(tflops, 4 if tflops < 0.1 else 2)}
+    if precision is not None:
+        ceiling = PEAK_BF16_TFLOPS / _PRECISION_PASSES[precision]
+        out["pct_ceiling"] = round(100.0 * tflops / ceiling, 1)
+    return out
+
+
 def emit(metric: str, value: float, unit: str, vs_baseline: float | None = None, **extra) -> None:
     rec = {"metric": metric, "value": round(value, 3), "unit": unit}
     if vs_baseline is not None:

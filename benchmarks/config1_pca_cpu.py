@@ -23,14 +23,16 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
-from benchmarks.common import emit, time_median
+from benchmarks.common import emit, roofline, time_median
+
+N, D = 10_000, 50
 
 
 def main() -> None:
     from spark_rapids_ml_tpu.models.pca import PCA
 
     rng = np.random.default_rng(1)
-    x = rng.normal(size=(10_000, 50))
+    x = rng.normal(size=(N, D))
 
     est = PCA().setK(3).setInputCol("features").setUseGemm(False).setUseCuSolverSVD(False)
 
@@ -38,7 +40,15 @@ def main() -> None:
         est.fit(x)
 
     elapsed = time_median(run)
-    emit("pca_fit_cpu_10kx50_k3", 10_000 / elapsed, "rows/s", wall_s=round(elapsed, 4))
+    # CPU floor: TFLOP/s reported for completeness; precision=None skips
+    # pct_ceiling (the MXU roofline constant does not apply here).
+    emit(
+        "pca_fit_cpu_10kx50_k3",
+        N / elapsed,
+        "rows/s",
+        wall_s=round(elapsed, 4),
+        **roofline(2.0 * N * D * D, elapsed, precision=None),
+    )
 
 
 if __name__ == "__main__":

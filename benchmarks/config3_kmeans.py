@@ -14,7 +14,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import emit, time_median
+from benchmarks.common import emit, roofline, time_median
 
 N, D, K, ITERS = 20_000_000, 16, 100, 10
 
@@ -45,11 +45,16 @@ def main() -> None:
     # lloyd() makes ITERS update passes plus one final assignment pass for
     # the training cost — ITERS+1 full-data distance sweeps in the timing.
     passes = ITERS + 1
+    # Dominant GEMMs: the (n,d)x(d,k) distance matmul every pass plus the
+    # (k,n)x(n,d) one-hot stats matmul on the ITERS update passes; the
+    # argmin/segment bookkeeping is uncounted (conservative MFU).
+    flop = 2.0 * N * D * K * passes + 2.0 * N * K * D * ITERS
     emit(
         "kmeans_20Mx16_k100_10iter",
         N * passes / elapsed,
         "row-iters/s",
         wall_s=round(elapsed, 4),
+        **roofline(flop, elapsed, "highest"),
     )
 
 

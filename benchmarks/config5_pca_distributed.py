@@ -22,7 +22,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import emit, time_amortized
+from benchmarks.common import emit, roofline, time_amortized
 
 BLOCK, D, K = 1_000_000, 1024, 16
 TOTAL_ROWS, N_CHIPS = 100_000_000, 8
@@ -78,6 +78,8 @@ def main() -> None:
         "s",
         chip_rows_per_sec=round(rows_per_sec_chip, 1),
         eigh_1024_s=round(eig_t, 4),
+        # Per-chip roofline of the measured block step (2*rows*d^2).
+        **roofline(2.0 * BLOCK * D * D, block_t, "highest"),
         basis=(
             f"library streamed-mesh block step (centering subtract + "
             f"sharded gram, {BLOCK}x{D}) on 1 chip, x{N_CHIPS} linear DP "

@@ -1,0 +1,55 @@
+"""Config 7: ANN (IVF) search throughput — the neighbor-family headline
+(the modern RAPIDS Spark-ML line's approximateNearestNeighbors; here the
+dense-padded IVF lists with blocked einsum scoring, ops/ann.py).
+
+1M items x 96 dims, 1024 lists, 10k queries probing 32 lists for k=10.
+FLOP accounting covers the dominant GEMMs actually executed: the coarse
+quantizer matmul (2*Q*d*n_lists) plus the PADDED fine scoring
+(2*Q*n_probe*L_max*d — the dense einsum scores padding too; that is the
+price of static shapes on the MXU and the honest FLOP count for MFU).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import emit, roofline, time_amortized
+
+N_ITEMS, D, N_LISTS, N_QUERIES, N_PROBE, K = 1_000_000, 96, 1024, 10_000, 32, 10
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_ml_tpu.ops.ann import build_ivf_index, ivf_search
+
+    rng = np.random.default_rng(7)
+    items = rng.normal(size=(N_ITEMS, D)).astype(np.float32)
+    index = build_ivf_index(items, n_lists=N_LISTS, seed=0)
+    queries = jax.random.normal(jax.random.key(1), (N_QUERIES, D), dtype=jnp.float32)
+    float(jnp.sum(queries[0]))
+
+    def dispatch():
+        d2, idx = ivf_search(index, queries, k=K, n_probe=N_PROBE)
+        return d2
+
+    elapsed = time_amortized(dispatch, lambda d2: float(d2[0, 0]), inner=3)
+    l_max = int(index.lists.shape[1])
+    flop = 2.0 * N_QUERIES * D * N_LISTS + 2.0 * N_QUERIES * N_PROBE * l_max * D
+    emit(
+        "ann_ivf_search_1Mx96_q10k_np32",
+        N_QUERIES / elapsed,
+        "queries/s",
+        wall_s=round(elapsed, 4),
+        l_max=l_max,
+        **roofline(flop, elapsed, "highest"),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,8 @@ CONFIGS = [
     ("config3_kmeans.py", {}),
     ("config4_linreg.py", {}),
     ("config5_pca_distributed.py", {}),
+    ("config6_pca_transform.py", {}),
+    ("config7_ann_search.py", {}),
 ]
 
 

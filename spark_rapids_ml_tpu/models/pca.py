@@ -455,6 +455,7 @@ class PCAModel(_PCAParams, Model):
         self._ev_raw = explainedVariance
         self._pc_np: Optional[np.ndarray] = None
         self._ev_np: Optional[np.ndarray] = None
+        self._pc_dev_cache: dict = {}
 
     @property
     def pc(self) -> Optional[np.ndarray]:
@@ -506,13 +507,10 @@ class PCAModel(_PCAParams, Model):
             # result stays on device (the symmetric counterpart of the
             # device-resident fit; the batched path the reference disabled,
             # RapidsPCA.scala:172-185).
-            import jax.numpy as jnp
-
             from spark_rapids_ml_tpu.ops.linalg import project_rows
 
-            pc_dev = jnp.asarray(self._pc_raw).astype(rows.dtype)
             with TraceRange("device transform", TraceColor.GREEN):
-                return project_rows(rows, pc_dev)
+                return project_rows(rows, self._pc_device(rows.dtype))
 
         if is_streaming_source(rows):
             # Streaming in, streaming out: project block by block at
@@ -556,6 +554,16 @@ class PCAModel(_PCAParams, Model):
         except ImportError:  # pragma: no cover
             pass
         return projected
+
+    def _pc_device(self, dtype):
+        """Components as a device array at ``dtype``, cached — repeated
+        device transforms must not pay a host->device copy per call."""
+        import jax.numpy as jnp
+
+        key = str(dtype)
+        if key not in self._pc_dev_cache:
+            self._pc_dev_cache[key] = jnp.asarray(self._pc_raw).astype(dtype)
+        return self._pc_dev_cache[key]
 
     # --- persistence (RapidsPCA.scala:207-255) ---
 
