@@ -109,6 +109,22 @@ class TestEstimator:
         d, idx = m.kneighbors(items[:10])
         np.testing.assert_array_equal(idx[:, 0], np.arange(10))
 
+    def test_brute_approx_algorithm(self, rng):
+        # Dense MXU scoring + hardware approximate top-k — exact on the
+        # CPU backend, so it must agree with brute here.
+        items = rng.normal(size=(300, 6)).astype(np.float32)
+        ma = (
+            ApproximateNearestNeighbors()
+            .setK(4)
+            .setAlgorithm("brute_approx")
+            .fit(items)
+        )
+        mb = ApproximateNearestNeighbors().setK(4).setAlgorithm("brute").fit(items)
+        da, ia = ma.kneighbors(items[:25])
+        db, ib = mb.kneighbors(items[:25])
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_allclose(da, db, atol=1e-6)
+
     def test_cosine_metric(self, rng):
         items = rng.normal(size=(200, 8)).astype(np.float32)
         m = (
