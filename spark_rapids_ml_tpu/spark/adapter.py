@@ -1541,9 +1541,15 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
         pass
 
     class TpuApproximateNearestNeighbors(_TpuNeighborsBase):
-        """ANN (ivfflat | ivfpq) — the modern spark-rapids-ml ANN family."""
+        """ANN — the modern spark-rapids-ml ANN family. Algorithms pass
+        through to the core model: ivfflat | ivfpq | brute |
+        brute_approx (the TPU-first hardware-top-k winner at
+        single-chip scales — BASELINE.md config 7)."""
 
-        algorithm = Param(Params._dummy(), "algorithm", "ivfflat|ivfpq", TypeConverters.toString)
+        algorithm = Param(
+            Params._dummy(), "algorithm",
+            "ivfflat|ivfpq|brute|brute_approx", TypeConverters.toString,
+        )
         algoParams = Param(Params._dummy(), "algoParams", "algorithm parameters", TypeConverters.identity)
 
         def __init__(self, k=5, inputCol="features"):

@@ -654,6 +654,19 @@ class TestNeighborsAdapters:
         # nprobe == nlist: exhaustive, so self must be the first hit.
         np.testing.assert_array_equal(idx[:, 0], np.arange(300))
 
+    def test_ann_brute_approx_algorithm(self, spark_env, rng):
+        adapter, spark = spark_env
+        items = rng.normal(size=(200, 6))
+        df = _vector_df(spark, items)
+        model = (
+            adapter.TpuApproximateNearestNeighbors(k=3)
+            .setAlgorithm("brute_approx")
+            .fit(df)
+        )
+        rows = model.kneighbors(df).collect()
+        idx = np.stack([np.asarray(r.indices) for r in rows]).astype(int)
+        np.testing.assert_array_equal(idx[:, 0], np.arange(200))
+
     def test_kneighbors_empty_partition(self, spark_env, rng):
         """Empty query partitions (routine after filter/repartition) must
         not kill the kneighbors job (r2 review)."""
