@@ -13,8 +13,9 @@ config 7): at 1M items × 96 dims, ``brute_approx`` answers 10k queries
 ~4.4× faster than ivfflat at 0.995 recall (41.4k vs 9.4k queries/s) —
 TPU gathers are scalarized while dense GEMMs ride the systolic array, so
 the inverted-list structure that wins on GPUs loses here until item
-counts far exceed single-chip HBM. Under a mesh, ``brute_approx``
-currently runs the exact sharded kernel (a strict recall upgrade).
+counts far exceed single-chip HBM. Under a mesh, ``brute_approx`` runs
+the hardware per-shard top-k with an exact cross-shard merge
+(``ops/knn.knn_sharded(approx=True)``).
 
 Metrics: ``euclidean`` / ``sqeuclidean`` natively; ``cosine`` by
 L2-normalizing items and queries, under which cosine distance equals half
@@ -354,6 +355,7 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
                     d2_j, idx = knn_sharded(
                         jnp.asarray(q, dtype=xs.dtype), xs, mask, self.mesh,
                         k=k,
+                        approx=self.getAlgorithm() == "brute_approx",
                     )
                 else:
                     d2_j, idx = knn(

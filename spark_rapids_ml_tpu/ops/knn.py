@@ -193,7 +193,7 @@ def shard_items(items, mesh, metric: str = "euclidean") -> Tuple[jax.Array, jax.
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_knn_fn(mesh, k: int, n_shard: int, precision: str):
+def _sharded_knn_fn(mesh, k: int, n_shard: int, precision: str, approx: bool = False):
     """Build (and cache) the jitted shard_map program for one
     (mesh, k, shard-size, precision) combination — jit's cache is keyed on
     the function object, so the closure must not be rebuilt per call."""
@@ -211,8 +211,14 @@ def _sharded_knn_fn(mesh, k: int, n_shard: int, precision: str):
         q_sq = jnp.sum(q * q, axis=1)
         d2 = _block_sq_distances(q, x_blk, q_sq, prec)
         d2 = jnp.where(m_blk[None, :] > 0, d2, jnp.inf)
-        neg_top, i_loc = lax.top_k(-d2, k_loc)
-        d_loc = -neg_top
+        if approx:
+            # Hardware partial-reduce per shard; the all-gathered
+            # candidate merge below stays exact (same contract as the
+            # single-device approx path in knn_sq_euclidean).
+            d_loc, i_loc = lax.approx_min_k(d2, k_loc)
+        else:
+            neg_top, i_loc = lax.top_k(-d2, k_loc)
+            d_loc = -neg_top
         i_glob = i_loc + shard_i * n_shard
         # (n_dev, nq, k) candidates on every device.
         cand_d = lax.all_gather(d_loc, DATA_AXIS)
@@ -244,9 +250,11 @@ def knn_sharded(
     k: int,
     precision: str = "highest",
     metric: str = "sqeuclidean",
+    approx: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Mesh path: items row-sharded P(data) (see :func:`shard_items`),
-    queries replicated.
+    queries replicated. ``approx``: hardware approximate per-shard top-k
+    (see :func:`knn_sq_euclidean`); the cross-shard merge stays exact.
 
     Each device computes its shard's local (nq, k) top-k, candidates are
     all-gathered over ICI (k per shard per query — tiny), and one final
@@ -265,7 +273,7 @@ def knn_sharded(
             jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30
         )
     n_shard = items.shape[0] // mesh.shape[DATA_AXIS]
-    fn = _sharded_knn_fn(mesh, k, n_shard, precision)
+    fn = _sharded_knn_fn(mesh, k, n_shard, precision, approx)
     d2, idx = fn(queries, items, item_mask)
     if metric == "euclidean":
         return jnp.sqrt(d2), idx

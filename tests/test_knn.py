@@ -105,6 +105,20 @@ class TestOps:
         # indices are directly comparable to the unsharded oracle.
         np.testing.assert_array_equal(np.asarray(idx), idx_ref)
 
+    def test_sharded_approx_matches_exact_on_cpu(self, rng):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.knn import shard_items
+
+        mesh = make_mesh((8, 1))
+        q = rng.normal(size=(9, 5)).astype(np.float64)
+        x = rng.normal(size=(170, 5)).astype(np.float64)
+        xs, mask = shard_items(x, mesh)
+        d_ex, i_ex = knn_sharded(jnp.asarray(q), xs, mask, mesh, k=4)
+        d_ap, i_ap = knn_sharded(jnp.asarray(q), xs, mask, mesh, k=4, approx=True)
+        np.testing.assert_array_equal(np.asarray(i_ap), np.asarray(i_ex))
+        np.testing.assert_allclose(np.asarray(d_ap), np.asarray(d_ex), atol=1e-10)
+
 
 class TestEstimator:
     def test_fit_kneighbors(self, rng):

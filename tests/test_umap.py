@@ -109,6 +109,24 @@ class TestOps:
         assert np.all(np.asarray(dists) > 0)
 
 
+class TestBuildAlgo:
+    def test_brute_approx_build_matches_exact_on_cpu(self, rng):
+        # approx_min_k is exact on the CPU backend, so the approximate
+        # graph build must give the identical embedding here; on TPU it
+        # trades ~0.5% neighbor recall for the hardware top-k.
+        x = rng.normal(size=(120, 6)).astype(np.float32)
+        e1 = np.asarray(UMAP().setNEpochs(20).setSeed(1).fit(x).transform(x))
+        e2 = np.asarray(
+            UMAP().setNEpochs(20).setSeed(1).setBuildAlgo("brute_approx")
+            .fit(x).transform(x)
+        )
+        np.testing.assert_allclose(e1, e2, atol=1e-5)
+
+    def test_invalid_build_algo_rejected(self):
+        with pytest.raises(ValueError, match="buildAlgo"):
+            UMAP().setBuildAlgo("nn_descent")
+
+
 class TestUMAP:
     def test_blobs_separate(self, rng):
         x, labels = _three_blobs(rng)
