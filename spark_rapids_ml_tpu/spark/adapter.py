@@ -1680,11 +1680,17 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
         nEpochs = Param(Params._dummy(), "nEpochs", "optimization epochs (0 = auto)", TypeConverters.toInt)
         seed = Param(Params._dummy(), "seed", "random seed", TypeConverters.toInt)
         outputCol = Param(Params._dummy(), "outputCol", "embedding column", TypeConverters.toString)
+        buildAlgo = Param(
+            Params._dummy(), "buildAlgo",
+            "kNN graph build: brute (exact) | brute_approx (hardware top-k)",
+            TypeConverters.toString,
+        )
 
         def __init__(self, featuresCol="features", outputCol="embedding"):
             super().__init__()
             self._setDefault(
                 nNeighbors=15, nComponents=2, nEpochs=0, seed=0,
+                buildAlgo="brute",
                 featuresCol="features", labelCol="label",
                 predictionCol="prediction", outputCol="embedding",
             )
@@ -1705,6 +1711,9 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
         def setOutputCol(self, value):
             return self._set(outputCol=value)
 
+        def setBuildAlgo(self, value):
+            return self._set(buildAlgo=value)
+
         def _fit(self, dataset):
             from spark_rapids_ml_tpu.manifold import UMAP
 
@@ -1714,6 +1723,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 .setNComponents(self.getOrDefault(self.nComponents))
                 .setNEpochs(self.getOrDefault(self.nEpochs))
                 .setSeed(self.getOrDefault(self.seed))
+                .setBuildAlgo(self.getOrDefault(self.buildAlgo))
                 .fit(_collect_features(dataset, self.getOrDefault(self.featuresCol)))
             )
             model = TpuUMAPModel(core)

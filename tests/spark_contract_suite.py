@@ -703,6 +703,21 @@ class TestTpuDBSCANAndUMAP:
         assert preds[0] != preds[50]
         np.testing.assert_array_equal(preds, model.labels_)
 
+    def test_umap_build_algo_passthrough(self, spark_env, rng):
+        adapter, spark = spark_env
+        x = rng.normal(size=(60, 5))
+        df = _vector_df(spark, x)
+        model = (
+            adapter.TpuUMAP()
+            .setNEpochs(20)
+            .setBuildAlgo("brute_approx")
+            .fit(df)
+        )
+        emb = np.stack(
+            [np.asarray(r.embedding.toArray()) for r in model.transform(df).collect()]
+        )
+        assert emb.shape == (60, 2) and np.isfinite(emb).all()
+
     def test_umap(self, spark_env, rng):
         adapter, spark = spark_env
         x = np.concatenate(
