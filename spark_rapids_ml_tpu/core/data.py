@@ -319,8 +319,27 @@ def is_streaming_source(data: Any) -> bool:
     if callable(getattr(data, "iter_blocks", None)):
         return True
     if callable(data) and not isinstance(data, type):
-        return True
+        return _is_zero_arg_callable(data)
     return False
+
+
+def _is_zero_arg_callable(fn: Any) -> bool:
+    """True when ``fn()`` is callable without arguments — the iterator-
+    factory contract. A callable that REQUIRES arguments is not a stream
+    factory; classifying it as one would die later inside the multi-pass
+    paths with an opaque TypeError, so probe the signature up front
+    (builtins without introspectable signatures pass through as factories)."""
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):  # no introspectable signature
+        return True
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY):
+            if p.default is p.empty:
+                return False
+    return True
 
 
 def is_reiterable_stream(data: Any) -> bool:
@@ -332,7 +351,11 @@ def is_reiterable_stream(data: Any) -> bool:
         return True
     from collections.abc import Iterator
 
-    return callable(data) and not isinstance(data, (type, Iterator))
+    return (
+        callable(data)
+        and not isinstance(data, (type, Iterator))
+        and _is_zero_arg_callable(data)
+    )
 
 
 def peek_stream_width(data: Any) -> int:

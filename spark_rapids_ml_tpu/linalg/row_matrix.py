@@ -351,19 +351,9 @@ class RowMatrix:
         x = self._device_x
         if self.mesh is None:
             return x
-        from jax.sharding import NamedSharding, PartitionSpec
+        from spark_rapids_ml_tpu.parallel.mesh import device_array_rows_on_mesh
 
-        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
-
-        dp = int(self.mesh.shape[DATA_AXIS])
-        if x.shape[0] % dp != 0:
-            raise ValueError(
-                f"device-array input with a mesh needs rows divisible by "
-                f"the data axis ({dp}), got {x.shape[0]}; pad/trim the "
-                f"array or pass host partitions (which pad with masking)"
-            )
-        sharding = NamedSharding(self.mesh, PartitionSpec(DATA_AXIS, None))
-        return jax.device_put(x, sharding)
+        return device_array_rows_on_mesh(x, self.mesh)
 
     def _covariance_gemm(self, mean: jnp.ndarray) -> jnp.ndarray:
         """Per-partition fused centered Gram + host partial sum (:168-201)."""

@@ -324,9 +324,9 @@ class PCA(_PCAParams, Estimator, MLReadable):
                     # axis would pad the features keeps the mesh
                     # covariance (explicit solver='randomized' raises
                     # loudly instead).
-                    from spark_rapids_ml_tpu.parallel.mesh import MODEL_AXIS
+                    from spark_rapids_ml_tpu.parallel.mesh import model_axis_size
 
-                    mp = int(self.mesh.shape[MODEL_AXIS])
+                    mp = model_axis_size(self.mesh)
                     wide = num_features(rows) % mp == 0
             if wide:
                 return self._fit_randomized(rows)
@@ -392,6 +392,28 @@ class PCA(_PCAParams, Estimator, MLReadable):
             if not 1 <= k <= min(n, d):
                 raise ValueError(f"k must be in [1, {min(n, d)}], got {k}")
             x = rows
+            if self.mesh is not None:
+                # An explicit mesh must never be silently dropped (the
+                # stance of RowMatrix._device_array_on_mesh): shard onto
+                # the mesh so the sketch GEMMs run under GSPMD. Same
+                # constraint as the host-partitions branch below: the
+                # sketch cannot PAD the model axis, so features must
+                # divide it exactly (mp=1 always does).
+                from spark_rapids_ml_tpu.parallel.mesh import (
+                    device_array_rows_on_mesh,
+                    model_axis_size,
+                )
+
+                mp = model_axis_size(self.mesh)
+                if d % mp != 0:
+                    raise ValueError(
+                        "the randomized solver does not shard the model "
+                        f"axis (features {d} would pad to a multiple of "
+                        f"{mp}); use a (dp, 1) mesh or solver='covariance'"
+                    )
+                x = device_array_rows_on_mesh(
+                    x, self.mesh, shard_features=mp > 1
+                )
         elif self.mesh is not None:
             from spark_rapids_ml_tpu.parallel.mesh import (
                 shard_rows_from_partitions,
@@ -456,6 +478,21 @@ class PCAModel(_PCAParams, Model):
         self._pc_np: Optional[np.ndarray] = None
         self._ev_np: Optional[np.ndarray] = None
         self._pc_dev_cache: dict = {}
+
+    def __getstate__(self):
+        """Pickle the HOST float64 views, never live device buffers: a
+        device-fitted model crossing a process boundary (Spark broadcast,
+        cloudpickle UDF closure) must not drag a jax.Array along."""
+        state = dict(self.__dict__)
+        state["_pc_raw"] = self.pc
+        state["_ev_raw"] = self.explainedVariance
+        state["_pc_np"] = state["_pc_raw"]
+        state["_ev_np"] = state["_ev_raw"]
+        state["_pc_dev_cache"] = {}
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
     @property
     def pc(self) -> Optional[np.ndarray]:

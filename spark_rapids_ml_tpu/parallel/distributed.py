@@ -34,7 +34,12 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    model_axis_size,
+)
 
 _initialized = False
 
@@ -187,7 +192,7 @@ def shard_rows_process_local(
     n_proc = jax.process_count()
     local_dev = jax.local_device_count()
     dp = mesh.shape[DATA_AXIS]
-    mp = mesh.shape[MODEL_AXIS]
+    mp = model_axis_size(mesh)
     if dp * mp != n_proc * local_dev:
         raise ValueError(
             f"mesh {dp}x{mp} != process_count*local_devices "
@@ -217,7 +222,9 @@ def shard_rows_process_local(
     mask_local = np.zeros(per_proc, dtype=np_dtype)
     mask_local[:n_local] = 1.0
 
-    x_sharding = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+    from spark_rapids_ml_tpu.parallel.mesh import row_sharding
+
+    x_sharding = row_sharding(mesh)  # handles meshes without a model axis
     m_sharding = NamedSharding(mesh, P(DATA_AXIS))
     xs = jax.make_array_from_process_local_data(
         x_sharding, x_local, (per_proc * n_proc, d_tot)

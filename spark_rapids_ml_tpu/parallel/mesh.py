@@ -48,9 +48,43 @@ def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     return Mesh(np.asarray([device]).reshape(1, 1), (DATA_AXIS, MODEL_AXIS))
 
 
+def model_axis_size(mesh: Mesh) -> int:
+    """Size of the model axis, treating a mesh WITHOUT one (a pure-DP
+    1-axis mesh) as model=1 — every consumer that indexes
+    ``mesh.shape[MODEL_AXIS]`` directly KeyErrors on such meshes."""
+    return int(mesh.shape.get(MODEL_AXIS, 1))
+
+
 def row_sharding(mesh: Mesh) -> NamedSharding:
-    """Rows over the data axis, features over the model axis."""
-    return NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+    """Rows over the data axis, features over the model axis (features
+    unsharded when the mesh has no model axis)."""
+    if MODEL_AXIS in mesh.shape:
+        return NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def device_array_rows_on_mesh(x, mesh: Mesh, shard_features: bool = False):
+    """Reshard a DEVICE-RESIDENT (n, d) array row-wise over the mesh's
+    data axis (an explicit mesh must never be silently dropped). Unlike
+    host partitions — which pad with masking — a live device array is
+    not copied into padded form, so rows must divide the data axis (and,
+    with ``shard_features``, features the model axis)."""
+    dp = int(mesh.shape[DATA_AXIS])
+    if x.shape[0] % dp != 0:
+        raise ValueError(
+            f"device-array input with a mesh needs rows divisible by "
+            f"the data axis ({dp}), got {x.shape[0]}; pad/trim the "
+            f"array or pass host partitions (which pad with masking)"
+        )
+    if shard_features and MODEL_AXIS in mesh.shape:
+        mp = int(mesh.shape[MODEL_AXIS])
+        if x.shape[1] % mp != 0:
+            raise ValueError(
+                f"device-array input with shard_features needs features "
+                f"divisible by the model axis ({mp}), got {x.shape[1]}"
+            )
+        return jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)))
+    return jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -91,7 +125,7 @@ def shard_rows_from_partitions(partitions, mesh: Mesh, dtype=None):
     n = sum(p.shape[0] for p in partitions)
     d = partitions[0].shape[1]
     dp = mesh.shape[DATA_AXIS]
-    mp = mesh.shape[MODEL_AXIS]
+    mp = model_axis_size(mesh)
     n_tot = n + ((-n) % dp)
     d_tot = d + ((-d) % mp)
     rows_per = n_tot // dp
@@ -120,7 +154,7 @@ def shard_rows_from_partitions(partitions, mesh: Mesh, dtype=None):
     x_sharding = row_sharding(mesh)
     m_sharding = NamedSharding(mesh, P(DATA_AXIS))
     x_shards, m_shards = [], []
-    mesh_devs = mesh.devices  # (dp, mp) array of devices
+    mesh_devs = np.asarray(mesh.devices).reshape(dp, mp)
     for di in range(dp):
         block = rows_slice(di * rows_per, (di + 1) * rows_per)
         mask_blk = np.zeros(rows_per, dtype=np_dtype)

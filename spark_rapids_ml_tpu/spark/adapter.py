@@ -1189,10 +1189,28 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             # findSplits samples the same way); same quantile definition
             # as ops.trees.quantize_features, at the core's f32.
             n_bins = min(max_bins, max(2, n))
-            fraction = _QUANTILE_SAMPLE_CAP / n
-            sampled = rdd if fraction >= 1.0 else rdd.sample(False, fraction, seed)
-            sample_rows = sampled.collect()
-            if not sample_rows:  # pathological sample draw: fall back
+            # One-pass uniform bounded draw. Plain sample().collect() only
+            # bounds the driver fetch in EXPECTATION; truncating the
+            # overdraw with take() would drop rows from the trailing
+            # partitions (a systematic bias on range-partitioned data);
+            # takeSample would fix both but runs its own count() job over
+            # the full dataset even though the treeReduce above already
+            # produced n. So: Bernoulli-draw at a modestly inflated
+            # fraction (one pass, rows cross the wire ~1.2×cap), then
+            # subsample UNIFORMLY to the cap driver-side — the retained
+            # sample is strictly bounded and unbiased.
+            if n <= _QUANTILE_SAMPLE_CAP:
+                sample_rows = rdd.collect()
+            else:
+                fraction = min(1.0, 1.2 * _QUANTILE_SAMPLE_CAP / n)
+                drawn = rdd.sample(False, fraction, seed).collect()
+                if len(drawn) > _QUANTILE_SAMPLE_CAP:
+                    pick = np.random.default_rng(seed).choice(
+                        len(drawn), size=_QUANTILE_SAMPLE_CAP, replace=False
+                    )
+                    drawn = [drawn[i] for i in pick]
+                sample_rows = drawn
+            if not sample_rows:  # pathological draw: fall back
                 sample_rows = rdd.take(min(n, _QUANTILE_SAMPLE_CAP))
             sx = np.stack(
                 [np.asarray(r[0].toArray(), dtype=np.float64) for r in sample_rows]

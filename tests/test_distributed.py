@@ -38,6 +38,29 @@ class TestShardRows:
         assert xs.shape == (16, 4)
         assert float(np.asarray(mask).sum()) == 13.0
 
+    def test_data_only_mesh(self, rng):
+        # A 1-axis (pure-DP) mesh must work end to end: shard_rows,
+        # shard_rows_process_local, and the PCA mesh fit all used to
+        # KeyError/ValueError on mesh.shape['model'].
+        from jax.sharding import Mesh
+
+        from spark_rapids_ml_tpu.parallel.distributed import (
+            shard_rows_process_local,
+        )
+        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+        mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
+        x = rng.normal(size=(13, 4))
+        xs, mask, n = shard_rows(x, mesh)
+        assert n == 13 and xs.shape == (16, 4)
+        xs2, mask2, n2, d2 = shard_rows_process_local([x], mesh)
+        assert n2 == 13 and d2 == 4
+        model = PCA(mesh=mesh).setK(2).fit(x)
+        oracle = PCA().setK(2).fit(x)
+        from spark_rapids_ml_tpu.utils.testing import assert_components_close
+
+        assert_components_close(model.pc, oracle.pc, 1e-8)
+
 
 class TestDistributedCovariance:
     def test_gspmd_matches_numpy(self, rng, mesh_8x1):

@@ -29,6 +29,20 @@ class TestStreamingSourceDetection:
         assert not is_streaming_source([x, x])
         assert not is_streaming_source("nope")
 
+    def test_callable_requiring_args_is_not_a_factory(self, rng):
+        # ADVICE r3: a callable that NEEDS arguments is not a zero-arg
+        # iterator factory — classifying it as one routes it into
+        # multi-pass paths that die with an opaque TypeError.
+        from spark_rapids_ml_tpu.core.data import is_reiterable_stream
+
+        needs_arg = lambda path: iter([])  # noqa: E731
+        assert not is_streaming_source(needs_arg)
+        assert not is_reiterable_stream(needs_arg)
+        # Defaults-only callables remain factories.
+        with_default = lambda n=2: iter([rng.normal(size=(n, 3))])  # noqa: E731
+        assert is_streaming_source(with_default)
+        assert is_reiterable_stream(with_default)
+
     def test_iter_stream_blocks_factory_fresh(self, rng):
         x = rng.normal(size=(4, 2))
         factory = lambda: iter([x, x])  # noqa: E731
