@@ -17,6 +17,11 @@ from typing import Callable
 # this repo (bench.py and the precision sweep must agree on it).
 PEAK_BF16_TFLOPS = 197.0
 
+# v5e HBM bandwidth — the denominator of the BYTES roofline (VERDICT r3
+# #2: FLOP MFU is the wrong lens for memory-bound shapes; every config
+# reports its fraction of BOTH ceilings).
+HBM_BW_GBPS = 819.0
+
 
 def time_median(fn: Callable[[], None], repeats: int = 3) -> float:
     """Median wall-clock of ``fn`` over ``repeats`` runs (after 1 warmup)."""
@@ -75,6 +80,26 @@ def roofline(flop: float, elapsed: float, precision: str | None = "highest") -> 
         ceiling = PEAK_BF16_TFLOPS / _PRECISION_PASSES[precision]
         out["pct_ceiling"] = round(100.0 * tflops / ceiling, 1)
     return out
+
+
+def bytes_roofline(bytes_moved: float, elapsed: float) -> dict:
+    """{gb_moved, gbps, pct_hbm_roofline} for a kernel that must move
+    ``bytes_moved`` bytes of HBM traffic in ``elapsed`` seconds.
+
+    ``bytes_moved`` should count the MINIMUM required traffic of the
+    algorithm (each input read once per documented pass + outputs written
+    once) — so pct_hbm_roofline reads as "fraction of the no-waste ideal":
+    100% means the schedule is at the bytes bound; a low number with high
+    MFU means the shape is compute-bound, and a low number with low MFU
+    means there is schedule headroom (temporaries, relayouts) to attack.
+    """
+    gb = bytes_moved / 1e9
+    bw = gb / elapsed
+    return {
+        "gb_moved": round(gb, 2),
+        "gbps": round(bw, 1),
+        "pct_hbm_roofline": round(100.0 * bw / HBM_BW_GBPS, 1),
+    }
 
 
 def emit(metric: str, value: float, unit: str, vs_baseline: float | None = None, **extra) -> None:

@@ -1,10 +1,25 @@
 """BASELINE config 3: KMeans k=100 on a 20M-row NYC-Taxi-shaped dataset.
 
 Synthetic 20M x 16 float32 (taxi feature width after encoding; zero-egress
-image: no dataset download) clustered around 100 planted centers. Measures
-Lloyd iterations on the MXU: one (n,d)x(d,k) distance GEMM + segment-sum
-per iteration, fixed 10 iterations (convergence depends on data; fixed
-iteration count makes the number comparable run-to-run).
+image: no dataset download) clustered around 100 planted centers.
+
+Since r4 this times the PUBLIC estimator — ``KMeans().fit(device_array)``
+— not the ops-layer kernel (VERDICT r3 #1): the device-resident input
+path makes the whole fit device-side, so the estimator number must land
+within ~5% of the kernel number. Fixed 10 Lloyd iterations (tol=0) keeps
+runs comparable. Reported variants:
+
+  - headline: backend="fused" (pallas assignment+stats, VERDICT r3 #2) at
+    precision="highest" — reference-parity numerics;
+  - fast: precision="default" (1-pass bf16 distance scores, f32
+    accumulation; measured training-cost delta ~2e-4 relative) — the
+    TPU-native speed point;
+  - the XLA backend at "highest" for the backend comparison.
+
+Both rooflines are reported (VERDICT r3 #2). The bytes column counts the
+MINIMUM traffic — (ITERS+1) streaming reads of X — which the fused kernel
+actually achieves (its block temporaries live in VMEM), so its
+pct_hbm_roofline is the honest "how far from the ideal pass" figure.
 """
 
 from __future__ import annotations
@@ -14,7 +29,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import emit, roofline, time_median
+from benchmarks.common import bytes_roofline, emit, roofline, time_median
 
 N, D, K, ITERS = 20_000_000, 16, 100, 10
 
@@ -23,7 +38,7 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from spark_rapids_ml_tpu.ops.kmeans import lloyd, random_init
+    from spark_rapids_ml_tpu.clustering import KMeans
 
     key = jax.random.key(3)
     kc, kx, ki = jax.random.split(key, 3)
@@ -32,29 +47,52 @@ def main() -> None:
     x = centers_true[assign] + jax.random.normal(kx, (N, D), dtype=jnp.float32)
     x = jax.device_put(x)
     float(jnp.sum(x[0]))
-    mask = jnp.ones(N, dtype=jnp.float32)
 
-    init = random_init(x, mask, jax.random.key(0), K)
-    init.block_until_ready()
+    def fit(backend: str, precision: str):
+        est = (
+            KMeans()
+            .setK(K)
+            .setMaxIter(ITERS)
+            .setTol(0.0)
+            .setInitMode("random")
+            .setSeed(0)
+            .setBackend(backend)
+            .setPrecision(precision)
+        )
 
-    def run() -> None:
-        centers, cost, n_iter = lloyd(x, mask, init, max_iter=ITERS, tol=0.0)
-        float(cost)
+        def run() -> None:
+            model = est.fit(x)
+            # ONE scalar readback syncs the whole in-order device stream
+            # (the fit is fully async; a second sync would double-pay the
+            # relay-tunnel round trip).
+            float(model._cost_raw)
 
-    elapsed = time_median(run)
-    # lloyd() makes ITERS update passes plus one final assignment pass for
-    # the training cost — ITERS+1 full-data distance sweeps in the timing.
-    passes = ITERS + 1
+        return time_median(run)
+
+    t_fused = fit("fused", "highest")
+    t_fast = fit("fused", "default")
+    t_xla = fit("xla", "highest")
+
+    passes = ITERS + 1  # ITERS updates + final cost sweep
     # Dominant GEMMs: the (n,d)x(d,k) distance matmul every pass plus the
-    # (k,n)x(n,d) one-hot stats matmul on the ITERS update passes; the
-    # argmin/segment bookkeeping is uncounted (conservative MFU).
+    # (k,n)x(n,d) one-hot stats matmul on the ITERS update passes.
     flop = 2.0 * N * D * K * passes + 2.0 * N * K * D * ITERS
+    # Minimum HBM traffic: one streaming read of X per pass (block
+    # temporaries are VMEM-resident in the fused kernel) + the one-time
+    # transposed copy (read + write).
+    min_bytes = 4.0 * N * D * (passes + 2)
     emit(
         "kmeans_20Mx16_k100_10iter",
-        N * passes / elapsed,
+        N * passes / t_fused,
         "row-iters/s",
-        wall_s=round(elapsed, 4),
-        **roofline(flop, elapsed, "highest"),
+        wall_s=round(t_fused, 4),
+        through_estimator_api=True,
+        backend="fused",
+        precision="highest",
+        default_precision_row_iters_per_s=round(N * passes / t_fast, 0),
+        xla_backend_row_iters_per_s=round(N * passes / t_xla, 0),
+        **roofline(flop, t_fused, "highest"),
+        **bytes_roofline(min_bytes, t_fused),
     )
 
 

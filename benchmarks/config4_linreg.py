@@ -1,8 +1,17 @@
 """BASELINE config 4: LinearRegression/Ridge on HIGGS-shaped 11M x 28.
 
 Synthetic data at the HIGGS shape (zero-egress image: no dataset download).
-Measures the normal-equation path: XtX/Xty sufficient-statistics GEMM on
-the chip + tiny host solve.
+
+Since r4 this times the PUBLIC estimator — ``LinearRegression().fit((X, y))``
+with device-resident arrays (VERDICT r3 #1) — not the ops-layer kernels:
+the normal-equation path (XtX/Xty sufficient-statistics GEMM + jitted
+device solve) runs end-to-end inside the fit, and the model's host views
+convert lazily, so the timed quantity is exactly what a user gets.
+
+Both rooflines reported (VERDICT r3 #2): at d=28 the config is
+bytes-bound by construction (1.6 kFLOP per 112-byte row), so
+pct_hbm_roofline is the honest utilization figure and pct_ceiling just
+documents how far from MXU-relevant this shape is.
 """
 
 from __future__ import annotations
@@ -12,7 +21,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import emit, roofline, time_amortized
+from benchmarks.common import bytes_roofline, emit, roofline, time_amortized
 
 N, D = 11_000_000, 28
 
@@ -21,7 +30,7 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from spark_rapids_ml_tpu.ops.linear import normal_eq_stats, solve_normal
+    from spark_rapids_ml_tpu.regression import LinearRegression
 
     key = jax.random.key(4)
     kx, kw, ke = jax.random.split(key, 3)
@@ -29,26 +38,25 @@ def main() -> None:
     w_true = jax.random.normal(kw, (D,), dtype=jnp.float32)
     y = x @ w_true + 0.1 * jax.random.normal(ke, (N,), dtype=jnp.float32)
     float(jnp.sum(x[0]))
-    mask = jnp.ones(N, dtype=jnp.float32)
+
+    est = LinearRegression().setRegParam(0.1)
 
     def dispatch():
-        xtx, xty, x_sum, y_sum, yty, count = normal_eq_stats(x, y, mask)
-        coef, intercept = solve_normal(
-            xtx, xty, x_sum, y_sum, count, reg_param=0.1, fit_intercept=True,
-            standardization=True,
-        )
-        return coef
+        # Device-resident (X, y): the whole fit stays async; the returned
+        # model's raw coefficient state is the device output to sync on.
+        return est.fit((x, y))._coef_raw
 
     elapsed = time_amortized(dispatch, lambda coef: float(coef[0]))
-    # Dominant GEMMs: XtX (2nd^2) + Xty (2nd); the tiny host solve adds
-    # ~0 FLOPs. At d=28 this config is HBM-bound, not MXU-bound — the
-    # pct_ceiling quantifies exactly that.
+    # Dominant GEMMs: XtX (2nd^2) + Xty (2nd); the solve is O(d^3) ~ 0.
+    # Minimum traffic: one read of X and y.
     emit(
         "linreg_normal_11Mx28_ridge",
         N / elapsed,
         "rows/s",
         wall_s=round(elapsed, 4),
+        through_estimator_api=True,
         **roofline(2.0 * N * D * (D + 1), elapsed, "highest"),
+        **bytes_roofline(4.0 * N * (D + 1), elapsed),
     )
 
 

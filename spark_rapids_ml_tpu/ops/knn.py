@@ -37,13 +37,22 @@ def _block_sq_distances(q: jax.Array, xb: jax.Array, q_sq: jax.Array, prec) -> j
     return jnp.maximum(d2, 0.0)
 
 
+def _auto_block_items(nq: int, n_items: int) -> int:
+    """Item-block size: measured throughput at config 7's shape is flat
+    beyond 65536 rows (the knee — 32.5k q/s at 64k vs 32.2k at 256k), so
+    cap there; under the cap a ~2 GiB f32 (nq, block) buffer budget
+    shrinks blocks for large query batches (memory safety), floored at
+    1024 so the scan stays coarse."""
+    return min(n_items, 65536, max(1024, (1 << 29) // max(nq, 1)))
+
+
 @partial(jax.jit, static_argnames=("k", "block_items", "precision", "approx"))
 def knn_sq_euclidean(
     queries: jax.Array,
     items: jax.Array,
     k: int,
     item_mask: jax.Array | None = None,
-    block_items: int = 65536,
+    block_items: int | None = None,
     precision: str = "highest",
     approx: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -63,13 +72,15 @@ def knn_sq_euclidean(
     hardware approximate top-k beats the inverted-list gathers of
     ``ops/ann.ivf_search`` at 1M×96 with ~0.995 recall, because TPU
     gathers are scalarized while the distance GEMM rides the systolic
-    array. The (nq, block_items) distance buffer bounds memory — raise
-    ``block_items`` for few-query/many-item calls (the benchmark uses
-    262144), keep the default for large query batches.
+    array. ``block_items=None`` picks the block from the query count
+    (:func:`_auto_block_items` — the estimator path reaches benchmark-
+    grade blocks without a knob); pass an explicit value to pin it.
     """
     n_items = items.shape[0]
     if not 1 <= k <= n_items:
         raise ValueError(f"k must be in [1, {n_items}], got {k}")
+    if block_items is None:
+        block_items = _auto_block_items(queries.shape[0], n_items)
     prec = _dot_precision(precision)
     dtype = queries.dtype
     nq = queries.shape[0]
@@ -133,7 +144,7 @@ def knn(
     items: jax.Array,
     k: int,
     item_mask: jax.Array | None = None,
-    block_items: int = 65536,
+    block_items: int | None = None,
     metric: str = "euclidean",
     precision: str = "highest",
     approx: bool = False,
@@ -162,6 +173,99 @@ def knn(
     if metric == "euclidean":
         return jnp.sqrt(d2), idx
     return d2, idx
+
+
+@partial(jax.jit, static_argnames=("k", "approx", "precision"))
+def _merge_block_topk(best_d, best_i, queries, q_sq, xb, start, k,
+                      approx: bool, precision: str = "highest"):
+    """One streamed-block update of the running (nq, k) top-k state —
+    the same candidate-merge math as :func:`knn_sq_euclidean`'s scan step,
+    jitted standalone so a HOST loop can drive it block by block."""
+    prec = _dot_precision(precision)
+    nq = queries.shape[0]
+    block = xb.shape[0]
+    d2 = _block_sq_distances(queries, xb, q_sq, prec)
+    idx = start + jnp.arange(block, dtype=jnp.int32)
+    if approx:
+        # A block smaller than k (ragged tail, fine-grained sources)
+        # cannot be approx-reduced to k candidates — take it whole.
+        blk_d, blk_pos = lax.approx_min_k(d2, min(k, block))
+        blk_i = jnp.take_along_axis(
+            jnp.broadcast_to(idx, (nq, block)), blk_pos, axis=1
+        )
+        cand_d = jnp.concatenate([best_d, blk_d], axis=1)
+        cand_i = jnp.concatenate([best_i, blk_i], axis=1)
+    else:
+        cand_d = jnp.concatenate([best_d, d2], axis=1)
+        cand_i = jnp.concatenate(
+            [best_i, jnp.broadcast_to(idx, (nq, block))], axis=1
+        )
+    neg_top, pos = lax.top_k(-cand_d, k)
+    return -neg_top, jnp.take_along_axis(cand_i, pos, axis=1)
+
+
+def knn_host_streamed(
+    queries: jax.Array,
+    item_blocks,
+    k: int,
+    metric: str = "euclidean",
+    precision: str = "highest",
+    approx: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k against an item set STREAMED from beyond device memory.
+
+    ``item_blocks``: an iterable of host (rows_i, d) blocks (list,
+    generator, ``NpyBlockReader.iter_blocks()`` — one pass is enough).
+    Each block uploads once, its candidates merge into the running
+    (nq, k) state on device (:func:`_merge_block_topk` — the same merge
+    discipline as the resident-scan path), and the block's buffers are
+    then free: device memory is O(nq*k + block), item capacity is bounded
+    by the SOURCE, not HBM (VERDICT r3 #4 — the regime the
+    models/approximate_nearest_neighbors docstring used to hand to
+    inverted lists on faith). Whether streaming beats a compressed
+    resident index (ivfpq) depends on source bandwidth; BASELINE.md
+    config 8 records the measured crossover.
+
+    Equal-size blocks reuse one compiled merge; a ragged final block
+    compiles once more.
+    """
+    from spark_rapids_ml_tpu.core.data import _block_to_dense
+
+    if metric not in ("euclidean", "sqeuclidean", "cosine"):
+        raise ValueError(f"unknown metric {metric!r}")
+    import numpy as np
+
+    q = queries
+    if metric == "cosine":
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True), 1e-30)
+    q_sq = jnp.sum(q * q, axis=1)
+    nq = q.shape[0]
+    dtype = q.dtype
+    best_d = jnp.full((nq, k), jnp.inf, dtype=dtype)
+    best_i = jnp.full((nq, k), -1, dtype=jnp.int32)
+    offset = 0
+    np_dtype = np.dtype(dtype)
+    for blk in item_blocks:
+        b = _block_to_dense(blk, dtype=np_dtype)
+        if b.shape[0] == 0:
+            continue
+        xb = jnp.asarray(b)
+        if metric == "cosine":
+            xb = xb / jnp.maximum(
+                jnp.linalg.norm(xb, axis=1, keepdims=True), 1e-30
+            )
+        best_d, best_i = _merge_block_topk(
+            best_d, best_i, q, q_sq, xb, jnp.int32(offset), k,
+            approx=approx, precision=precision,
+        )
+        offset += b.shape[0]
+    if offset < k:
+        raise ValueError(f"k={k} exceeds streamed item count {offset}")
+    if metric == "euclidean":
+        return jnp.sqrt(best_d), best_i
+    if metric == "cosine":
+        return best_d / 2.0, best_i
+    return best_d, best_i
 
 
 def shard_items(items, mesh, metric: str = "euclidean") -> Tuple[jax.Array, jax.Array]:

@@ -28,14 +28,23 @@ from spark_rapids_ml_tpu.ops.linalg import _dot_precision, soft_threshold
 
 @partial(jax.jit, static_argnames=("precision",))
 def normal_eq_stats(
-    x: jax.Array, y: jax.Array, mask: jax.Array, precision: str = "highest"
+    x: jax.Array, y: jax.Array, mask: jax.Array | None, precision: str = "highest"
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Masked sufficient statistics in one pass.
 
     Returns (xtx, xty, x_sum, y_sum, yty, count): raw (uncentered) moments;
     centering happens in the solver where it is O(d^2), not O(n d).
+
+    ``mask=None`` means "all rows real, weight 1" and skips the masking
+    multiplies entirely — at small d this config is bytes-bound and the
+    x*mask pass would nearly double the HBM traffic for nothing.
     """
     prec = _dot_precision(precision)
+    if mask is None:
+        xtx = jnp.matmul(x.T, x, precision=prec)
+        xty = jnp.matmul(x.T, y, precision=prec)
+        n = jnp.asarray(x.shape[0], x.dtype)
+        return (xtx, xty, jnp.sum(x, axis=0), jnp.sum(y), jnp.sum(y * y), n)
     xm = x * mask[:, None]
     ym = y * mask
     xtx = jnp.matmul(xm.T, x, precision=prec)

@@ -30,6 +30,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from spark_rapids_ml_tpu.ops.linalg import _dot_precision, soft_threshold
@@ -329,6 +330,159 @@ def fit_logistic_elastic_net(
     b_orig = b - jnp.matmul(offset, w_orig, precision=prec) if fit_intercept else b
     final_loss = smooth_loss((w, b)) + reg1 * jnp.sum(jnp.abs(w))
     return LogisticFit(w_orig, b_orig, n_iter, final_loss)
+
+
+@partial(jax.jit, static_argnames=("c", "fit_intercept", "precision"))
+def _stream_block_value_grad(xb, yb, w, b, offset, scale, c, fit_intercept, precision):
+    """UNnormalized block loss + gradient contribution for the streaming
+    fit: sum_i logloss_i over this block only (the driver divides by the
+    global n and adds the L2 term once)."""
+    prec = _dot_precision(precision)
+    dtype = xb.dtype
+    if c == 1:
+        y_t = (yb == 1).astype(dtype)
+    else:
+        y_t = jax.nn.one_hot(yb, c, dtype=dtype)
+
+    def f(params):
+        w_, b_ = params
+        xs = (xb - offset) / scale
+        logits = jnp.matmul(xs, w_, precision=prec)
+        if fit_intercept:
+            logits = logits + b_
+        if c == 1:
+            z = logits[:, 0]
+            per_row = jax.nn.softplus(z) - y_t * z
+        else:
+            per_row = -jnp.sum(y_t * jax.nn.log_softmax(logits, axis=1), axis=1)
+        return jnp.sum(per_row)
+
+    val, (gw, gb) = jax.value_and_grad(f)((w, b))
+    return val, gw, gb
+
+
+def streaming_label_feature_stats(pairs):
+    """One pass over (X_block, y_block) pairs: feature moments in host
+    fp64 (n, mean, sigma — the standardizer inputs) plus label integrality
+    and range for the class count. O(d) state."""
+    n = 0
+    s = ss = None
+    y_max = -1
+    y_int_ok = True
+    for xb, yb in pairs:
+        b = np.asarray(xb, dtype=np.float64)
+        yv = np.asarray(yb).ravel()
+        if s is None:
+            s = np.zeros(b.shape[1])
+            ss = np.zeros(b.shape[1])
+        s += b.sum(axis=0)
+        ss += (b * b).sum(axis=0)
+        n += b.shape[0]
+        if yv.size:
+            yi = yv.astype(np.int64)
+            if not np.array_equal(yi, yv) or yi.min() < 0:
+                y_int_ok = False
+            y_max = max(y_max, int(yi.max()))
+    if n == 0:
+        raise ValueError("streaming source yielded no rows")
+    mean = s / n
+    sigma = np.sqrt(np.maximum(ss / n - mean * mean, 0.0))
+    return n, mean, sigma, y_max, y_int_ok
+
+
+def fit_logistic_streaming(
+    pairs_factory,
+    n_classes: int,
+    n: int,
+    mean: np.ndarray,
+    sigma: np.ndarray,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    precision: str = "highest",
+    multinomial: bool = False,
+    dtype=None,
+) -> LogisticFit:
+    """Multi-pass L-BFGS fit over a RE-ITERABLE (X_block, y_block) source.
+
+    Same objective and standardization semantics as :func:`fit_logistic`;
+    memory is O(block + d*c): each objective evaluation streams the blocks
+    through :func:`_stream_block_value_grad` (device GEMMs, device
+    accumulation) while scipy's L-BFGS-B drives the O(d*c) optimizer state
+    on host — the optimizer round trip per data pass is exactly the shape
+    Spark's breeze-over-treeAggregate loop has (one driver update per
+    distributed pass), so the streaming fit is also the faithful analogue
+    of the reference lineage's execution model. Feature moments arrive
+    precomputed (:func:`streaming_label_feature_stats`) so the caller's
+    label scan and the standardizer share one pass.
+    """
+    from scipy.optimize import minimize
+
+    if n_classes < 2:
+        raise ValueError(f"need at least 2 classes, got {n_classes}")
+    c = n_classes if (multinomial or n_classes > 2) else 1
+    d = mean.shape[0]
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    np_dtype = np.dtype(dtype)
+
+    safe_sigma = np.where(sigma > 0, sigma, 1.0)
+    if standardization:
+        offset = mean if fit_intercept else np.zeros_like(mean)
+        scale = safe_sigma
+    else:
+        offset = np.zeros_like(mean)
+        scale = np.ones_like(safe_sigma)
+    offset_j = jnp.asarray(offset, dtype=dtype)
+    scale_j = jnp.asarray(scale, dtype=dtype)
+
+    n_b = c if fit_intercept else 0
+
+    def fun_grad(theta):
+        w = theta[: d * c].reshape(d, c)
+        b = theta[d * c :] if fit_intercept else np.zeros(c)
+        wj = jnp.asarray(w.astype(np_dtype))
+        bj = jnp.asarray(b.astype(np_dtype))
+        tot = jnp.zeros((), dtype)
+        gw_acc = jnp.zeros((d, c), dtype)
+        gb_acc = jnp.zeros((c,), dtype)
+        for xb, yb in pairs_factory():
+            xj = jnp.asarray(np.ascontiguousarray(xb, dtype=np_dtype))
+            yj = jnp.asarray(np.asarray(yb).ravel().astype(np.int32))
+            v, gw, gb = _stream_block_value_grad(
+                xj, yj, wj, bj, offset_j, scale_j, c, fit_intercept, precision
+            )
+            tot, gw_acc, gb_acc = tot + v, gw_acc + gw, gb_acc + gb
+        val = float(tot) / n + 0.5 * reg_param * float(np.sum(w * w))
+        g_w = np.asarray(gw_acc, dtype=np.float64) / n + reg_param * w
+        out = [g_w.ravel()]
+        if fit_intercept:
+            out.append(np.asarray(gb_acc, dtype=np.float64) / n)
+        return val, np.concatenate(out)
+
+    theta0 = np.zeros(d * c + n_b)
+    res = minimize(
+        fun_grad,
+        theta0,
+        jac=True,
+        method="L-BFGS-B",
+        options={"maxiter": max_iter, "gtol": tol, "ftol": 1e-14},
+    )
+    w = res.x[: d * c].reshape(d, c)
+    b = res.x[d * c :] if fit_intercept else np.zeros(c)
+
+    if c > 1 and reg_param == 0.0:
+        # Identifiability pivot for unregularized softmax (fit_logistic parity).
+        w = w - w.mean(axis=1, keepdims=True)
+        b = b - b.mean()
+
+    w_orig = w / scale[:, None]
+    b_orig = b - offset @ w_orig if fit_intercept else b
+    return LogisticFit(
+        w_orig, b_orig, np.int64(res.nit), np.float64(res.fun)
+    )
 
 
 @partial(jax.jit, static_argnames=("n_classes", "precision"))

@@ -1,0 +1,293 @@
+"""Pallas TPU kernel: fused KMeans assignment + update statistics.
+
+The XLA Lloyd step (ops.kmeans.lloyd_step) materializes two (n, k) HBM
+temporaries per iteration — the distance matrix (consumed by argmin/min)
+and the one-hot matrix (operand of the stats GEMM). At 20M x 16, k=100
+that is ~32 GB of HBM write+read traffic per pass against a 1.3 GB data
+read: the pass is temporary-bound, not data-bound (VERDICT r3 #2 — the
+bytes-roofline gap). This kernel keeps both temporaries in VMEM: per row
+block it computes scores, argmin, one-hot, and the (k, d) partial sums
+without writing anything block-sized back to HBM. The only HBM traffic is
+the streaming read of X — the true roofline.
+
+Why round 3's attempt was ~20x SLOWER and this one is not: the r3 kernel
+read X in its natural (n, d) layout, so at d=16 each VMEM tile used 16 of
+128 lanes (and the HBM layout paid the same padding). Here X arrives
+TRANSPOSED — (d, n): n runs along the lane dimension (dense tiles at any
+d), d along sublanes (padded to 8, zeros contribute nothing). The two
+dot_generals contract over d (scores) and over the block dimension
+(stats) — both MXU ops; argmin/one-hot live on the VPU between them.
+
+Padding rows (zero columns of x_t beyond n_true) all land in the SAME
+deterministic cluster argmin(c2) with distance min(c2) and zero vector
+sum — the caller subtracts that closed-form contribution instead of
+streaming a mask (lloyd_fused below).
+
+Supports the unweighted fit (the adapter's weighted path keeps the
+masked XLA formulation).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+
+def _split_hi_lo(a):
+    """bf16 hi/lo split (in f32 containers): a == hi + lo with both parts
+    bf16-representable, so DEFAULT-precision (1-pass) dots on the parts
+    are exact products — the building block of the 3-pass f32-grade dot."""
+    hi = a.astype(jnp.bfloat16).astype(jnp.float32)
+    return hi, a - hi
+
+
+def _dot_prec(a, b, dims, precision):
+    """dot_general at the named precision. Mosaic has no HIGH mapping, so
+    "high" is emulated as the classic 3-pass bf16 split
+    (hi*hi + hi*lo + lo*hi — drops only the lo*lo term, ~f32 accuracy at
+    half of HIGHEST's six passes)."""
+    kw = dict(dimension_numbers=dims, preferred_element_type=jnp.float32)
+    if precision == "high":
+        a_hi, a_lo = _split_hi_lo(a)
+        b_hi, b_lo = _split_hi_lo(b)
+        default = jax.lax.Precision.DEFAULT
+        return (
+            jax.lax.dot_general(a_hi, b_hi, precision=default, **kw)
+            + jax.lax.dot_general(a_hi, b_lo, precision=default, **kw)
+            + jax.lax.dot_general(a_lo, b_hi, precision=default, **kw)
+        )
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if precision == "highest"
+        else jax.lax.Precision.DEFAULT
+    )
+    return jax.lax.dot_general(a, b, precision=prec, **kw)
+
+
+def _assign_stats_kernel(xt_ref, ct_ref, c2_ref, sums_ref, counts_ref,
+                         cost_ref, *, precision):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        cost_ref[0, 0] = 0.0
+
+    xt = xt_ref[:]  # (d_pad, bn)
+    # scores = c2 - 2 x.c  (the x2 term is argmin-invariant per row; the
+    # true distance comes back via sum(x2) added to sum(min scores)).
+    xc = _dot_prec(
+        xt, ct_ref[:], (((0,), (0,)), ((), ())), precision
+    )  # (bn, k_pad)
+    scores = c2_ref[:] - 2.0 * xc
+    m = jnp.min(scores, axis=1, keepdims=True)  # (bn, 1)
+    labels = jnp.argmin(scores, axis=1)  # (bn,)
+    oh = (
+        jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        == labels[:, None]
+    ).astype(jnp.float32)  # (bn, k_pad), exact 0/1
+    # Stats GEMM: oh is EXACT in bf16 (0/1), so "high" needs only the x
+    # split — oh.x_hi + oh.x_lo is exact-product f32-grade in 2 passes.
+    if precision == "high":
+        xt_hi, xt_lo = _split_hi_lo(xt)
+        default = jax.lax.Precision.DEFAULT
+        kw = dict(
+            dimension_numbers=(((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        sums_ref[:] += jax.lax.dot_general(
+            oh, xt_hi, precision=default, **kw
+        ) + jax.lax.dot_general(oh, xt_lo, precision=default, **kw)
+    else:
+        sums_ref[:] += _dot_prec(
+            oh, xt, (((0,), (1,)), ((), ())), precision
+        )  # (k_pad, d_pad)
+    counts_ref[:] += jnp.sum(oh, axis=0, keepdims=True)  # (1, k_pad)
+    cost_ref[0, 0] += jnp.sum(xt * xt) + jnp.sum(m)
+
+
+@partial(jax.jit, static_argnames=("block_n", "precision", "interpret"))
+def assign_stats_fused(
+    xt: jax.Array,
+    centers: jax.Array,
+    block_n: int = 4096,
+    precision: str = "highest",
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused Lloyd statistics for TRANSPOSED input.
+
+    ``xt``: (d_pad, n_pad) with d padded to 8 and n padded to ``block_n``
+    multiples, both zero-filled (use :func:`pad_transposed`). ``centers``:
+    (k, d_pad). Returns raw ``(sums (k, d_pad), counts (k,), cost)``
+    INCLUDING the padding rows' contribution — callers subtract it in
+    closed form (see :func:`lloyd_fused`).
+    """
+    d_pad, n_pad = xt.shape
+    k = centers.shape[0]
+    if centers.shape[1] != d_pad:
+        raise ValueError(f"centers width {centers.shape[1]} != x width {d_pad}")
+    k_pad = k + ((-k) % 128)
+    ct = jnp.pad(centers.T, ((0, 0), (0, k_pad - k)))  # (d_pad, k_pad)
+    c2 = jnp.sum(ct * ct, axis=0, keepdims=True)  # (1, k_pad)
+    # Padded center columns are all-zero -> c2 = 0 would WIN every argmin.
+    # Push them to +inf so no real row ever lands there.
+    if k_pad > k:
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
+        c2 = jnp.where(col < k, c2, jnp.inf)
+    if precision not in ("highest", "high", "default"):
+        raise ValueError(f"precision must be highest|high|default, got {precision!r}")
+    nb = n_pad // block_n
+
+    sums, counts, cost = pl.pallas_call(
+        partial(_assign_stats_kernel, precision=precision),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((d_pad, block_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d_pad, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(xt, ct, c2)
+    return sums[:k], counts[0, :k], cost[0, 0]
+
+
+def fused_feasible(d: int, k: int) -> bool:
+    """True when the kernel's fixed VMEM residents (centers + c2 + the
+    (k, d) accumulator) plus one minimum 128-column block fit the budget.
+    The KMeans backend resolver consults this — auto falls back to XLA,
+    an explicit backend='fused' raises."""
+    return auto_block_n(d, k) is not None
+
+
+def auto_block_n(d: int, k: int):
+    """Row-block size that keeps the kernel's VMEM residents (x tile
+    double-buffered + scores + one-hot + split scratch) within ~10 MB,
+    or None when even the minimum 128-column block would not fit (very
+    wide d x large k — the XLA path handles those)."""
+    d_pad = d + ((-d) % 8)
+    k_pad = k + ((-k) % 128)
+    per_col = 4 * d_pad + 2 * k_pad  # f32 elements per block column
+    fixed = 2 * d_pad * k_pad + k_pad * d_pad + k_pad  # ct + sums + c2
+    budget_elems = (10 << 20) // 4 - fixed
+    bn = budget_elems // per_col if budget_elems > 0 else 0
+    if bn < 128:
+        return None
+    return (min(8192, bn) // 128) * 128
+
+
+def pad_transposed(x: jax.Array, block_n: int = 4096) -> Tuple[jax.Array, int]:
+    """(n, d) -> zero-padded (d_pad, n_pad) transposed copy for the fused
+    kernel (one extra HBM round trip of X, amortized over all Lloyd
+    iterations). Returns (xt, n_true)."""
+    n, d = x.shape
+    d_pad = (-d) % 8
+    n_pad = (-n) % block_n
+    xt = x.T
+    if d_pad or n_pad:
+        xt = jnp.pad(xt, ((0, d_pad), (0, n_pad)))
+    return xt, n
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_true", "max_iter", "block_n", "precision", "cosine", "interpret",
+    ),
+)
+def lloyd_fused(
+    xt: jax.Array,
+    n_true: int,
+    init_centers: jax.Array,
+    max_iter: int = 20,
+    tol: float = 1e-4,
+    block_n: int = 4096,
+    precision: str = "highest",
+    cosine: bool = False,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full Lloyd fit on the fused kernel: (centers, cost, n_iter).
+
+    Same convergence semantics as :func:`ops.kmeans.lloyd` (movement tol,
+    empty clusters keep their center, final cost at converged centers).
+    ``xt`` comes from :func:`pad_transposed`; ``init_centers`` is (k, d)
+    and is zero-padded to the kernel width internally. The returned
+    centers carry the same d_pad width — slice ``[:, :d]`` outside.
+
+    Padding correction: the n_pad zero columns all score argmin(c2) with
+    distance min(c2) and contribute zero to sums — subtracted in closed
+    form each pass, so results are EXACTLY the masked formulation's.
+    """
+    d_pad = xt.shape[0]
+    n_pad_rows = xt.shape[1] - n_true
+    k = init_centers.shape[0]
+    init = jnp.pad(
+        init_centers.astype(jnp.float32),
+        ((0, 0), (0, d_pad - init_centers.shape[1])),
+    )
+
+    def correct(stats, centers):
+        sums, counts, cost = stats
+        c2 = jnp.sum(centers * centers, axis=1)  # (k,)
+        pad_label = jnp.argmin(c2)
+        counts = counts.at[pad_label].add(-jnp.float32(n_pad_rows))
+        cost = cost - n_pad_rows * c2[pad_label]
+        return sums, counts, cost
+
+    def step(centers):
+        stats = assign_stats_fused(
+            xt, centers, block_n=block_n, precision=precision,
+            interpret=interpret,
+        )
+        sums, counts, cost = correct(stats, centers)
+        new_centers = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
+        )
+        if cosine:
+            norms = jnp.sqrt(jnp.sum(new_centers * new_centers, axis=1, keepdims=True))
+            new_centers = new_centers / jnp.maximum(norms, 1e-12)
+        return new_centers, cost
+
+    def cond(state):
+        _, moved, it, _ = state
+        return jnp.logical_and(moved > tol * tol, it < max_iter)
+
+    def body(state):
+        centers, _, it, _ = state
+        new_centers, cost = step(centers)
+        moved = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
+        return new_centers, moved, it + 1, cost
+
+    state0 = (
+        init,
+        jnp.asarray(jnp.inf, jnp.float32),
+        0,
+        jnp.asarray(0.0, jnp.float32),
+    )
+    centers, _, n_iter, _ = jax.lax.while_loop(cond, body, state0)
+    # Final cost at the converged centers (lloyd parity).
+    _, _, cost = correct(
+        assign_stats_fused(
+            xt, centers, block_n=block_n, precision=precision,
+            interpret=interpret,
+        ),
+        centers,
+    )
+    return centers, cost, n_iter

@@ -36,7 +36,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.parallel.mesh import (
     DATA_AXIS,
-    MODEL_AXIS,
     make_mesh,
     model_axis_size,
 )

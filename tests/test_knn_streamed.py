@@ -1,0 +1,131 @@
+"""Host-streamed kNN/ANN indexes (VERDICT r3 #4): item sets beyond HBM
+stream through a running top-k merge; results must match the resident
+path exactly (the merge math is shared)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.neighbors import ApproximateNearestNeighbors, NearestNeighbors
+from spark_rapids_ml_tpu.ops.knn import knn, knn_host_streamed
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(17)
+    items = rng.normal(size=(3000, 24)).astype(np.float32)
+    queries = rng.normal(size=(50, 24)).astype(np.float32)
+    return items, queries
+
+
+def _blocks_of(items, bs):
+    return [items[i : i + bs] for i in range(0, items.shape[0], bs)]
+
+
+class TestStreamedOps:
+    @pytest.mark.parametrize("metric", ["euclidean", "sqeuclidean", "cosine"])
+    def test_matches_resident(self, corpus, metric):
+        items, queries = corpus
+        d_r, i_r = knn(jnp.asarray(queries), jnp.asarray(items), 7, metric=metric)
+        d_s, i_s = knn_host_streamed(
+            jnp.asarray(queries), _blocks_of(items, 700), 7, metric=metric
+        )
+        assert np.array_equal(np.asarray(i_r), np.asarray(i_s))
+        assert np.allclose(np.asarray(d_r), np.asarray(d_s), atol=1e-5)
+
+    def test_ragged_final_block_and_generator_source(self, corpus):
+        items, queries = corpus
+        d_r, i_r = knn(jnp.asarray(queries), jnp.asarray(items), 5, metric="sqeuclidean")
+        gen = (b for b in _blocks_of(items, 999))  # one-shot is fine at the ops level
+        d_s, i_s = knn_host_streamed(jnp.asarray(queries), gen, 5, metric="sqeuclidean")
+        assert np.array_equal(np.asarray(i_r), np.asarray(i_s))
+
+    def test_k_exceeds_count_raises(self, corpus):
+        _, queries = corpus
+        with pytest.raises(ValueError, match="exceeds"):
+            knn_host_streamed(
+                jnp.asarray(queries), [np.ones((3, 24), np.float32)], 5
+            )
+
+    def test_approx_with_blocks_smaller_than_k(self, corpus):
+        # Regression (r4 review): approx_min_k on a block narrower than k
+        # used to crash; small blocks must merge whole instead.
+        items, queries = corpus
+        d_r, i_r = knn(
+            jnp.asarray(queries), jnp.asarray(items[:70]), 10,
+            metric="sqeuclidean",
+        )
+        d_s, i_s = knn_host_streamed(
+            jnp.asarray(queries), _blocks_of(items[:70], 7), 10,
+            metric="sqeuclidean", approx=True,
+        )
+        # approx per-block selection is exact on CPU; order may differ
+        # only among equal distances.
+        assert np.allclose(np.sort(d_s, axis=1), np.sort(d_r, axis=1), atol=1e-5)
+
+
+class TestStreamedEstimators:
+    def test_nn_streamed_matches_resident(self, corpus):
+        items, queries = corpus
+
+        def factory():
+            return iter(_blocks_of(items, 800))
+
+        streamed = NearestNeighbors().setK(6).fit(factory)
+        resident = NearestNeighbors().setK(6).fit(items.astype(np.float64))
+        d_s, i_s = streamed.kneighbors(queries.astype(np.float64))
+        d_r, i_r = resident.kneighbors(queries.astype(np.float64))
+        assert np.array_equal(i_s, i_r)
+        assert np.allclose(d_s, d_r, atol=1e-5)
+
+    def test_ann_streamed_brute_approx_matches(self, corpus):
+        items, queries = corpus
+
+        def factory():
+            return iter(_blocks_of(items, 800))
+
+        streamed = (
+            ApproximateNearestNeighbors()
+            .setK(6)
+            .setAlgorithm("brute_approx")
+            .fit(factory)
+        )
+        resident = (
+            ApproximateNearestNeighbors()
+            .setK(6)
+            .setAlgorithm("brute_approx")
+            .fit(items.astype(np.float64))
+        )
+        d_s, i_s = streamed.kneighbors(queries.astype(np.float64))
+        d_r, i_r = resident.kneighbors(queries.astype(np.float64))
+        # approx_min_k is exact on CPU; block boundaries differ between
+        # the streamed (800) and resident (auto) paths, so compare sets.
+        agree = np.mean([
+            len(set(i_s[q]) & set(i_r[q])) / 6 for q in range(i_s.shape[0])
+        ])
+        assert agree > 0.99
+
+    def test_one_shot_generator_rejected(self, corpus):
+        items, _ = corpus
+        gen = (b for b in _blocks_of(items, 500))
+        with pytest.raises(ValueError, match="RE-ITERABLE"):
+            NearestNeighbors().setK(3).fit(gen)
+
+    def test_ivf_streamed_rejected(self, corpus):
+        items, _ = corpus
+
+        def factory():
+            return iter(_blocks_of(items, 500))
+
+        with pytest.raises(ValueError, match="brute"):
+            ApproximateNearestNeighbors().setAlgorithm("ivfflat").fit(factory)
+
+    def test_streamed_model_does_not_persist(self, corpus, tmp_path):
+        items, _ = corpus
+
+        def factory():
+            return iter(_blocks_of(items, 500))
+
+        model = NearestNeighbors().setK(3).fit(factory)
+        with pytest.raises(ValueError, match="persist"):
+            model.write.overwrite().save(str(tmp_path / "m"))
