@@ -1,0 +1,70 @@
+"""Config 9: RandomForest classification fit (VERDICT r3 #3 — the
+families with no benchmark row).
+
+500k x 16 synthetic, 8 trees, depth 6, 16 bins, 2 classes — through the
+PUBLIC estimator on device-resident (X, y). The dominant compute is the
+level-order histogram GEMM (ops/trees._level_histograms): per level l,
+S einsums of (T, n, M_l) x (n, d*B) with M_l = 2^l nodes, so
+FLOP = sum_l 2*S*T*n*2^l*d*B — the one-hot "scatter-free counting on the
+MXU" design pays dense FLOPs for gather-free histograms, which is
+exactly what the MFU column quantifies. Bytes: (ITERS-free, one level
+pass reads x_binned int32 + stats per level).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import bytes_roofline, emit, roofline, time_median
+
+N, D, TREES, DEPTH, BINS, CLASSES = 500_000, 16, 8, 6, 16, 2
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+    kx, kw, ke = jax.random.split(jax.random.key(9), 3)
+    x = jax.random.normal(kx, (N, D), dtype=jnp.float32)
+    w = jax.random.normal(kw, (D,), dtype=jnp.float32)
+    margin = x @ w + 0.3 * jax.random.normal(ke, (N,), dtype=jnp.float32)
+    y = (margin > 0).astype(jnp.float32)
+    float(jnp.sum(x[0]) + float(y[0]))
+
+    est = (
+        RandomForestClassifier()
+        .setNumTrees(TREES)
+        .setMaxDepth(DEPTH)
+        .setMaxBins(BINS)
+        .setSeed(0)
+    )
+
+    def run() -> None:
+        model = est.fit((x, y))
+        jax.block_until_ready(model._forest.leaf_value)
+
+    elapsed = time_median(run)
+    flop = sum(
+        2.0 * CLASSES * TREES * N * (2 ** level) * D * BINS
+        for level in range(DEPTH)
+    )
+    # Traffic: one read of the binned matrix + stats + weights per level.
+    level_bytes = 4.0 * N * (D + CLASSES + TREES)
+    emit(
+        "rf_classifier_fit_500kx16_t8_d6",
+        N / elapsed,
+        "rows/s",
+        wall_s=round(elapsed, 4),
+        through_estimator_api=True,
+        **roofline(flop, elapsed, "highest"),
+        **bytes_roofline(level_bytes * DEPTH, elapsed),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,13 @@ CONFIGS = [
     ("config5_pca_distributed.py", {}),
     ("config6_pca_transform.py", {}),
     ("config7_ann_search.py", {}),
+    ("config8_ann_beyond_hbm.py", {}),
+    ("config9_random_forest.py", {}),
+    ("config10_logreg.py", {}),
+    ("config11_exact_knn.py", {}),
+    ("config12_dbscan.py", {}),
+    ("config13_umap.py", {}),
+    ("config14_evaluators.py", {}),
 ]
 
 

@@ -10,12 +10,24 @@ inverted lists), ``brute`` (exact, delegates to ``ops/knn.py``), and
 ``brute_approx`` (dense MXU scoring + the TPU-native hardware approximate
 top-k, ``lax.approx_min_k``). The measured TPU-first result (BASELINE.md
 config 7): at 1M items × 96 dims, ``brute_approx`` answers 10k queries
-~4.4× faster than ivfflat at 0.995 recall (41.4k vs 9.4k queries/s) —
-TPU gathers are scalarized while dense GEMMs ride the systolic array, so
-the inverted-list structure that wins on GPUs loses here until item
-counts far exceed single-chip HBM. Under a mesh, ``brute_approx`` runs
-the hardware per-shard top-k with an exact cross-shard merge
-(``ops/knn.knn_sharded(approx=True)``).
+~3.9× faster than ivfflat at ~0.997 recall — TPU gathers are scalarized
+while dense GEMMs ride the systolic array, so the inverted-list
+structure that wins on GPUs loses here at resident scales. Under a mesh,
+``brute_approx`` runs the hardware per-shard top-k with an exact
+cross-shard merge (``ops/knn.knn_sharded(approx=True)``).
+
+BEYOND single-chip HBM the choice is measured, not assumed (BASELINE.md
+config 8): a re-iterable block source fits a STREAMED brute index
+(``ops/knn.knn_host_streamed`` — running top-k merge, capacity bounded
+by the source). The measured crossover is effectively zero: the
+compressed resident alternative (``ivfpq`` — the only structure whose
+residency shrinks relative to raw items) is so gather-bound on TPU
+(~78 q/s at 0.16 recall vs 22.4k q/s streamed-device at 1M×128) that
+~20 MB/s of source bandwidth already beats it. The TPU-native
+beyond-HBM recipe is therefore streaming (or sharding items across
+chips/executors — ``knn_sharded`` / the adapter's
+``setIndexMode("sharded")``); ``ivfpq``/``ivfflat`` remain for API
+parity with the cuML lineage, not as the scale path.
 
 Metrics: ``euclidean`` / ``sqeuclidean`` natively; ``cosine`` by
 L2-normalizing items and queries, under which cosine distance equals half

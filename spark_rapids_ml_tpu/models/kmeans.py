@@ -187,6 +187,9 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
         return self
 
     def setBackend(self, value: str) -> "KMeans":
+        """``"fused"`` computes in float32 (the pallas kernel's dtype) —
+        an explicit request downcasts float64 input; ``"auto"`` never
+        does (f64 fits keep the XLA path)."""
         if value not in ("auto", "fused", "xla"):
             raise ValueError(f"backend must be auto/fused/xla, got {value!r}")
         self.set(self.backend, value)
@@ -259,7 +262,8 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
             else:
                 init = kmeans_plusplus_init(xs, mask, key, k)
             backend = self._resolve_backend(
-                w_host, int(xs.shape[0]) * k, d=int(xs.shape[1]), k=k
+                w_host, int(xs.shape[0]) * k, d=int(xs.shape[1]), k=k,
+                dtype=xs.dtype,
             )
             if backend == "fused":
                 # Pallas fused assignment+stats: the (n, k) distance and
@@ -310,7 +314,9 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
     # compile isn't worth it.
     _FUSED_AUTO_WORK = 1 << 22
 
-    def _resolve_backend(self, w_host, work: int, d: int = 1, k: int = 2) -> str:
+    def _resolve_backend(
+        self, w_host, work: int, d: int = 1, k: int = 2, dtype=None
+    ) -> str:
         """Pick the Lloyd kernel. "fused" needs a uniform row weight (the
         kernel streams no mask — padding is corrected in closed form) and
         a single-device layout; explicit requests that can't be honored
@@ -333,7 +339,13 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                 raise ValueError(
                     "backend='fused' does not support " + ", ".join(blockers)
                 )
+            # An EXPLICIT fused request accepts the kernel's documented
+            # f32 compute (the setter docs say so) even for f64 input.
             return "fused"
+        if dtype is not None and np.dtype(dtype) == np.float64:
+            # auto must not silently downcast x64 input to the f32 kernel
+            # — precision='highest' on f64 means the f64 XLA path.
+            blockers.append("float64 input")
         if requested == "xla" or blockers:
             return "xla"
         # auto: the pallas kernel is TPU-compiled; other platforms would

@@ -86,7 +86,14 @@ def _coarse_quantizer(items: np.ndarray, n_lists: int, seed: int,
     centroids, _, _ = lloyd(
         x, mask, init, max_iter=kmeans_iters, tol=1e-4, data_shards=data_shards
     )
-    labels, _ = assign_clusters(x, centroids)
+    if 4 * n * n_lists > 2_000_000_000:
+        # The full (n, n_lists) assignment matrix would blow HBM at
+        # beyond-HBM-benchmark scales — block the final assignment.
+        from spark_rapids_ml_tpu.ops.kmeans import assign_clusters_blocked
+
+        labels, _ = assign_clusters_blocked(x, centroids)
+    else:
+        labels, _ = assign_clusters(x, centroids)
     # Strip row padding (mesh) and model-axis feature padding.
     return np.asarray(centroids)[:, :d], np.asarray(labels)[:n]
 

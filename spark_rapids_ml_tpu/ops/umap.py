@@ -156,19 +156,24 @@ def optimize_layout(
 
     Every epoch: gradients of the fuzzy cross-entropy for all E edges
     (attraction, weighted by membership) and E * neg_rate uniformly drawn
-    negatives (repulsion) are accumulated with two scatter-adds and applied
-    with a linearly annealed step — umap-learn's sampling schedule folded
-    into weights. ``target`` (if given) is a fixed reference point set the
-    tail of each edge attracts to instead of the live embedding — the
-    transform-time mode where train points stay put; ``move_other=False``
-    then skips the tail update.
+    negatives (repulsion), applied with a linearly annealed step —
+    umap-learn's sampling schedule folded into weights. ``target`` (if
+    given) is a fixed reference point set the tail of each edge attracts
+    to instead of the live embedding — the transform-time mode where
+    train points stay put; ``move_other=False`` then skips the tail
+    update.
+
+    TPU layout (r4, measured 97% of the UMAP fit wall before): the edge
+    list is EXACTLY (n heads x k neighbors), so every head-side access is
+    STRUCTURED — the head "gather" is a broadcast of y and the head
+    "scatter" is a dense (n, k, ...) sum over k — leaving only the
+    genuinely random accesses (the dst/negative gathers and the tail
+    scatter) on the slow scalarized path.
     """
     n, dim = embedding.shape
     k = graph.indices.shape[1]
-    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, k)).reshape(-1)
-    dst = graph.indices.reshape(-1)
-    w = graph.weight.reshape(-1)
-    e = src.shape[0]
+    dst = graph.indices  # (n, k)
+    w = graph.weight  # (n, k)
     ref = embedding if target is None else target
     n_ref = ref.shape[0]
 
@@ -177,33 +182,40 @@ def optimize_layout(
         key, k_neg = jax.random.split(key)
         alpha = learning_rate * (1.0 - ep / n_epochs)
 
-        yi = y[src]  # (E, dim)
-        yj = (y if target is None else target)[dst]
+        yi = y[:, None, :]  # (n, 1, dim) — the head side is a broadcast
+        yj = (y if target is None else target)[dst]  # (n, k, dim)
         diff = yi - yj
-        d2 = jnp.sum(diff * diff, axis=1)
+        d2 = jnp.sum(diff * diff, axis=2)  # (n, k)
         # Attractive: d/dy_i of log(1/(1 + a d^2b)) -> -2ab d^{2(b-1)}/(1+a d^2b)
         att = (-2.0 * a * b * jnp.power(jnp.maximum(d2, 1e-12), b - 1.0)) / (
             1.0 + a * jnp.power(d2, b)
         )
-        g_att = jnp.clip((att * w)[:, None] * diff, -4.0, 4.0)  # (E, dim)
+        g_att = jnp.clip((att * w)[:, :, None] * diff, -4.0, 4.0)  # (n, k, dim)
 
-        neg_idx = jax.random.randint(k_neg, (e, neg_rate), 0, n_ref)
+        # Same RNG stream as the flat-edge formulation: draw (E, m), view
+        # as (n, k, m).
+        neg_idx = jax.random.randint(k_neg, (n * k, neg_rate), 0, n_ref).reshape(
+            n, k, neg_rate
+        )
         # Negatives come from the LIVE layout in fit mode (repulsion must
         # track the moving points), from the frozen targets in transform.
-        yn = (y if target is None else target)[neg_idx]  # (E, m, dim)
-        diff_n = yi[:, None, :] - yn
-        d2n = jnp.sum(diff_n * diff_n, axis=2)
+        yn = (y if target is None else target)[neg_idx]  # (n, k, m, dim)
+        diff_n = y[:, None, None, :] - yn
+        d2n = jnp.sum(diff_n * diff_n, axis=3)  # (n, k, m)
         rep = (2.0 * repulsion * b) / (
             (0.001 + d2n) * (1.0 + a * jnp.power(d2n, b))
         )
-        g_rep = jnp.clip((rep * w[:, None])[:, :, None] * diff_n, -4.0, 4.0)
+        g_rep = jnp.clip((rep * w[:, :, None])[:, :, :, None] * diff_n, -4.0, 4.0)
 
         # Head moves along both terms (att < 0 pulls toward the neighbor,
-        # rep > 0 pushes off the negatives); the tail mirrors attraction.
-        grad_i = g_att + jnp.sum(g_rep, axis=1)  # (E, dim)
-        delta = jnp.zeros_like(y).at[src].add(alpha * grad_i)
+        # rep > 0 pushes off the negatives): a DENSE sum over (k, m) — no
+        # scatter. The tail mirrors attraction (true scatter, dst random).
+        grad_head = jnp.sum(g_att + jnp.sum(g_rep, axis=2), axis=1)  # (n, dim)
+        delta = alpha * grad_head
         if move_other and target is None:
-            delta = delta.at[dst].add(-alpha * g_att)
+            delta = delta + jnp.zeros_like(y).at[dst.reshape(-1)].add(
+                -alpha * g_att.reshape(-1, dim)
+            )
         return y + delta, key
 
     y, _ = lax.fori_loop(0, n_epochs, epoch, (embedding, key))

@@ -58,4 +58,61 @@ def _pickle_roundtrip(obj):
         return pickle.loads(pickle.dumps(obj))
 
 
-__all__ = ["keyword_only", "TaskContext"]
+# Torrent-broadcast analogue: values serialize ONCE at broadcast() time
+# (counted, for the one-serialization contract tests); the Broadcast
+# handle that rides task closures pickles as a registry id only —
+# exactly the cost model of Spark's TorrentBroadcast.
+import itertools as _itertools
+
+_BROADCAST_REGISTRY = {}
+_BROADCAST_IDS = _itertools.count()  # monotonic: destroy() must not free ids
+BROADCAST_VALUE_PICKLES = {"count": 0}
+
+
+def _broadcast_from_id(bid: int) -> "Broadcast":
+    b = Broadcast.__new__(Broadcast)
+    b._bid = bid
+    return b
+
+
+class Broadcast:
+    """pyspark.broadcast.Broadcast: read-only shared variable, one
+    serialization per broadcast, ``.value`` on executors."""
+
+    def __init__(self, value):
+        bid = next(_BROADCAST_IDS)
+        BROADCAST_VALUE_PICKLES["count"] += 1
+        _BROADCAST_REGISTRY[bid] = _pickle_roundtrip(value)
+        self._bid = bid
+
+    @property
+    def value(self):
+        return _BROADCAST_REGISTRY[self._bid]
+
+    def __reduce__(self):
+        # Task closures ship the HANDLE, never the value.
+        return (_broadcast_from_id, (self._bid,))
+
+    def unpersist(self, blocking: bool = False) -> None:
+        pass
+
+    def destroy(self, blocking: bool = False) -> None:
+        _BROADCAST_REGISTRY.pop(self._bid, None)
+
+
+class SparkContext:
+    """Driver-side context stub: the adapter touches only broadcast()."""
+
+    def broadcast(self, value) -> Broadcast:
+        return Broadcast(value)
+
+
+_SC = SparkContext()
+
+__all__ = [
+    "keyword_only",
+    "TaskContext",
+    "Broadcast",
+    "SparkContext",
+    "BROADCAST_VALUE_PICKLES",
+]

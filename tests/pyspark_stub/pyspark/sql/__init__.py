@@ -227,6 +227,12 @@ class DataFrame:
         self._parts = partitions
 
     @property
+    def sparkSession(self) -> "SparkSession":
+        """pyspark 3.5 DataFrame.sparkSession — the owning session (the
+        stub's sessions are interchangeable singletons)."""
+        return SparkSession()
+
+    @property
     def columns(self) -> List[str]:
         return list(self._schema)
 
@@ -294,6 +300,12 @@ class SparkSession:
             return SparkSession()
 
     builder = Builder()
+
+    @property
+    def sparkContext(self):
+        from pyspark import _SC
+
+        return _SC
 
     def createDataFrame(self, data, schema, numPartitions: int = 2) -> DataFrame:
         rows = [Row(schema, list(r)) for r in data]

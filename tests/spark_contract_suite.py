@@ -668,6 +668,101 @@ class TestNeighborsAdapters:
         idx = np.stack([np.asarray(r.indices) for r in rows]).astype(int)
         np.testing.assert_array_equal(idx[:, 0], np.arange(200))
 
+    def test_sharded_index_matches_collected(self, spark_env, rng):
+        """indexMode='sharded' (VERDICT r3 #5): executor-local shards +
+        treeReduce merge must return exactly the collected path's
+        neighbors."""
+        adapter, spark = spark_env
+        items = rng.normal(size=(240, 6))
+        df = _vector_df(spark, items)
+        queries = rng.normal(size=(30, 6))
+        qdf = _vector_df(spark, queries)
+        collected = adapter.TpuNearestNeighbors(k=5).fit(df)
+        sharded = (
+            adapter.TpuNearestNeighbors(k=5).setIndexMode("sharded").fit(df)
+        )
+        rows_c = collected.kneighbors(qdf).collect()
+        rows_s = sharded.kneighbors(qdf).collect()
+        idx_c = np.stack([np.asarray(r.indices) for r in rows_c]).astype(int)
+        idx_s = np.stack([np.asarray(r.indices) for r in rows_s]).astype(int)
+        np.testing.assert_array_equal(idx_s, idx_c)
+        d_c = np.stack([np.asarray(r.distances) for r in rows_c])
+        d_s = np.stack([np.asarray(r.distances) for r in rows_s])
+        np.testing.assert_allclose(d_s, d_c, atol=1e-9)
+
+    def test_sharded_fit_never_collects_items(self, spark_env, rng):
+        """The point of sharded mode: the ITEM SET never crosses
+        executor->driver. The stub's fetch counter sees only the
+        per-partition count rows during fit."""
+        adapter, spark = spark_env
+        try:
+            from pyspark.sql import FETCHED_ROWS
+        except ImportError:
+            import pytest as _pytest
+
+            _pytest.skip("fetch instrumentation is stub-only")
+        items = rng.normal(size=(300, 5))
+        df = _vector_df(spark, items)
+        FETCHED_ROWS["rows"] = 0
+        model = (
+            adapter.TpuNearestNeighbors(k=3).setIndexMode("sharded").fit(df)
+        )
+        # Fit fetches one (partition, count) row per partition — never
+        # an item row.
+        n_parts = df.rdd.getNumPartitions()
+        assert FETCHED_ROWS["rows"] <= n_parts, FETCHED_ROWS["rows"]
+        # The search fetches the QUERY vectors (the small side), still
+        # never the item set.
+        queries = rng.normal(size=(20, 5))
+        qdf = _vector_df(spark, queries)
+        FETCHED_ROWS["rows"] = 0
+        model.kneighbors(qdf).collect()
+        assert FETCHED_ROWS["rows"] < 300, FETCHED_ROWS["rows"]
+
+    def test_sharded_ann_brute_matches_collected(self, spark_env, rng):
+        adapter, spark = spark_env
+        items = rng.normal(size=(200, 6))
+        df = _vector_df(spark, items)
+        collected = (
+            adapter.TpuApproximateNearestNeighbors(k=4)
+            .setAlgorithm("brute")
+            .fit(df)
+        )
+        sharded = (
+            adapter.TpuApproximateNearestNeighbors(k=4)
+            .setAlgorithm("brute")
+            .setIndexMode("sharded")
+            .fit(df)
+        )
+        idx_c = np.stack(
+            [np.asarray(r.indices) for r in collected.kneighbors(df).collect()]
+        ).astype(int)
+        idx_s = np.stack(
+            [np.asarray(r.indices) for r in sharded.kneighbors(df).collect()]
+        ).astype(int)
+        np.testing.assert_array_equal(idx_s, idx_c)
+
+    def test_sharded_empty_query_dataset(self, spark_env, rng):
+        """Regression (r4 review): an all-filtered query set must come
+        back empty, not crash in np.stack."""
+        adapter, spark = spark_env
+        from pyspark.sql import DataFrame as StubDF
+
+        items = rng.normal(size=(80, 4))
+        df = _vector_df(spark, items)
+        model = adapter.TpuNearestNeighbors(k=3).setIndexMode("sharded").fit(df)
+        empty = StubDF(["features"], [[]])
+        assert model.kneighbors(empty).collect() == []
+
+    def test_sharded_ann_rejects_inverted_lists(self, spark_env, rng):
+        adapter, spark = spark_env
+        items = rng.normal(size=(60, 4))
+        df = _vector_df(spark, items)
+        with pytest.raises(ValueError, match="sharded"):
+            adapter.TpuApproximateNearestNeighbors(k=3).setAlgorithm(
+                "ivfflat"
+            ).setIndexMode("sharded").fit(df)
+
     def test_kneighbors_empty_partition(self, spark_env, rng):
         """Empty query partitions (routine after filter/repartition) must
         not kill the kneighbors job (r2 review)."""
@@ -688,6 +783,26 @@ class TestNeighborsAdapters:
 
 
 class TestTpuDBSCANAndUMAP:
+    def test_transform_closure_broadcast_once(self, spark_env, rng):
+        """VERDICT r3 #7: the training matrix + fitted values ship as ONE
+        broadcast serialization, not one per task closure — the stub's
+        torrent-broadcast counter proves it across a multi-partition
+        transform and a REPEATED transform (the handle is cached)."""
+        adapter, spark = spark_env
+        try:
+            from pyspark import BROADCAST_VALUE_PICKLES
+        except ImportError:
+            pytest.skip("broadcast instrumentation is stub-only")
+        x = np.concatenate(
+            [rng.normal(scale=0.2, size=(40, 3)) + c for c in ([0, 0, 0], [5, 5, 0])]
+        )
+        df = _vector_df(spark, x)
+        model = adapter.TpuDBSCAN().setEps(0.7).setMinSamples(4).fit(df)
+        BROADCAST_VALUE_PICKLES["count"] = 0
+        model.transform(df).collect()
+        model.transform(df).collect()  # cached handle: still one broadcast
+        assert BROADCAST_VALUE_PICKLES["count"] == 1, BROADCAST_VALUE_PICKLES
+
     def test_dbscan(self, spark_env, rng):
         adapter, spark = spark_env
         x = np.concatenate(
