@@ -1,0 +1,69 @@
+"""Lazy host views over device-or-host fitted model state.
+
+Every model family shares one contract (established by PCAModel in r3,
+generalized in r4): a device-resident fit stores the raw ``jax.Array``
+outputs so the fit stays async; the public host views convert (and
+cache) lazily on first read; and pickling — a Spark broadcast, a
+cloudpickle UDF closure — materializes host arrays and NEVER ships live
+device buffers. Eight model classes used to carry that contract as
+copy-pasted ``__getstate__``/property boilerplate; this mixin is the one
+home (r4 review simplification finding), so a future change to the
+pickling rules happens once.
+
+Usage::
+
+    class FooModel(_FooParams, Model, LazyHostState):
+        _lazy_host_fields = {"_coef_raw": ("_coef_np", np.float64)}
+        _pickle_clear = ("_dev_cache",)   # device-side caches -> None
+
+        @property
+        def coefficients(self):
+            return self._lazy_host_view("_coef_raw")
+
+Properties stay declared per class — they carry the public names and
+docstrings; only the conversion/pickling mechanics live here. A dtype of
+``None`` keeps the raw array's own dtype. Subclasses needing extra
+pickle normalization (e.g. device scalars) extend ``__getstate__`` via
+``super()``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class LazyHostState:
+    #: {raw_attr: (cache_attr, host_dtype_or_None)}
+    _lazy_host_fields: dict = {}
+    #: attributes reset to their "empty" value when pickling (device-side
+    #: caches rebuilt lazily after load); value None unless overridden in
+    #: _pickle_clear_values.
+    _pickle_clear: tuple = ()
+    _pickle_clear_values: dict = {}
+
+    def _lazy_host_view(self, raw_attr: str):
+        cache_attr, dtype = self._lazy_host_fields[raw_attr]
+        cached = getattr(self, cache_attr)
+        if cached is None:
+            raw = getattr(self, raw_attr)
+            if raw is not None:
+                cached = (
+                    np.asarray(raw)
+                    if dtype is None
+                    else np.asarray(raw, dtype=dtype)
+                )
+                setattr(self, cache_attr, cached)
+        return cached
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        for raw_attr, (cache_attr, _dtype) in self._lazy_host_fields.items():
+            host = self._lazy_host_view(raw_attr)
+            state[raw_attr] = host
+            state[cache_attr] = host
+        for attr in self._pickle_clear:
+            state[attr] = self._pickle_clear_values.get(attr)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)

@@ -54,6 +54,7 @@ from spark_rapids_ml_tpu.core.data import (
 )
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.ingest import matrix_like
+from spark_rapids_ml_tpu.core.lazy_state import LazyHostState
 from spark_rapids_ml_tpu.core.params import Param, Params, gt, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
     MLReadable,
@@ -288,7 +289,7 @@ class ApproximateNearestNeighbors(_ANNParams, Estimator, MLReadable):
         return model
 
 
-class ApproximateNearestNeighborsModel(_ANNParams, Model):
+class ApproximateNearestNeighborsModel(_ANNParams, Model, LazyHostState):
     """Indexed item set; ``kneighbors`` probes the IVF lists.
 
     With a mesh, queries shard over the data axis against the replicated
@@ -316,25 +317,15 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
         self._items_dev = None  # cached device copy of _search_items()
         self._sharded_brute = None  # cached (items_sharded, mask) for brute+mesh
 
-    def __getstate__(self):
-        """Pickle host state, never live device buffers; device-side
-        caches (index, sharded copies) rebuild lazily after load."""
-        state = dict(self.__dict__)
-        state["_items_raw"] = self.items
-        state["_items_np"] = state["_items_raw"]
-        state["_items_dev"] = None
-        state["_sharded_brute"] = None
-        state["_index"] = None
-        return state
-
-    def __setstate__(self, state):
-        self.__dict__.update(state)
+    # Host views convert lazily; pickling materializes host state and
+    # drops the device-side caches (index, sharded copies — rebuilt
+    # lazily after load). core/lazy_state.LazyHostState.
+    _lazy_host_fields = {"_items_raw": ("_items_np", None)}
+    _pickle_clear = ("_items_dev", "_sharded_brute", "_index")
 
     @property
     def items(self) -> Optional[np.ndarray]:
-        if self._items_np is None and self._items_raw is not None:
-            self._items_np = np.asarray(self._items_raw)
-        return self._items_np
+        return self._lazy_host_view("_items_raw")
 
     def setMesh(self, mesh) -> "ApproximateNearestNeighborsModel":
         self.mesh = mesh

@@ -29,6 +29,7 @@ from spark_rapids_ml_tpu.core.data import (
 )
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.ingest import matrix_like, prepare_rows
+from spark_rapids_ml_tpu.core.lazy_state import LazyHostState
 from spark_rapids_ml_tpu.core.params import Param, Params, gt, toFloat, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
     MLReadable,
@@ -446,12 +447,14 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
 _extract_features = extract_features
 
 
-class KMeansModel(_KMeansParams, Model):
+class KMeansModel(_KMeansParams, Model, LazyHostState):
     """Fitted model: ``clusterCenters()`` (k, d), prediction via transform.
 
     Fitted state may be host numpy OR live jax.Arrays from a device-
-    resident fit; host float64 views convert lazily (the PCAModel
-    contract: a device fit stays async until someone reads the model)."""
+    resident fit; host float64 views convert lazily and pickling
+    materializes host state (core/lazy_state.LazyHostState)."""
+
+    _lazy_host_fields = {"_centers_raw": ("_centers_np", np.float64)}
 
     def __init__(
         self,
@@ -467,23 +470,14 @@ class KMeansModel(_KMeansParams, Model):
         self._iter_raw = numIter
 
     def __getstate__(self):
-        """Pickle host float64 state, never live device buffers (the
-        PCAModel pickling contract — Spark broadcast / cloudpickle)."""
-        state = dict(self.__dict__)
-        state["_centers_raw"] = self._centers
-        state["_centers_np"] = state["_centers_raw"]
+        state = super().__getstate__()
         state["_cost_raw"] = self.trainingCost
         state["_iter_raw"] = self.numIter
         return state
 
-    def __setstate__(self, state):
-        self.__dict__.update(state)
-
     @property
     def _centers(self) -> Optional[np.ndarray]:
-        if self._centers_np is None and self._centers_raw is not None:
-            self._centers_np = np.asarray(self._centers_raw, dtype=np.float64)
-        return self._centers_np
+        return self._lazy_host_view("_centers_raw")
 
     @property
     def trainingCost(self) -> float:

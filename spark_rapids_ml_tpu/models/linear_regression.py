@@ -25,6 +25,7 @@ from spark_rapids_ml_tpu.core.data import (
 )
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.ingest import matrix_like, prepare_labels, prepare_rows
+from spark_rapids_ml_tpu.core.lazy_state import LazyHostState
 from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toString
 from spark_rapids_ml_tpu.core.persistence import (
     MLReadable,
@@ -455,12 +456,14 @@ def _extract_xy(dataset: Any, features_col: str, label_col: str):
     )
 
 
-class LinearRegressionModel(_LinearRegressionParams, Model):
+class LinearRegressionModel(_LinearRegressionParams, Model, LazyHostState):
     """Fitted model: ``coefficients`` (d,), ``intercept``.
 
     Fitted state may be host numpy OR live jax.Arrays from a device-
-    resident fit; the public host float64 views convert lazily (the
-    PCAModel contract — a device fit stays async until read)."""
+    resident fit; host float64 views convert lazily and pickling
+    materializes host state (core/lazy_state.LazyHostState)."""
+
+    _lazy_host_fields = {"_coef_raw": ("_coef_np", np.float64)}
 
     def __init__(
         self,
@@ -474,21 +477,13 @@ class LinearRegressionModel(_LinearRegressionParams, Model):
         self._intercept_raw = intercept
 
     def __getstate__(self):
-        """Pickle host float64 state, never live device buffers."""
-        state = dict(self.__dict__)
-        state["_coef_raw"] = self.coefficients
-        state["_coef_np"] = state["_coef_raw"]
+        state = super().__getstate__()
         state["_intercept_raw"] = self.intercept
         return state
 
-    def __setstate__(self, state):
-        self.__dict__.update(state)
-
     @property
     def coefficients(self) -> Optional[np.ndarray]:
-        if self._coef_np is None and self._coef_raw is not None:
-            self._coef_np = np.asarray(self._coef_raw, dtype=np.float64)
-        return self._coef_np
+        return self._lazy_host_view("_coef_raw")
 
     @property
     def intercept(self) -> float:

@@ -36,6 +36,7 @@ from spark_rapids_ml_tpu.core.ingest import (
     prepare_rows,
     validate_int_labels,
 )
+from spark_rapids_ml_tpu.core.lazy_state import LazyHostState
 from spark_rapids_ml_tpu.core.params import Param, Params, toBoolean, toFloat, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
     MLReadable,
@@ -400,13 +401,18 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
         return self._copyValues(model)
 
 
-class LogisticRegressionModel(_LogisticRegressionParams, Model):
+class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
     """Fitted model. ``weights``: (d, 1) binomial sigmoid column or (d, c)
     softmax matrix; ``intercepts``: (1,) or (c,).
 
     Fitted state may be host numpy OR live jax.Arrays from a device-
-    resident fit; the public host views convert lazily (the PCAModel
-    contract — a device fit stays async until read)."""
+    resident fit; host float64 views convert lazily and pickling
+    materializes host state (core/lazy_state.LazyHostState)."""
+
+    _lazy_host_fields = {
+        "_w_raw": ("_w_np", np.float64),
+        "_b_raw": ("_b_np", np.float64),
+    }
 
     def __init__(
         self,
@@ -425,29 +431,17 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model):
         self._iter_raw = numIter
 
     def __getstate__(self):
-        """Pickle host float64 state, never live device buffers."""
-        state = dict(self.__dict__)
-        state["_w_raw"] = self.weights
-        state["_b_raw"] = self.intercepts
-        state["_w_np"] = state["_w_raw"]
-        state["_b_np"] = state["_b_raw"]
+        state = super().__getstate__()
         state["_iter_raw"] = self.numIter
         return state
 
-    def __setstate__(self, state):
-        self.__dict__.update(state)
-
     @property
     def weights(self) -> Optional[np.ndarray]:
-        if self._w_np is None and self._w_raw is not None:
-            self._w_np = np.asarray(self._w_raw, dtype=np.float64)
-        return self._w_np
+        return self._lazy_host_view("_w_raw")
 
     @property
     def intercepts(self) -> Optional[np.ndarray]:
-        if self._b_np is None and self._b_raw is not None:
-            self._b_np = np.asarray(self._b_raw, dtype=np.float64)
-        return self._b_np
+        return self._lazy_host_view("_b_raw")
 
     @property
     def numIter(self) -> int:

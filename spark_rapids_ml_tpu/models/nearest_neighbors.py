@@ -22,6 +22,7 @@ from spark_rapids_ml_tpu.core.data import (
     is_device_array,
 )
 from spark_rapids_ml_tpu.core.ingest import matrix_like
+from spark_rapids_ml_tpu.core.lazy_state import LazyHostState
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, gt, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -153,7 +154,7 @@ class NearestNeighbors(_NearestNeighborsParams, Estimator, MLReadable):
         return self._copyValues(model)
 
 
-class NearestNeighborsModel(_NearestNeighborsParams, Model):
+class NearestNeighborsModel(_NearestNeighborsParams, Model, LazyHostState):
     """Indexed item set; ``kneighbors`` runs the blocked distance GEMM."""
 
     def __init__(
@@ -176,23 +177,14 @@ class NearestNeighborsModel(_NearestNeighborsParams, Model):
         self._sharded = None  # lazily cached (items_sharded, mask_sharded)
         self._items_stream = items_stream  # re-iterable beyond-HBM index
 
-    def __getstate__(self):
-        """Pickle host state, never live device buffers (and drop the
-        sharded-index cache, which holds device buffers too)."""
-        state = dict(self.__dict__)
-        state["_items_raw"] = self.items
-        state["_items_np"] = state["_items_raw"]
-        state["_sharded"] = None
-        return state
-
-    def __setstate__(self, state):
-        self.__dict__.update(state)
+    # Host views convert lazily; pickling materializes host state and
+    # drops the sharded-index device cache (core/lazy_state.LazyHostState).
+    _lazy_host_fields = {"_items_raw": ("_items_np", None)}
+    _pickle_clear = ("_sharded",)
 
     @property
     def items(self) -> Optional[np.ndarray]:
-        if self._items_np is None and self._items_raw is not None:
-            self._items_np = np.asarray(self._items_raw)
-        return self._items_np
+        return self._lazy_host_view("_items_raw")
 
     def setMesh(self, mesh) -> "NearestNeighborsModel":
         self.mesh = mesh

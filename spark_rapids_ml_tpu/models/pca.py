@@ -29,6 +29,7 @@ from spark_rapids_ml_tpu.core.data import (
     num_features,
 )
 from spark_rapids_ml_tpu.core.estimator import Estimator, HasInputCol, HasOutputCol, Model
+from spark_rapids_ml_tpu.core.lazy_state import LazyHostState
 from spark_rapids_ml_tpu.core.params import Param, gt, toBoolean, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
     MLReadable,
@@ -456,7 +457,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
         model = PCAModel(self.uid, comps, ratio)
         return self._copyValues(model)
 
-class PCAModel(_PCAParams, Model):
+class PCAModel(_PCAParams, Model, LazyHostState):
     """Fitted PCA model: principal components (d, k) + explained variance (k,).
 
     Reference: RapidsPCAModel (RapidsPCA.scala:146-205).
@@ -472,42 +473,31 @@ class PCAModel(_PCAParams, Model):
         # Raw fitted state may be host numpy OR a jax.Array from a
         # device-resident fit; the public `pc`/`explainedVariance` host
         # float64 views convert lazily (and cache) so a device fit stays
-        # async until the model is actually read.
+        # async until the model is actually read. Pickling materializes
+        # host state (core/lazy_state.LazyHostState).
         self._pc_raw = pc
         self._ev_raw = explainedVariance
         self._pc_np: Optional[np.ndarray] = None
         self._ev_np: Optional[np.ndarray] = None
         self._pc_dev_cache: dict = {}
 
-    def __getstate__(self):
-        """Pickle the HOST float64 views, never live device buffers: a
-        device-fitted model crossing a process boundary (Spark broadcast,
-        cloudpickle UDF closure) must not drag a jax.Array along."""
-        state = dict(self.__dict__)
-        state["_pc_raw"] = self.pc
-        state["_ev_raw"] = self.explainedVariance
-        state["_pc_np"] = state["_pc_raw"]
-        state["_ev_np"] = state["_ev_raw"]
-        state["_pc_dev_cache"] = {}
-        return state
-
-    def __setstate__(self, state):
-        self.__dict__.update(state)
+    _lazy_host_fields = {
+        "_pc_raw": ("_pc_np", np.float64),
+        "_ev_raw": ("_ev_np", np.float64),
+    }
+    _pickle_clear = ("_pc_dev_cache",)
+    _pickle_clear_values = {"_pc_dev_cache": {}}
 
     @property
     def pc(self) -> Optional[np.ndarray]:
         """Principal components (d, k) as host float64 (Spark's
         DenseMatrix surface, RapidsPCA.scala:146-150)."""
-        if self._pc_np is None and self._pc_raw is not None:
-            self._pc_np = np.asarray(self._pc_raw, dtype=np.float64)
-        return self._pc_np
+        return self._lazy_host_view("_pc_raw")
 
     @property
     def explainedVariance(self) -> Optional[np.ndarray]:
         """Explained-variance ratios (k,) as host float64."""
-        if self._ev_np is None and self._ev_raw is not None:
-            self._ev_np = np.asarray(self._ev_raw, dtype=np.float64)
-        return self._ev_np
+        return self._lazy_host_view("_ev_raw")
 
     def setInputCol(self, value: str) -> "PCAModel":
         self.set(self.inputCol, value)

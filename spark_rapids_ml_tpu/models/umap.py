@@ -31,6 +31,7 @@ from spark_rapids_ml_tpu.core.data import (
     is_device_array,
 )
 from spark_rapids_ml_tpu.core.ingest import matrix_like
+from spark_rapids_ml_tpu.core.lazy_state import LazyHostState
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, toFloat, toInt, toString
 from spark_rapids_ml_tpu.core.persistence import (
@@ -349,7 +350,7 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
         return self._copyValues(model)
 
 
-class UMAPModel(_UMAPParams, Model):
+class UMAPModel(_UMAPParams, Model, LazyHostState):
     """Fitted model: ``embedding`` (n, dim); transform embeds NEW points
     against the frozen training layout."""
 
@@ -363,7 +364,8 @@ class UMAPModel(_UMAPParams, Model):
     ):
         super().__init__(uid)
         # Fitted state keeps its residence (device-fit state stays on
-        # device); host float64 views convert lazily.
+        # device); host float64 views convert lazily and pickling
+        # materializes host state (core/lazy_state.LazyHostState).
         self._emb_raw = embedding
         self._train_raw = trainData
         self._emb_np: Optional[np.ndarray] = None
@@ -371,29 +373,18 @@ class UMAPModel(_UMAPParams, Model):
         self.a = a
         self.b = b
 
-    def __getstate__(self):
-        """Pickle host float64 state, never live device buffers."""
-        state = dict(self.__dict__)
-        state["_emb_raw"] = self.embedding
-        state["_train_raw"] = self.trainData
-        state["_emb_np"] = state["_emb_raw"]
-        state["_train_np"] = state["_train_raw"]
-        return state
-
-    def __setstate__(self, state):
-        self.__dict__.update(state)
+    _lazy_host_fields = {
+        "_emb_raw": ("_emb_np", np.float64),
+        "_train_raw": ("_train_np", np.float64),
+    }
 
     @property
     def embedding(self) -> Optional[np.ndarray]:
-        if self._emb_np is None and self._emb_raw is not None:
-            self._emb_np = np.asarray(self._emb_raw, dtype=np.float64)
-        return self._emb_np
+        return self._lazy_host_view("_emb_raw")
 
     @property
     def trainData(self) -> Optional[np.ndarray]:
-        if self._train_np is None and self._train_raw is not None:
-            self._train_np = np.asarray(self._train_raw, dtype=np.float64)
-        return self._train_np
+        return self._lazy_host_view("_train_raw")
 
     def copy(self, extra=None) -> "UMAPModel":
         that = UMAPModel(self.uid, self._emb_raw, self._train_raw, self.a, self.b)
