@@ -223,7 +223,7 @@ def optimize_layout(
 
 
 @lru_cache(maxsize=None)
-def _sharded_layout_fn(mesh, n: int, n_epochs: int, neg_rate: int):
+def _sharded_layout_fn(mesh, n: int, k_nbrs: int, n_epochs: int, neg_rate: int):
     """Build (and cache) the jitted shard_map epoch program for one
     (mesh, shape) combination — jit's cache is keyed on the function
     object, so the closure must not be rebuilt per call (the
@@ -236,45 +236,68 @@ def _sharded_layout_fn(mesh, n: int, n_epochs: int, neg_rate: int):
 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 
-    def local(src_b, dst_b, w_b, y0, key, learning_rate, repulsion, a, b):
+    def local(dst_b, w_b, y0, key, learning_rate, repulsion, a, b):
+        # Edges shard by HEAD ROW (n_local, k) — the same structured-head
+        # layout as the single-device epoch: the head gather is a
+        # dynamic slice of y, the head scatter a dense sum + one
+        # dynamic-update-slice; only the dst/negative gathers and the
+        # tail scatter stay on the scalarized path.
         key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+        n_local = dst_b.shape[0]
+        row0 = lax.axis_index(DATA_AXIS) * n_local
+        n_pad_total = n_local * lax.axis_size(DATA_AXIS)
+        dim = y0.shape[1]
 
         def epoch(ep, carry):
             y, key = carry
             key, k_neg = jax.random.split(key)
             alpha = learning_rate * (1.0 - ep / n_epochs)
-            yi = y[src_b]
-            yj = y[dst_b]
-            diff = yi - yj
-            d2 = jnp.sum(diff * diff, axis=1)
+            yh = lax.dynamic_slice_in_dim(y, row0, n_local)  # (n_local, dim)
+            yj = y[dst_b]  # (n_local, k, dim)
+            diff = yh[:, None, :] - yj
+            d2 = jnp.sum(diff * diff, axis=2)
             att = (-2.0 * a * b * jnp.power(jnp.maximum(d2, 1e-12), b - 1.0)) / (
                 1.0 + a * jnp.power(d2, b)
             )
-            g_att = jnp.clip((att * w_b)[:, None] * diff, -4.0, 4.0)
-            neg_idx = jax.random.randint(k_neg, (src_b.shape[0], neg_rate), 0, n)
-            yn = y[neg_idx]
-            diff_n = yi[:, None, :] - yn
-            d2n = jnp.sum(diff_n * diff_n, axis=2)
+            g_att = jnp.clip((att * w_b)[:, :, None] * diff, -4.0, 4.0)
+            neg_idx = jax.random.randint(
+                k_neg, (n_local, k_nbrs, neg_rate), 0, n
+            )
+            yn = y[neg_idx]  # (n_local, k, m, dim)
+            diff_n = yh[:, None, None, :] - yn
+            d2n = jnp.sum(diff_n * diff_n, axis=3)
             rep = (2.0 * repulsion * b) / (
                 (0.001 + d2n) * (1.0 + a * jnp.power(d2n, b))
             )
-            g_rep = jnp.clip((rep * w_b[:, None])[:, :, None] * diff_n, -4.0, 4.0)
-            grad_i = g_att + jnp.sum(g_rep, axis=1)
-            delta = jnp.zeros_like(y).at[src_b].add(alpha * grad_i)
-            delta = delta.at[dst_b].add(-alpha * g_att)
+            g_rep = jnp.clip(
+                (rep * w_b[:, :, None])[:, :, :, None] * diff_n, -4.0, 4.0
+            )
+            grad_head = jnp.sum(g_att + jnp.sum(g_rep, axis=2), axis=1)
+            delta = jnp.zeros_like(y).at[dst_b.reshape(-1)].add(
+                -alpha * g_att.reshape(-1, dim)
+            )
+            head_block = (
+                lax.dynamic_slice_in_dim(delta, row0, n_local)
+                + alpha * grad_head
+            )
+            delta = lax.dynamic_update_slice_in_dim(delta, head_block, row0, 0)
             # ONE collective per epoch: merge the shards' deltas so every
             # device applies the identical (replicated) update.
             delta = lax.psum(delta, DATA_AXIS)
             return y + delta, key
 
-        y, _ = lax.fori_loop(0, n_epochs, epoch, (y0, key))
-        return y
+        # Pad y to the sharded row total so head slices never clamp;
+        # padded rows carry zero weight and are never sampled (negatives
+        # draw from [0, n)).
+        y_pad = jnp.pad(y0, ((0, n_pad_total - n), (0, 0)))
+        y_pad, _ = lax.fori_loop(0, n_epochs, epoch, (y_pad, key))
+        return y_pad[:n]
 
     fit = shard_map(
         local,
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P(),
+            P(DATA_AXIS, None), P(DATA_AXIS, None), P(), P(),
             P(), P(), P(), P(),
         ),
         out_specs=P(),
@@ -298,12 +321,14 @@ def optimize_layout_sharded(
 ) -> jax.Array:
     """Mesh-sharded synchronous-epoch layout optimization (fit mode).
 
-    The epoch is EDGE-parallel: edges (and their negative draws) shard over
-    the mesh data axis, each shard scatter-adds its gradient contributions
-    into a local (n, dim) delta, and ONE psum per epoch merges the deltas
-    over ICI — the embedding stays replicated, so the per-epoch wire cost
-    is the (n, dim) delta, independent of edge count (VERDICT r1 missing
-    item 6: previously only the kNN-graph stage sharded).
+    The epoch shards edges by HEAD ROW over the mesh data axis (the
+    structured-head layout of the single-device epoch: the head side of
+    every edge is a slice/dense-sum, never a gather/scatter); each shard
+    accumulates its gradient contributions into a local (n, dim) delta,
+    and ONE psum per epoch merges the deltas over ICI — the embedding
+    stays replicated, so the per-epoch wire cost is the (n, dim) delta,
+    independent of edge count (VERDICT r1 missing item 6: previously
+    only the kNN-graph stage sharded).
 
     Negative samples are drawn per shard (key folded with the shard index),
     so the draw SEQUENCE differs from the single-device path while the
@@ -316,31 +341,25 @@ def optimize_layout_sharded(
 
     n, dim = embedding.shape
     k = graph.indices.shape[1]
-    src = jnp.broadcast_to(
-        jnp.arange(n, dtype=jnp.int32)[:, None], (n, k)
-    ).reshape(-1)
-    dst = graph.indices.reshape(-1)
-    w = graph.weight.reshape(-1)
-    e = src.shape[0]
+    dst = graph.indices  # (n, k)
+    w = graph.weight
     dp = int(mesh.shape[DATA_AXIS])
-    pad = (-e) % dp
+    pad = (-n) % dp
     if pad:
-        # Padded edges carry zero weight: their attractive AND repulsive
-        # terms are scaled by w, so they contribute exactly nothing.
-        src = jnp.concatenate([src, jnp.zeros(pad, jnp.int32)])
-        dst = jnp.concatenate([dst, jnp.zeros(pad, jnp.int32)])
-        w = jnp.concatenate([w, jnp.zeros(pad, w.dtype)])
+        # Padded head rows carry zero weight: their attractive AND
+        # repulsive terms are scaled by w, so they contribute nothing.
+        dst = jnp.concatenate([dst, jnp.zeros((pad, k), jnp.int32)])
+        w = jnp.concatenate([w, jnp.zeros((pad, k), w.dtype)])
 
-    edge_sharding = NamedSharding(mesh, P(DATA_AXIS))
-    src = jax.device_put(src, edge_sharding)
-    dst = jax.device_put(dst, edge_sharding)
-    w = jax.device_put(w, edge_sharding)
+    row_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    dst = jax.device_put(dst, row_sharding)
+    w = jax.device_put(w, row_sharding)
     y0 = jax.device_put(embedding.astype(jnp.float32), NamedSharding(mesh, P()))
 
-    fit = _sharded_layout_fn(mesh, n, n_epochs, neg_rate)
+    fit = _sharded_layout_fn(mesh, n, k, n_epochs, neg_rate)
     f32 = jnp.float32
     return fit(
-        src, dst, w, y0, key,
+        dst, w, y0, key,
         jnp.asarray(learning_rate, f32), jnp.asarray(repulsion, f32),
         jnp.asarray(a, f32), jnp.asarray(b, f32),
     )
