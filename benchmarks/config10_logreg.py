@@ -41,7 +41,9 @@ def main() -> None:
 
     def run() -> None:
         model = est.fit((x, y))
-        jax.block_until_ready(model._w_raw)
+        # Scalar readback: block_until_ready does not reliably wait
+        # under the relay tunnel (bench.py docstring).
+        float(model._w_raw[0, 0])
 
     elapsed = time_median(run)
     flop = 2.0 * 2.0 * N * D * ITERS  # fwd + grad GEMM per iteration
