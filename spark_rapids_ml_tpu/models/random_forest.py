@@ -233,23 +233,49 @@ class _RandomForestParams(Params):
         return self._chain(self.weightCol, v)
 
 
-def _hist_exact_in_bf16(row_stats: np.ndarray, sample_w) -> bool:
+@jax.jit
+def _exactness_device(rs, w):
+    """Fused device-side bf16-exactness predicate: ONE scalar readback
+    (integrality of stats AND weights, and the max product bound) — a
+    device-resident fit must not pull the (n, S) one-hot to host, and
+    even the host path should pay one sync, not two (each readback is a
+    full round trip through a relay tunnel)."""
+    rs = rs.astype(jnp.float32)
+    return (
+        jnp.all(rs == jnp.rint(rs))
+        & jnp.all(w == jnp.rint(w))
+        & (jnp.max(jnp.abs(rs)) * jnp.max(w) <= 256.0)
+    )
+
+
+@jax.jit
+def _weight_exact_and_max(w):
+    """[weights_all_integer, max_weight] as one device array — one pull."""
+    return jnp.stack(
+        [jnp.all(w == jnp.rint(w)).astype(jnp.float32), jnp.max(w)]
+    )
+
+
+def _hist_exact_in_bf16(row_stats, sample_w) -> bool:
     """True when every histogram operand survives bf16 rounding. The
     one-pass DEFAULT-precision histogram feeds ``sample_weight * stat``
     to the MXU as bf16 (fp32 accumulation), so exactness needs the
     *product* — integer and <= 256 — not just the raw stats: an integer
     weightCol of 129 drawn 3 times by the bootstrap contributes 387,
-    which bf16 rounds."""
+    which bf16 rounds. Bootstrap draws are integral today
+    (Poisson/Bernoulli), but the guard verifies that rather than assume
+    it."""
+    if is_device_array(row_stats):
+        if row_stats.size == 0:
+            return False
+        return bool(_exactness_device(row_stats, jnp.asarray(sample_w)))
     rs = np.asarray(row_stats, dtype=np.float32)
     if rs.size == 0 or not np.array_equal(rs, np.rint(rs)):
         return False
-    # sample_w may be device-resident (T, n): reduce on device, pull scalars.
-    # Bootstrap draws are integral today (Poisson/Bernoulli), but the guard
-    # verifies that rather than assume it.
-    if not bool(jnp.all(sample_w == jnp.rint(sample_w))):
+    w_stats = np.asarray(_weight_exact_and_max(jnp.asarray(sample_w)))
+    if not w_stats[0]:
         return False
-    max_prod = float(np.abs(rs).max()) * float(jnp.max(sample_w))
-    return max_prod <= 256.0
+    return float(np.abs(rs).max()) * float(w_stats[1]) <= 256.0
 
 
 def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarray,
