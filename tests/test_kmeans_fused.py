@@ -71,6 +71,23 @@ class TestFusedOps:
         pad_rows = xt.shape[1] - n_true
         assert float(jnp.sum(c)) == pytest.approx(n_true + pad_rows)
 
+    def test_assign_clusters_blocked_parity(self):
+        # The row-blocked assignment (used by the IVF coarse quantizer at
+        # shapes whose full (n, k) distance matrix would blow HBM) must
+        # match the unblocked op exactly, ragged final block included.
+        from spark_rapids_ml_tpu.ops.kmeans import (
+            assign_clusters,
+            assign_clusters_blocked,
+        )
+
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.normal(size=(1001, 12)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(17, 12)).astype(np.float32))
+        l_b, d_b = assign_clusters_blocked(x, c, block_rows=128)
+        l_u, d_u = assign_clusters(x, c)
+        assert np.array_equal(np.asarray(l_b), np.asarray(l_u))
+        assert np.allclose(np.asarray(d_b), np.asarray(d_u), atol=1e-6)
+
     def test_auto_block_n_respects_vmem(self):
         bn_small = auto_block_n(16, 100)
         assert 4096 <= bn_small <= 8192 and bn_small % 128 == 0
