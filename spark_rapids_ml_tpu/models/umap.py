@@ -66,6 +66,10 @@ class _UMAPParams(Params):
     minDist = Param("_", "minDist", "minimum embedded distance", toFloat)
     spread = Param("_", "spread", "embedded scale", toFloat)
     negativeSampleRate = Param("_", "negativeSampleRate", "negatives per edge", toInt)
+    negativePoolSize = Param(
+        "_", "negativePoolSize",
+        "shared negative pool per epoch (0 = per-edge sampling)", toInt,
+    )
     repulsionStrength = Param("_", "repulsionStrength", "repulsion weight", toFloat)
     seed = Param("_", "seed", "random seed", toInt)
     featuresCol = Param("_", "featuresCol", "features column name", toString)
@@ -88,6 +92,7 @@ class _UMAPParams(Params):
             minDist=0.1,
             spread=1.0,
             negativeSampleRate=5,
+            negativePoolSize=256,
             repulsionStrength=1.0,
             seed=0,
             featuresCol="features",
@@ -124,6 +129,9 @@ class _UMAPParams(Params):
 
     def getNegativeSampleRate(self) -> int:
         return self.getOrDefault(self.negativeSampleRate)
+
+    def getNegativePoolSize(self) -> int:
+        return self.getOrDefault(self.negativePoolSize)
 
     def getRepulsionStrength(self) -> float:
         return self.getOrDefault(self.repulsionStrength)
@@ -175,6 +183,17 @@ class _UMAPParams(Params):
 
     def setNegativeSampleRate(self, v: int):
         return self._chain(self.negativeSampleRate, v)
+
+    def setNegativePoolSize(self, v: int):
+        """Per-epoch shared negative pool size (r5 default path): repulsion
+        is scored against one pool of ``v`` uniform draws with dense
+        (n, v) distance GEMMs instead of E * negativeSampleRate random
+        gathers — an importance-weighted equivalent estimator
+        (:func:`ops.umap.optimize_layout`). ``0`` restores exact per-edge
+        sampling (the umap-learn/cuML scheme, gather-bound on TPU)."""
+        if v < 0:
+            raise ValueError(f"negativePoolSize must be >= 0, got {v}")
+        return self._chain(self.negativePoolSize, v)
 
     def setRepulsionStrength(self, v: float):
         return self._chain(self.repulsionStrength, v)
@@ -332,6 +351,7 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
                 k_opt,
                 n_epochs=self._auto_epochs(n),
                 neg_rate=self.getNegativeSampleRate(),
+                neg_pool=self.getNegativePoolSize(),
                 learning_rate=self.getLearningRate(),
                 repulsion=self.getRepulsionStrength(),
                 a=a,
@@ -443,6 +463,7 @@ class UMAPModel(_UMAPParams, Model, LazyHostState):
                 jax.random.key(self.getSeed() + 1),
                 n_epochs=epochs,
                 neg_rate=self.getNegativeSampleRate(),
+                neg_pool=self.getNegativePoolSize(),
                 learning_rate=self.getLearningRate(),
                 repulsion=self.getRepulsionStrength(),
                 a=self.a,

@@ -136,7 +136,7 @@ def find_ab_params(spread: float, min_dist: float) -> Tuple[float, float]:
 
 @partial(
     jax.jit,
-    static_argnames=("n_epochs", "neg_rate", "move_other"),
+    static_argnames=("n_epochs", "neg_rate", "neg_pool", "move_other"),
 )
 def optimize_layout(
     embedding: jax.Array,  # (n, dim) initial layout
@@ -145,6 +145,7 @@ def optimize_layout(
     *,
     n_epochs: int,
     neg_rate: int = 5,
+    neg_pool: int = 256,
     learning_rate: float = 1.0,
     repulsion: float = 1.0,
     a: float = 1.577,
@@ -155,8 +156,8 @@ def optimize_layout(
     """Synchronous-epoch UMAP layout optimization.
 
     Every epoch: gradients of the fuzzy cross-entropy for all E edges
-    (attraction, weighted by membership) and E * neg_rate uniformly drawn
-    negatives (repulsion), applied with a linearly annealed step —
+    (attraction, weighted by membership) and a repulsion term from
+    uniformly drawn negatives, applied with a linearly annealed step —
     umap-learn's sampling schedule folded into weights. ``target`` (if
     given) is a fixed reference point set the tail of each edge attracts
     to instead of the live embedding — the transform-time mode where
@@ -167,8 +168,25 @@ def optimize_layout(
     list is EXACTLY (n heads x k neighbors), so every head-side access is
     STRUCTURED — the head "gather" is a broadcast of y and the head
     "scatter" is a dense (n, k, ...) sum over k — leaving only the
-    genuinely random accesses (the dst/negative gathers and the tail
-    scatter) on the slow scalarized path.
+    genuinely random accesses on the slow scalarized path.
+
+    Negative sampling (r5): ``neg_pool > 0`` (default) replaces the
+    E * neg_rate per-edge random gathers — measured 96% of the fit wall
+    in r4 (BASELINE config 13) — with ONE shared pool of ``neg_pool``
+    uniform draws per epoch. Repulsion of every head against the pool is
+    dense algebra: squared distances via ``y @ pool.T`` (MXU GEMM) plus
+    norm broadcasts, and because the per-sample coefficient (not the
+    per-component gradient) carries the clip, the gradient factorizes as
+    ``rowsum(c) * y - c @ pool`` — two dense contractions, no gather.
+    The estimator stays unbiased w.r.t. the per-edge one: each head's
+    k * neg_rate uniform draws with per-edge weights w_ij are replaced
+    by neg_pool shared uniform draws importance-weighted by
+    sum_j(w_ij) * neg_rate / neg_pool, and the clip cap scales by the
+    same ratio so the maximum per-epoch repulsion magnitude is preserved
+    (cap * n_samples is invariant). Pool samples are shared across heads
+    (correlated within an epoch, fresh draw every epoch); per-head
+    expectation and total weight match the per-edge formulation exactly.
+    ``neg_pool=0`` keeps the legacy per-edge path.
     """
     n, dim = embedding.shape
     k = graph.indices.shape[1]
@@ -176,14 +194,22 @@ def optimize_layout(
     w = graph.weight  # (n, k)
     ref = embedding if target is None else target
     n_ref = ref.shape[0]
+    w_sum = jnp.sum(w, axis=1)  # (n,) total edge weight per head
 
     def epoch(ep, carry):
         y, key = carry
         key, k_neg = jax.random.split(key)
         alpha = learning_rate * (1.0 - ep / n_epochs)
 
+        # Edge gathers stay in ROW form — measured on v5e (r5): splitting
+        # the (n, k, dim) gather into dim flat (n,) -> (n, k) lookups is
+        # 1.5x SLOWER (scalar gathers pay per element; the row gather
+        # amortizes index handling across the dim-wide row), the opposite
+        # of the forest per-class-gather lesson, whose tables are
+        # hundreds wide.
         yi = y[:, None, :]  # (n, 1, dim) — the head side is a broadcast
-        yj = (y if target is None else target)[dst]  # (n, k, dim)
+        ref_y = y if target is None else target
+        yj = ref_y[dst]  # (n, k, dim)
         diff = yi - yj
         d2 = jnp.sum(diff * diff, axis=2)  # (n, k)
         # Attractive: d/dy_i of log(1/(1 + a d^2b)) -> -2ab d^{2(b-1)}/(1+a d^2b)
@@ -192,25 +218,52 @@ def optimize_layout(
         )
         g_att = jnp.clip((att * w)[:, :, None] * diff, -4.0, 4.0)  # (n, k, dim)
 
-        # Same RNG stream as the flat-edge formulation: draw (E, m), view
-        # as (n, k, m).
-        neg_idx = jax.random.randint(k_neg, (n * k, neg_rate), 0, n_ref).reshape(
-            n, k, neg_rate
-        )
-        # Negatives come from the LIVE layout in fit mode (repulsion must
-        # track the moving points), from the frozen targets in transform.
-        yn = (y if target is None else target)[neg_idx]  # (n, k, m, dim)
-        diff_n = y[:, None, None, :] - yn
-        d2n = jnp.sum(diff_n * diff_n, axis=3)  # (n, k, m)
-        rep = (2.0 * repulsion * b) / (
-            (0.001 + d2n) * (1.0 + a * jnp.power(d2n, b))
-        )
-        g_rep = jnp.clip((rep * w[:, :, None])[:, :, :, None] * diff_n, -4.0, 4.0)
+        if neg_pool > 0:
+            # Shared pool: neg_pool gathers per epoch (vs n*k*neg_rate),
+            # then repulsion is dense (n, s) work on the MXU/VPU.
+            pool_idx = jax.random.randint(k_neg, (neg_pool,), 0, n_ref)
+            pool = (y if target is None else target)[pool_idx]  # (s, dim)
+            y2 = jnp.sum(y * y, axis=1)  # (n,)
+            p2 = jnp.sum(pool * pool, axis=1)  # (s,)
+            cross = y @ pool.T  # (n, s) GEMM
+            d2n = jnp.maximum(y2[:, None] + p2[None, :] - 2.0 * cross, 0.0)
+            rep = (2.0 * repulsion * b) / (
+                (0.001 + d2n) * (1.0 + a * jnp.power(d2n, b))
+            )
+            # Importance weight: each pool sample stands for
+            # k * neg_rate / s per-edge draws of mean weight w_sum / k.
+            c = rep * (w_sum[:, None] * (neg_rate / neg_pool))
+            # Clip on the coefficient: per-edge path caps each of the
+            # k * neg_rate per-sample gradients at 4; each pool sample
+            # represents k * neg_rate / s of them, so cap scales by that
+            # ratio (|c * diff| <= c * sqrt(d2n) <= cap).
+            cap = 4.0 * k * neg_rate / neg_pool
+            c = jnp.minimum(c, cap / jnp.sqrt(d2n + 1e-12))
+            g_rep_head = (
+                jnp.sum(c, axis=1, keepdims=True) * y - c @ pool
+            )  # (n, dim): sum_p c_ip (y_i - pool_p), factorized
+            grad_head = jnp.sum(g_att, axis=1) + g_rep_head
+        else:
+            # Legacy per-edge negatives: draw (E, m), view as (n, k, m).
+            neg_idx = jax.random.randint(
+                k_neg, (n * k, neg_rate), 0, n_ref
+            ).reshape(n, k, neg_rate)
+            # Negatives come from the LIVE layout in fit mode (repulsion
+            # must track the moving points), frozen targets in transform.
+            yn = ref_y[neg_idx]  # (n, k, m, dim)
+            diff_n = y[:, None, None, :] - yn
+            d2n = jnp.sum(diff_n * diff_n, axis=3)  # (n, k, m)
+            rep = (2.0 * repulsion * b) / (
+                (0.001 + d2n) * (1.0 + a * jnp.power(d2n, b))
+            )
+            g_rep = jnp.clip(
+                (rep * w[:, :, None])[:, :, :, None] * diff_n, -4.0, 4.0
+            )
+            grad_head = jnp.sum(g_att + jnp.sum(g_rep, axis=2), axis=1)
 
         # Head moves along both terms (att < 0 pulls toward the neighbor,
-        # rep > 0 pushes off the negatives): a DENSE sum over (k, m) — no
-        # scatter. The tail mirrors attraction (true scatter, dst random).
-        grad_head = jnp.sum(g_att + jnp.sum(g_rep, axis=2), axis=1)  # (n, dim)
+        # rep > 0 pushes off the negatives): a DENSE sum — no scatter.
+        # The tail mirrors attraction (true scatter, dst random).
         delta = alpha * grad_head
         if move_other and target is None:
             delta = delta + jnp.zeros_like(y).at[dst.reshape(-1)].add(
@@ -223,7 +276,9 @@ def optimize_layout(
 
 
 @lru_cache(maxsize=None)
-def _sharded_layout_fn(mesh, n: int, k_nbrs: int, n_epochs: int, neg_rate: int):
+def _sharded_layout_fn(
+    mesh, n: int, k_nbrs: int, n_epochs: int, neg_rate: int, neg_pool: int
+):
     """Build (and cache) the jitted shard_map epoch program for one
     (mesh, shape) combination — jit's cache is keyed on the function
     object, so the closure must not be rebuilt per call (the
@@ -242,17 +297,28 @@ def _sharded_layout_fn(mesh, n: int, k_nbrs: int, n_epochs: int, neg_rate: int):
         # dynamic slice of y, the head scatter a dense sum + one
         # dynamic-update-slice; only the dst/negative gathers and the
         # tail scatter stay on the scalarized path.
-        key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+        #
+        # Pooled mode (neg_pool > 0) draws the shared pool from the UNFOLDED
+        # (replicated) key so every shard scores the identical pool — no
+        # per-shard randomness remains, and the epoch matches the
+        # single-device pooled path up to psum reduction order. Only the
+        # legacy per-edge path folds the key per shard.
+        shard_key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+        if neg_pool <= 0:
+            key = shard_key
         n_local = dst_b.shape[0]
         row0 = lax.axis_index(DATA_AXIS) * n_local
         n_pad_total = n_local * lax.axis_size(DATA_AXIS)
         dim = y0.shape[1]
+        w_sum_b = jnp.sum(w_b, axis=1)  # (n_local,)
 
         def epoch(ep, carry):
             y, key = carry
             key, k_neg = jax.random.split(key)
             alpha = learning_rate * (1.0 - ep / n_epochs)
             yh = lax.dynamic_slice_in_dim(y, row0, n_local)  # (n_local, dim)
+            # Row gather, as in the single-device epoch (r5 measured the
+            # component-split variant 1.5x SLOWER on v5e).
             yj = y[dst_b]  # (n_local, k, dim)
             diff = yh[:, None, :] - yj
             d2 = jnp.sum(diff * diff, axis=2)
@@ -260,19 +326,37 @@ def _sharded_layout_fn(mesh, n: int, k_nbrs: int, n_epochs: int, neg_rate: int):
                 1.0 + a * jnp.power(d2, b)
             )
             g_att = jnp.clip((att * w_b)[:, :, None] * diff, -4.0, 4.0)
-            neg_idx = jax.random.randint(
-                k_neg, (n_local, k_nbrs, neg_rate), 0, n
-            )
-            yn = y[neg_idx]  # (n_local, k, m, dim)
-            diff_n = yh[:, None, None, :] - yn
-            d2n = jnp.sum(diff_n * diff_n, axis=3)
-            rep = (2.0 * repulsion * b) / (
-                (0.001 + d2n) * (1.0 + a * jnp.power(d2n, b))
-            )
-            g_rep = jnp.clip(
-                (rep * w_b[:, :, None])[:, :, :, None] * diff_n, -4.0, 4.0
-            )
-            grad_head = jnp.sum(g_att + jnp.sum(g_rep, axis=2), axis=1)
+            if neg_pool > 0:
+                pool_idx = jax.random.randint(k_neg, (neg_pool,), 0, n)
+                pool = y[pool_idx]  # (s, dim) — y is replicated
+                yh2 = jnp.sum(yh * yh, axis=1)
+                p2 = jnp.sum(pool * pool, axis=1)
+                cross = yh @ pool.T  # (n_local, s)
+                d2n = jnp.maximum(
+                    yh2[:, None] + p2[None, :] - 2.0 * cross, 0.0
+                )
+                rep = (2.0 * repulsion * b) / (
+                    (0.001 + d2n) * (1.0 + a * jnp.power(d2n, b))
+                )
+                c = rep * (w_sum_b[:, None] * (neg_rate / neg_pool))
+                cap = 4.0 * k_nbrs * neg_rate / neg_pool
+                c = jnp.minimum(c, cap / jnp.sqrt(d2n + 1e-12))
+                g_rep_head = jnp.sum(c, axis=1, keepdims=True) * yh - c @ pool
+                grad_head = jnp.sum(g_att, axis=1) + g_rep_head
+            else:
+                neg_idx = jax.random.randint(
+                    k_neg, (n_local, k_nbrs, neg_rate), 0, n
+                )
+                yn = y[neg_idx]  # (n_local, k, m, dim)
+                diff_n = yh[:, None, None, :] - yn
+                d2n = jnp.sum(diff_n * diff_n, axis=3)
+                rep = (2.0 * repulsion * b) / (
+                    (0.001 + d2n) * (1.0 + a * jnp.power(d2n, b))
+                )
+                g_rep = jnp.clip(
+                    (rep * w_b[:, :, None])[:, :, :, None] * diff_n, -4.0, 4.0
+                )
+                grad_head = jnp.sum(g_att + jnp.sum(g_rep, axis=2), axis=1)
             delta = jnp.zeros_like(y).at[dst_b.reshape(-1)].add(
                 -alpha * g_att.reshape(-1, dim)
             )
@@ -314,6 +398,7 @@ def optimize_layout_sharded(
     *,
     n_epochs: int,
     neg_rate: int = 5,
+    neg_pool: int = 256,
     learning_rate: float = 1.0,
     repulsion: float = 1.0,
     a: float = 1.577,
@@ -330,10 +415,13 @@ def optimize_layout_sharded(
     independent of edge count (VERDICT r1 missing item 6: previously
     only the kNN-graph stage sharded).
 
-    Negative samples are drawn per shard (key folded with the shard index),
-    so the draw SEQUENCE differs from the single-device path while the
-    sampling distribution and count per edge are identical — same
-    optimization, different RNG stream, like any reseeded SGD run.
+    Pooled negatives (``neg_pool > 0``, default) draw ONE shared pool per
+    epoch from the replicated key, so all shards score the identical pool
+    and the result matches the single-device pooled path up to psum
+    reduction order. The legacy per-edge path (``neg_pool=0``) draws
+    negatives per shard (key folded with the shard index): same sampling
+    distribution and count per edge, different RNG stream — like any
+    reseeded SGD run.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -356,7 +444,7 @@ def optimize_layout_sharded(
     w = jax.device_put(w, row_sharding)
     y0 = jax.device_put(embedding.astype(jnp.float32), NamedSharding(mesh, P()))
 
-    fit = _sharded_layout_fn(mesh, n, k, n_epochs, neg_rate)
+    fit = _sharded_layout_fn(mesh, n, k, n_epochs, neg_rate, neg_pool)
     f32 = jnp.float32
     return fit(
         dst, w, y0, key,

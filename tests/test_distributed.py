@@ -397,6 +397,32 @@ class TestDistributedUMAPOptimize:
         # clusters must still clearly separate.
         assert separation(emb_u) > 1.8, separation(emb_u)
 
+    def test_sharded_pooled_epoch_matches_unsharded(self, rng, mesh_8x1):
+        """Pooled mode draws the shared pool from the replicated key, so
+        the sharded epoch computes the SAME update as the single-device
+        one (only psum reduction order differs) — checked over one epoch,
+        before float drift can amplify through the SGD trajectory."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models.umap import _knn_excluding_self
+        from spark_rapids_ml_tpu.ops.umap import (
+            fuzzy_simplicial_set,
+            optimize_layout,
+            optimize_layout_sharded,
+        )
+
+        x = jnp.asarray(rng.normal(size=(96, 6)), dtype=jnp.float32)
+        d, i = _knn_excluding_self(x, 8, "euclidean")
+        graph = fuzzy_simplicial_set(i, d)
+        emb0 = jnp.asarray(rng.normal(size=(96, 2)), dtype=jnp.float32)
+        kw = dict(n_epochs=1, neg_rate=5, neg_pool=64, a=1.577, b=0.895)
+        e_s = np.asarray(
+            optimize_layout_sharded(mesh_8x1, emb0, graph, jax.random.key(3), **kw)
+        )
+        e_u = np.asarray(optimize_layout(emb0, graph, jax.random.key(3), **kw))
+        np.testing.assert_allclose(e_s, e_u, atol=1e-5)
+
 
 class TestStreamedMeshCovariance:
     """Streaming + mesh — the north-star loop: blocks stream in, each is

@@ -217,6 +217,71 @@ class TestUMAP:
         assert u._auto_epochs(50_000) == 200
 
 
+class TestPooledNegatives:
+    """The r5 epoch-shared negative pool (dense GEMM repulsion) must be an
+    equivalent estimator to per-edge sampling: same embedding QUALITY, not
+    the same stochastic trajectory (different RNG usage by design)."""
+
+    def test_pooled_quality_matches_per_edge(self, rng):
+        manifold = pytest.importorskip("sklearn.manifold")
+        x, labels = _three_blobs(rng, n_per=50)
+        pooled = UMAP().setNNeighbors(10).setNEpochs(150).setSeed(1).fit(x)
+        per_edge = (
+            UMAP().setNNeighbors(10).setNEpochs(150).setSeed(1)
+            .setNegativePoolSize(0).fit(x)
+        )
+        t_pool = manifold.trustworthiness(x, pooled.embedding, n_neighbors=10)
+        t_edge = manifold.trustworthiness(x, per_edge.embedding, n_neighbors=10)
+        # Neighborhood preservation parity: pooled within 0.03 of per-edge
+        # (both must clear the absolute bar the suite holds UMAP to).
+        assert t_pool > 0.85, t_pool
+        assert t_pool > t_edge - 0.03, (t_pool, t_edge)
+        assert _separation_ratio(pooled.embedding, labels) > 2.0
+
+    def test_pool_smaller_and_larger_than_n(self, rng):
+        # Pool size is independent of n: oversampling (s > n) and heavy
+        # subsampling both stay finite and separate the blobs.
+        x, labels = _three_blobs(rng, n_per=30)  # n = 90
+        for s in (32, 512):
+            emb = (
+                UMAP().setNNeighbors(8).setNEpochs(120).setSeed(2)
+                .setNegativePoolSize(s).fit(x).embedding
+            )
+            assert np.all(np.isfinite(emb))
+            assert _separation_ratio(emb, labels) > 1.5, s
+
+    def test_per_edge_path_deterministic(self, rng):
+        x, _ = _three_blobs(rng, n_per=20)
+        kw = dict()
+        e1 = (
+            UMAP().setNEpochs(40).setSeed(9).setNegativePoolSize(0)
+            .fit(x).embedding
+        )
+        e2 = (
+            UMAP().setNEpochs(40).setSeed(9).setNegativePoolSize(0)
+            .fit(x).embedding
+        )
+        np.testing.assert_allclose(e1, e2, atol=1e-6)
+
+    def test_pool_param_validation(self):
+        with pytest.raises(ValueError, match="negativePoolSize"):
+            UMAP().setNegativePoolSize(-1)
+
+    def test_transform_uses_pool(self, rng):
+        # Transform-mode pooled repulsion draws from the FROZEN training
+        # layout; new points must still land near their blob.
+        x, labels = _three_blobs(rng, n_per=40)
+        model = UMAP().setNNeighbors(10).setNEpochs(120).setSeed(3).fit(x)
+        x_new = rng.normal(size=(15, x.shape[1]))
+        x_new[:, 1] += 12.0  # blob 1
+        emb_new = model.transform(x_new)
+        cents = np.stack(
+            [model.embedding[labels == c].mean(axis=0) for c in range(3)]
+        )
+        d = np.linalg.norm(emb_new[:, None, :] - cents[None, :, :], axis=2)
+        assert np.mean(np.argmin(d, axis=1) == 1) >= 0.9
+
+
 class TestResume:
     def test_init_embedding_resumes_optimization(self, rng):
         """An interrupted fit's embedding seeds a continuation that reaches
