@@ -50,6 +50,37 @@ def main() -> None:
         **bytes_roofline(4.0 * N * D * 2, elapsed),
     )
 
+    # Adversarial chain topology (VERDICT r4 #5): one cluster whose
+    # diameter equals n. The old diffusion converged in O(diameter)
+    # expensive eps sweeps; with full path compression between sweeps the
+    # sweep count is O(log n) (a small constant for a pure chain).
+    from spark_rapids_ml_tpu.ops.dbscan import dbscan_labels
+
+    n_chain = 100_000
+    chain = jnp.stack(
+        [jnp.arange(n_chain, dtype=jnp.float32) * 0.5, jnp.zeros(n_chain)],
+        axis=1,
+    )
+    float(jnp.sum(chain[0]))
+
+    sweeps_out = {}
+
+    def run_chain() -> None:
+        labels, _, sweeps = dbscan_labels(chain, 0.6, 2, return_sweeps=True)
+        sweeps_out["sweeps"] = int(sweeps)  # scalar sync (tunnel-safe)
+        int(labels[0])
+
+    t_chain = time_median(run_chain)
+    emit(
+        "dbscan_chain_100k_diameter_n",
+        n_chain / t_chain,
+        "rows/s",
+        wall_s=round(t_chain, 4),
+        eps_sweeps=sweeps_out["sweeps"],
+        **roofline(2.0 * n_chain * n_chain * 2, t_chain, "highest"),
+        **bytes_roofline(4.0 * n_chain * 2 * 2, t_chain),
+    )
+
 
 if __name__ == "__main__":
     main()
