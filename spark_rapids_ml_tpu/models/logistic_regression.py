@@ -578,11 +578,19 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
             class_name="org.apache.spark.ml.classification.LogisticRegressionModel",
             extra_metadata={"numClasses": self.numClasses, "numIter": self.numIter},
         )
+        # Spark LogisticRegressionModel's exact data row (its Data case
+        # class): numClasses, numFeatures, interceptVector,
+        # coefficientMatrix ((1, d) binomial / (C, d) multinomial),
+        # isMultinomial — byte-compatible with upstream readers
+        # (VERDICT r4 #6; the SURVEY §3.4 discipline).
         save_data(
             path,
             {
-                "weights": ("matrix", self.weights),
-                "intercepts": ("vector", self.intercepts),
+                "numClasses": ("scalar", int(self.numClasses)),
+                "numFeatures": ("scalar", int(self.weights.shape[0])),
+                "interceptVector": ("vector", self.intercepts),
+                "coefficientMatrix": ("matrix", self.coefficientMatrix),
+                "isMultinomial": ("scalar", bool(self.intercepts.shape[0] > 1)),
             },
         )
 
@@ -590,11 +598,19 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
     def _load_impl(cls, path: str) -> "LogisticRegressionModel":
         metadata = load_metadata(path, expected_class="LogisticRegressionModel")
         data = load_data(path)
+        if "coefficientMatrix" in data:
+            weights = np.asarray(data["coefficientMatrix"]).T  # (d, 1|C)
+            intercepts = np.asarray(data["interceptVector"])
+            n_classes = int(data.get("numClasses", metadata.get("numClasses", 2)))
+        else:  # directories written before the Spark-schema alignment (r5)
+            weights = data["weights"]
+            intercepts = data["intercepts"]
+            n_classes = metadata.get("numClasses", 2)
         model = cls(
             metadata["uid"],
-            data["weights"],
-            data["intercepts"],
-            numClasses=metadata.get("numClasses", 2),
+            weights,
+            intercepts,
+            numClasses=n_classes,
             numIter=metadata.get("numIter", 0),
         )
         get_and_set_params(model, metadata)

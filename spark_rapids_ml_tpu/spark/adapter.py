@@ -1258,6 +1258,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             leaf_value = np.zeros((T, n_total, s_out), dtype=np.float32)
             node_weight = np.zeros((T, n_total), dtype=np.float32)
             node_gain = np.zeros((T, n_total), dtype=np.float32)
+            node_imp = np.zeros((T, n_total), dtype=np.float32)
 
             def partials_op(level, offset, m_nodes, want_hist,
                             feat_b, thr_b):
@@ -1312,6 +1313,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 )
                 node_weight[:, sl] = np.asarray(w_par)
                 node_gain[:, sl] = np.where(ok, g_b, 0.0)
+                node_imp[:, sl] = np.asarray(_impurity(total, impurity)[0])
 
             offset = 2**max_depth - 1
             m_nodes = 2**max_depth
@@ -1323,7 +1325,9 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             sl = slice(offset, offset + m_nodes)
             is_leaf[:, sl] = True
             leaf_value[:, sl, :] = np.asarray(_leaf_prediction(tot, impurity))
-            node_weight[:, sl] = np.asarray(_impurity(tot, impurity)[1])
+            imp_bottom, w_bottom = _impurity(tot, impurity)
+            node_weight[:, sl] = np.asarray(w_bottom)
+            node_imp[:, sl] = np.asarray(imp_bottom)
         finally:
             rdd.unpersist()
 
@@ -1336,6 +1340,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             jnp.asarray(leaf_value),
             jnp.asarray(node_weight),
             jnp.asarray(node_gain),
+            jnp.asarray(node_imp),
         )
         return forest, d, n_classes
 

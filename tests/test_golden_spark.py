@@ -19,9 +19,18 @@ import pytest
 pa = pytest.importorskip("pyarrow")
 import pyarrow.parquet as pq  # noqa: E402
 
+from spark_rapids_ml_tpu.classification import (  # noqa: E402
+    LogisticRegressionModel,
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+)
 from spark_rapids_ml_tpu.clustering import KMeansModel  # noqa: E402
 from spark_rapids_ml_tpu.feature import PCA, PCAModel  # noqa: E402
-from spark_rapids_ml_tpu.regression import LinearRegressionModel  # noqa: E402
+from spark_rapids_ml_tpu.regression import (  # noqa: E402
+    LinearRegressionModel,
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
 
 # Spark's MatrixUDT / VectorUDT arrow-side schemas, nullable like Spark's.
 _SPARK_MATRIX = pa.struct(
@@ -234,6 +243,239 @@ class TestLoadSparkWrittenModels:
         np.testing.assert_allclose(model.coefficients, [0.0, 2.0, 0.0, -1.0, 0.0])
 
 
+def _node(nid, pred, imp, stats, raw, gain=-1.0, left=-1, right=-1,
+          feat=-1, thr=None):
+    """Spark NodeData dict (leaf by default; pass children for a split)."""
+    return {
+        "id": nid,
+        "prediction": float(pred),
+        "impurity": float(imp),
+        "impurityStats": [float(s) for s in stats],
+        "rawCount": int(raw),
+        "gain": float(gain),
+        "leftChild": left,
+        "rightChild": right,
+        "split": {
+            "featureIndex": feat,
+            "leftCategoriesOrThreshold": [] if thr is None else [float(thr)],
+            "numCategories": -1,
+        },
+    }
+
+
+def _nodedata_schema():
+    split_t = pa.struct(
+        [
+            ("featureIndex", pa.int32()),
+            ("leftCategoriesOrThreshold", pa.list_(pa.float64())),
+            ("numCategories", pa.int32()),
+        ]
+    )
+    node_t = pa.struct(
+        [
+            ("id", pa.int32()),
+            ("prediction", pa.float64()),
+            ("impurity", pa.float64()),
+            ("impurityStats", pa.list_(pa.float64())),
+            ("rawCount", pa.int64()),
+            ("gain", pa.float64()),
+            ("leftChild", pa.int32()),
+            ("rightChild", pa.int32()),
+            ("split", split_t),
+        ]
+    )
+    return pa.schema([("treeID", pa.int32()), ("nodeData", node_t)])
+
+
+class TestLoadSparkWrittenForests:
+    """Spark's EnsembleModelReadWrite on-disk shape (treeID + NodeData
+    struct rows, preorder ids, explicit child pointers, leaf sentinels)
+    must load into the heap-array Forest and predict correctly
+    (VERDICT r4 #6 — the RF families joined the golden suite in r5)."""
+
+    def test_rf_classifier_golden(self, tmp_path, rng):
+        path = str(tmp_path / "spark_rfc")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.classification.RandomForestClassificationModel",
+            "RandomForestClassificationModel_g",
+            {"numTrees": 2, "featuresCol": "features"},
+        )
+        # Tree 0: split on feature 0 at 0.5 -> class-count leaves;
+        # tree 1: a single root leaf (50/50).
+        rows = [
+            (0, _node(0, 1.0, 0.495, [9, 11], 20, gain=0.3, left=1, right=2,
+                      feat=0, thr=0.5)),
+            (0, _node(1, 0.0, 0.32, [8, 2], 10)),
+            (0, _node(2, 1.0, 0.18, [1, 9], 10)),
+            (1, _node(0, 0.0, 0.5, [5, 5], 10)),
+        ]
+        schema = _nodedata_schema()
+        _write_spark_parquet(
+            path,
+            schema,
+            [{"treeID": t, "nodeData": nd} for t, nd in rows],
+            "{}",
+        )
+        model = RandomForestClassificationModel.load(path)
+        probs = model.predictProbability(
+            np.array([[0.0, 0.0], [1.0, 0.0]], dtype=np.float64)
+        )
+        # Mean of tree leaf distributions: ((.8,.2)+(.5,.5))/2, ((.1,.9)+(.5,.5))/2
+        np.testing.assert_allclose(probs, [[0.65, 0.35], [0.3, 0.7]], atol=1e-6)
+        preds = np.asarray(
+            model.predict(np.array([[0.0, 0.0], [1.0, 0.0]], dtype=np.float64))
+        )
+        np.testing.assert_array_equal(preds, [0, 1])
+        assert model.totalNumNodes == 4
+
+    def test_rf_regressor_golden(self, tmp_path):
+        path = str(tmp_path / "spark_rfr")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.regression.RandomForestRegressionModel",
+            "RandomForestRegressionModel_g",
+            {"numTrees": 1},
+        )
+        # Variance stats [count, sum, sumSq]; prediction = mean.
+        rows = [
+            (0, _node(0, 0.8, 2.1, [10, 8, 30.0], 10, gain=1.5, left=1,
+                      right=2, feat=1, thr=0.0)),
+            (0, _node(1, -1.0, 0.1, [4, -4.0, 4.4], 4)),
+            (0, _node(2, 2.0, 0.1, [6, 12.0, 24.6], 6)),
+        ]
+        _write_spark_parquet(
+            path,
+            _nodedata_schema(),
+            [{"treeID": t, "nodeData": nd} for t, nd in rows],
+            "{}",
+        )
+        model = RandomForestRegressionModel.load(path)
+        pred = model.predict(np.array([[0.0, -1.0], [0.0, 1.0]], dtype=np.float64))
+        np.testing.assert_allclose(pred, [-1.0, 2.0], atol=1e-6)
+
+    def test_legacy_flattened_forest_layout_loads(self, tmp_path):
+        """Pre-r5 model directories (the flattened treeID/nodeID scalar
+        columns) must still load (code-review r5: the Spark-schema
+        rewrite must not strand existing checkpoints)."""
+        from spark_rapids_ml_tpu.core.persistence import save_metadata, save_rows
+
+        path = str(tmp_path / "legacy_rf")
+        shell = RandomForestClassificationModel()
+        save_metadata(
+            shell,
+            path,
+            class_name=(
+                "org.apache.spark.ml.classification."
+                "RandomForestClassificationModel"
+            ),
+            extra_metadata={"numFeatures": 1, "numClasses": 2},
+        )
+        # One depth-1 tree: root splits feature 0 at 0.5.
+        save_rows(
+            path,
+            {
+                "treeID": ("scalar", [0, 0, 0]),
+                "nodeID": ("scalar", [0, 1, 2]),
+                "feature": ("scalar", [0, -1, -1]),
+                "threshold": ("scalar", [0.5, 0.0, 0.0]),
+                "isLeaf": ("scalar", [False, True, True]),
+                "leafValue": ("vector", [[0.5, 0.5], [0.8, 0.2], [0.1, 0.9]]),
+                "nodeWeight": ("scalar", [20.0, 10.0, 10.0]),
+                "nodeGain": ("scalar", [0.3, 0.0, 0.0]),
+            },
+        )
+        model = RandomForestClassificationModel.load(path)
+        probs = model.predictProbability(np.array([[0.0], [1.0]]))
+        np.testing.assert_allclose(probs, [[0.8, 0.2], [0.1, 0.9]], atol=1e-6)
+
+    def test_logistic_regression_golden(self, tmp_path, rng):
+        coef = rng.normal(size=4)
+        path = str(tmp_path / "spark_logreg")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.classification.LogisticRegressionModel",
+            "LogisticRegressionModel_g",
+            {"featuresCol": "features", "threshold": 0.5},
+        )
+        schema = pa.schema(
+            [
+                ("numClasses", pa.int32()),
+                ("numFeatures", pa.int32()),
+                ("interceptVector", _SPARK_VECTOR),
+                ("coefficientMatrix", _SPARK_MATRIX),
+                ("isMultinomial", pa.bool_()),
+            ]
+        )
+        _write_spark_parquet(
+            path,
+            schema,
+            [
+                {
+                    "numClasses": 2,
+                    "numFeatures": 4,
+                    "interceptVector": _vector_struct([0.25]),
+                    "coefficientMatrix": _matrix_struct(coef[None, :]),
+                    "isMultinomial": False,
+                }
+            ],
+            "{}",
+        )
+        model = LogisticRegressionModel.load(path)
+        np.testing.assert_allclose(model.coefficients, coef)
+        assert model.intercept == pytest.approx(0.25)
+        x = rng.normal(size=(5, 4))
+        expect = 1.0 / (1.0 + np.exp(-(x @ coef + 0.25)))
+        np.testing.assert_allclose(
+            model.predictProbability(x)[:, 1], expect, atol=1e-6
+        )
+
+    def test_logistic_regression_multinomial_golden(self, tmp_path, rng):
+        cm = rng.normal(size=(3, 4))  # (numClasses, d), Spark orientation
+        iv = rng.normal(size=3)
+        path = str(tmp_path / "spark_logreg_mn")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.classification.LogisticRegressionModel",
+            "LogisticRegressionModel_mn",
+            {},
+        )
+        schema = pa.schema(
+            [
+                ("numClasses", pa.int32()),
+                ("numFeatures", pa.int32()),
+                ("interceptVector", _SPARK_VECTOR),
+                ("coefficientMatrix", _SPARK_MATRIX),
+                ("isMultinomial", pa.bool_()),
+            ]
+        )
+        _write_spark_parquet(
+            path,
+            schema,
+            [
+                {
+                    "numClasses": 3,
+                    "numFeatures": 4,
+                    "interceptVector": _vector_struct(iv),
+                    "coefficientMatrix": _matrix_struct(cm),
+                    "isMultinomial": True,
+                }
+            ],
+            "{}",
+        )
+        model = LogisticRegressionModel.load(path)
+        np.testing.assert_allclose(model.coefficientMatrix, cm)
+        np.testing.assert_allclose(model.interceptVector, iv)
+        x = rng.normal(size=(6, 4))
+        z = x @ cm.T + iv
+        expect = np.exp(z) / np.exp(z).sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(model.predictProbability(x), expect, atol=1e-6)
+
+
 class TestWrittenFormatIsSparkShaped:
     """The reverse direction: what this framework writes must be exactly
     the structural schema Spark's readers parse."""
@@ -267,6 +509,96 @@ class TestWrittenFormatIsSparkShaped:
         assert table.schema.field("pc").type == _SPARK_MATRIX
         assert table.schema.field("explainedVariance").type == _SPARK_VECTOR
         assert os.path.exists(os.path.join(path, "data", "_SUCCESS"))
+
+    def test_rf_written_schema_and_roundtrip(self, tmp_path, rng):
+        """Forests persist in Spark's EnsembleModelReadWrite shape:
+        (treeID, nodeData struct) rows + treesMetadata, and round-trip to
+        identical predictions."""
+        x = rng.normal(size=(150, 5))
+        y = ((x[:, 0] + x[:, 2]) > 0).astype(float)
+        model = (
+            RandomForestClassifier().setNumTrees(4).setMaxDepth(3).setSeed(1)
+            .fit((x, y))
+        )
+        path = str(tmp_path / "ours_rfc")
+        model.write.overwrite().save(path)
+
+        files = [
+            f for f in os.listdir(os.path.join(path, "data"))
+            if f.endswith(".parquet")
+        ]
+        table = pq.read_table(os.path.join(path, "data", files[0]))
+        assert table.schema.equals(_nodedata_schema()), table.schema
+        # Leaf sentinels and preorder roots, as Spark writes them.
+        first = table.to_pylist()[0]
+        assert first["nodeData"]["id"] == 0
+        leaves = [
+            r["nodeData"] for r in table.to_pylist()
+            if r["nodeData"]["leftChild"] < 0
+        ]
+        assert leaves and all(nd["gain"] == -1.0 for nd in leaves)
+        assert all(nd["split"]["featureIndex"] == -1 for nd in leaves)
+        # treesMetadata: one row per tree with uniform weights.
+        tm_files = [
+            f for f in os.listdir(os.path.join(path, "treesMetadata"))
+            if f.endswith(".parquet")
+        ]
+        tm = pq.read_table(os.path.join(path, "treesMetadata", tm_files[0]))
+        assert tm.column_names == ["treeID", "metadata", "weights"]
+        assert tm.num_rows == 4
+
+        loaded = RandomForestClassificationModel.load(path)
+        np.testing.assert_allclose(
+            loaded.predictProbability(x), model.predictProbability(x), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            loaded.featureImportances, model.featureImportances, atol=1e-6
+        )
+
+    def test_rf_regressor_roundtrip_exact(self, tmp_path, rng):
+        """Regression round trip: the variance-triplet encoding must be
+        lossless (sumSq reconstructed from the stored node impurity)."""
+        x = rng.normal(size=(120, 4))
+        y = 2.0 * x[:, 0] - x[:, 3] + 0.1 * rng.normal(size=120) + 5.0
+        model = (
+            RandomForestRegressor().setNumTrees(3).setMaxDepth(3).setSeed(2)
+            .fit((x, y))
+        )
+        path = str(tmp_path / "ours_rfr")
+        model.write.overwrite().save(path)
+        loaded = RandomForestRegressionModel.load(path)
+        np.testing.assert_allclose(loaded.predict(x), model.predict(x), atol=1e-5)
+        f0, f1 = model._forest, loaded._forest
+        np.testing.assert_allclose(
+            np.asarray(f1.node_impurity), np.asarray(f0.node_impurity), atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(f1.node_weight), np.asarray(f0.node_weight), atol=1e-5
+        )
+
+    def test_logreg_written_schema(self, tmp_path, rng):
+        x = rng.normal(size=(100, 3))
+        y = (x[:, 0] > 0).astype(float)
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        model = LogisticRegression().setMaxIter(30).fit((x, y))
+        path = str(tmp_path / "ours_lr")
+        model.write.overwrite().save(path)
+        files = [
+            f for f in os.listdir(os.path.join(path, "data"))
+            if f.endswith(".parquet")
+        ]
+        table = pq.read_table(os.path.join(path, "data", files[0]))
+        assert table.schema.field("coefficientMatrix").type == _SPARK_MATRIX
+        assert table.schema.field("interceptVector").type == _SPARK_VECTOR
+        row = table.to_pylist()[0]
+        assert row["numClasses"] == 2
+        assert row["numFeatures"] == 3
+        assert row["isMultinomial"] is False
+        loaded = LogisticRegressionModel.load(path)
+        np.testing.assert_allclose(
+            loaded.predictProbability(x), model.predictProbability(x), atol=1e-8
+        )
 
     def test_roundtrip_through_spark_shape(self, tmp_path, rng):
         """Write with our writer, re-read the raw structs as a Spark reader

@@ -269,3 +269,69 @@ class TestParams:
         assert rf.getFeatureSubsetStrategy() == "auto"
         assert rf.getSubsamplingRate() == 1.0
         assert RandomForestRegressor().getImpurity() == "variance"
+
+
+class TestNumClassesHint:
+    """setNumClasses: the Spark label-metadata analogue (fit dispatches
+    without a label scan; r5)."""
+
+    def test_hinted_fit_matches_inferred(self, rng):
+        from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+        x = rng.normal(size=(300, 5))
+        y = ((x[:, 0] + x[:, 1]) > 0).astype(float)
+        inferred = (
+            RandomForestClassifier().setNumTrees(6).setMaxDepth(4).setSeed(3)
+            .fit((x, y))
+        )
+        hinted = (
+            RandomForestClassifier().setNumTrees(6).setMaxDepth(4).setSeed(3)
+            .setNumClasses(2).fit((x, y))
+        )
+        assert hinted.numClasses == 2
+        np.testing.assert_allclose(
+            hinted.predictProbability(x), inferred.predictProbability(x),
+            atol=1e-6,
+        )
+
+    def test_hinted_device_fit_no_readback(self, rng):
+        """With the hint (and no weightCol), a device-resident fit must
+        dispatch without ANY device->host transfer before the forest
+        arrays are touched."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+        x = jnp.asarray(rng.normal(size=(200, 4)), dtype=jnp.float32)
+        y = (x[:, 0] > 0).astype(jnp.float32)
+        est = (
+            RandomForestClassifier().setNumTrees(4).setMaxDepth(3).setSeed(0)
+            .setNumClasses(2)
+        )
+        with jax.transfer_guard_device_to_host("disallow"):
+            model = est.fit((x, y))
+        # Root weight is the tree's bootstrap-draw total (~n, Poisson).
+        root_w = float(np.asarray(model._forest.node_weight[0, 0]))
+        assert abs(root_w - 200.0) < 5 * np.sqrt(200.0)
+        assert model.numClasses == 2
+
+    def test_hint_survives_copy_and_validates(self, rng):
+        from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+        est = RandomForestClassifier().setNumClasses(3)
+        assert est.copy().getNumClasses() == 3
+        with pytest.raises(ValueError, match="numClasses"):
+            RandomForestClassifier().setNumClasses(1)
+
+    def test_bootstrap_weights_clamped_integral(self):
+        """The 256 clamp that makes unweighted exactness static: weights
+        stay integral and within the bf16-exact product bound."""
+        import jax
+
+        from spark_rapids_ml_tpu.ops.trees import sample_weights
+
+        w = np.asarray(sample_weights(jax.random.key(1), 4, 50_000, 1.0, True))
+        assert np.array_equal(w, np.rint(w))
+        assert w.max() <= 256.0
+        assert w.mean() == pytest.approx(1.0, abs=0.05)
