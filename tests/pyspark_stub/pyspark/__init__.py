@@ -109,9 +109,53 @@ class SparkContext:
 
 _SC = SparkContext()
 
+
+class BarrierTaskInfo:
+    """pyspark.taskcontext.BarrierTaskInfo: the per-task descriptor
+    ``BarrierTaskContext.getTaskInfos()`` returns (``address`` attr)."""
+
+    def __init__(self, address: str):
+        self.address = address
+
+
+class BarrierTaskContext(TaskContext):
+    """pyspark.BarrierTaskContext: the task context inside a barrier
+    stage. ``get()`` is only valid in a task launched by
+    ``RDDBarrier.mapPartitions`` (returns None elsewhere, like the plain
+    TaskContext stub); ``barrier()`` is the global sync point (a no-op in
+    the stub's sequential gang execution — ordering IS the sync);
+    ``getTaskInfos()`` lists all gang members, the handle a launcher uses
+    to derive jax.distributed coordinates."""
+
+    _current: Optional["BarrierTaskContext"] = None
+
+    def __init__(self, partition_id: int, num_tasks: int, attempt: int):
+        self._pid = partition_id
+        self._num = num_tasks
+        self._attempt = attempt
+
+    @classmethod
+    def get(cls) -> Optional["BarrierTaskContext"]:
+        return cls._current
+
+    def barrier(self) -> None:
+        pass
+
+    def partitionId(self) -> int:
+        return self._pid
+
+    def attemptNumber(self) -> int:
+        return self._attempt
+
+    def getTaskInfos(self):
+        return [BarrierTaskInfo("localhost:0") for _ in range(self._num)]
+
+
 __all__ = [
     "keyword_only",
     "TaskContext",
+    "BarrierTaskContext",
+    "BarrierTaskInfo",
     "Broadcast",
     "SparkContext",
     "BROADCAST_VALUE_PICKLES",

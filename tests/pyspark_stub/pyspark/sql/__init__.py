@@ -194,6 +194,60 @@ class RDD:
     def getNumPartitions(self) -> int:
         return len(self._parts)
 
+    def barrier(self) -> "RDDBarrier":
+        """pyspark 3.5 RDD.barrier(): mark this stage for barrier
+        execution — all tasks launch together and ANY task failure
+        relaunches the WHOLE gang (stage-level retry, not per-task)."""
+        return RDDBarrier(self)
+
+
+# Spark's spark.stage.maxConsecutiveAttempts default: a barrier stage is
+# retried as a unit at most this many times before the job fails.
+BARRIER_MAX_ATTEMPTS = 4
+
+# Gang-relaunch instrumentation for the failure-recovery contract tests:
+# every (attempt, partition) task launch inside a barrier stage is
+# recorded here. Reset with BARRIER_TASK_LAUNCHES.clear().
+BARRIER_TASK_LAUNCHES: List[tuple] = []
+
+
+class RDDBarrier:
+    """pyspark.rdd.RDDBarrier: ``mapPartitions`` with barrier-stage
+    semantics. The stub runs the gang sequentially, but retry semantics
+    are Spark's barrier-scheduler ones: if ANY partition's task raises,
+    results of the whole attempt are discarded and EVERY task relaunches
+    from scratch (the relaunch-the-gang semantic a jax.distributed cohort
+    needs — an individually retried task would rejoin a dead gang); after
+    BARRIER_MAX_ATTEMPTS failed attempts the error propagates to the
+    driver, like the reference's JNI-throw -> task-fail -> Spark-retry
+    story (SURVEY §5; rapidsml_jni.cu:101-153 pattern) escalating to job
+    failure."""
+
+    def __init__(self, rdd: RDD):
+        self._rdd = rdd
+
+    def mapPartitions(self, f, preservesPartitioning: bool = False) -> RDD:
+        from pyspark import BarrierTaskContext
+
+        f = _pickle_roundtrip(f)
+        n = len(self._rdd._parts)
+        last_err = None
+        for attempt in range(BARRIER_MAX_ATTEMPTS):
+            out = []
+            try:
+                for i, p in enumerate(self._rdd._parts):
+                    BARRIER_TASK_LAUNCHES.append((attempt, i))
+                    BarrierTaskContext._current = BarrierTaskContext(i, n, attempt)
+                    try:
+                        out.append(list(f(iter(p))))
+                    finally:
+                        BarrierTaskContext._current = None
+            except Exception as e:  # gang relaunch: discard, restart all
+                last_err = e
+                continue
+            return RDD(out)
+        raise last_err
+
 
 def _arrow_series(values: list):
     """pyspark 3.5 pandas_udf input typing (the SQL Arrow serializer,
