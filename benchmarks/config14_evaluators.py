@@ -17,7 +17,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import bytes_roofline, emit, time_amortized
+from benchmarks.common import bytes_roofline, emit, time_amortized, time_median
 
 N = 10_000_000
 
@@ -31,7 +31,6 @@ def main() -> None:
         MulticlassClassificationEvaluator,
         RegressionEvaluator,
     )
-    from spark_rapids_ml_tpu.ops.metrics import binary_auc_device
 
     ky, kp = jax.random.split(jax.random.key(14))
     scores = jax.random.uniform(ky, (N,), dtype=jnp.float32)
@@ -40,21 +39,24 @@ def main() -> None:
     ).astype(jnp.float32)
     float(jnp.sum(scores[0:1]))
 
+    # The timed quantity IS the public evaluate() call (ADVICE r4: rows
+    # must time what through_estimator_api claims); evaluate returns a
+    # Python float, so each run includes exactly one scalar-readback sync
+    # — the honest per-call cost of the estimator API. Because that sync
+    # is INSIDE every call, batching cannot amortize it, so the roofline
+    # fields (device-bytes utilization) come from a separate slope-timed
+    # run of the underlying device op, labeled as such.
+    from spark_rapids_ml_tpu.ops.metrics import binary_auc_device
+
     auc_ev = BinaryClassificationEvaluator()
-    t_auc = time_amortized(
-        lambda: binary_auc_device(labels, scores),
-        lambda out: float(out),
-        inner=3,
-    )
+    t_auc = time_median(lambda: auc_ev.evaluate((labels, scores)))
     auc = auc_ev.evaluate((labels, scores))
+    t_auc_device = time_amortized(
+        lambda: binary_auc_device(labels, scores), lambda out: float(out)
+    )
 
     reg_ev = RegressionEvaluator().setMetricName("rmse")
-    t_reg = time_amortized(
-        lambda: jnp.sum((scores - labels) ** 2),  # proxy sync value
-        lambda out: float(out),
-        inner=3,
-    )
-    _ = reg_ev.evaluate((labels, scores))
+    t_reg = time_median(lambda: reg_ev.evaluate((labels, scores)))
 
     mc_ev = MulticlassClassificationEvaluator().setMetricName("accuracy")
     preds = (scores > 0.5).astype(jnp.float32)
@@ -71,8 +73,13 @@ def main() -> None:
         through_estimator_api=True,
         auc=round(float(auc), 4),
         multiclass_accuracy=round(float(acc), 4),
-        regression_reduction_wall_s=round(t_reg, 5),
-        **bytes_roofline(sort_bytes, t_auc),
+        regression_rmse_evaluate_wall_s=round(t_reg, 5),
+        # Roofline against the slope-timed DEVICE wall (ops-layer
+        # binary_auc_device): evaluate()'s internal sync is a fixed
+        # tunnel round trip per call that batching cannot amortize, so
+        # the API wall above would understate device-bytes utilization.
+        device_wall_s=round(t_auc_device, 4),
+        **bytes_roofline(sort_bytes, t_auc_device),
     )
 
 

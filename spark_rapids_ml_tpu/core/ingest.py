@@ -190,9 +190,12 @@ def prepare_labels(y: Any, n_pad: int, n_true: Optional[int] = None, mesh=None, 
 
 def validate_int_labels(y: Any):
     """Shared classifier label check: non-negative integers. Works for host
-    and device labels; on device this costs two scalar readbacks (the class
-    count defines array shapes, so a sync is inherent — what must NOT
-    happen is an O(n) pull of the label vector).
+    and device labels; on device this costs ONE scalar-vector readback (the
+    class count defines array shapes, so a sync is inherent — what must NOT
+    happen is an O(n) pull of the label vector, and under the relay tunnel
+    each separate readback is a full round trip, so the integrality flag,
+    min, and max travel as one stacked device array — the
+    models.random_forest._weight_exact_and_max pattern, ADVICE r4).
 
     Returns ``(y_int, n_classes)`` with ``y_int`` in the input's residence
     (int32 on device, int64 on host).
@@ -201,16 +204,25 @@ def validate_int_labels(y: Any):
         import jax.numpy as jnp
 
         y = y.ravel()
+        y_int = y.astype(jnp.int32)
         if jnp.issubdtype(y.dtype, jnp.floating):
-            y_int = y.astype(jnp.int32)
-            if not bool(jnp.all(y == y_int.astype(y.dtype))):
-                raise ValueError("labels must be integers in [0, numClasses)")
+            integral = jnp.all(y == y_int.astype(y.dtype))
         else:
-            y_int = y.astype(jnp.int32)
-        lo, hi = jnp.min(y_int), jnp.max(y_int)
-        if int(lo) < 0:
+            integral = jnp.asarray(True)
+        stats = np.asarray(
+            jnp.stack(
+                [
+                    integral.astype(jnp.int32),
+                    jnp.min(y_int),
+                    jnp.max(y_int),
+                ]
+            )
+        )
+        if not bool(stats[0]):
+            raise ValueError("labels must be integers in [0, numClasses)")
+        if int(stats[1]) < 0:
             raise ValueError("labels must be >= 0")
-        return y_int, int(hi) + 1
+        return y_int, int(stats[2]) + 1
     y_host = np.asarray(y).ravel()
     y_int = y_host.astype(np.int64)
     if not np.array_equal(y_int, y_host):

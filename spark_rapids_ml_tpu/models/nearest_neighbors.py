@@ -182,6 +182,19 @@ class NearestNeighborsModel(_NearestNeighborsParams, Model, LazyHostState):
     _lazy_host_fields = {"_items_raw": ("_items_np", None)}
     _pickle_clear = ("_sharded",)
 
+    def __getstate__(self):
+        # Same contract as _save_impl (ADVICE r4): a streamed-index model
+        # must not pickle — cloudpickling (Spark broadcast, UDF closures)
+        # would either ship the whole item set the streamed mode exists to
+        # avoid, or fail opaquely on an unpicklable reader.
+        if self._items_stream is not None:
+            raise ValueError(
+                "a streamed-index model does not pickle (its items live "
+                "in the external source); broadcast/persist the source "
+                "instead"
+            )
+        return super().__getstate__()
+
     @property
     def items(self) -> Optional[np.ndarray]:
         return self._lazy_host_view("_items_raw")

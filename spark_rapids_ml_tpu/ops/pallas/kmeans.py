@@ -121,14 +121,21 @@ def assign_stats_fused(
     block_n: int = 4096,
     precision: str = "highest",
     interpret: bool = False,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fused Lloyd statistics for TRANSPOSED input.
 
     ``xt``: (d_pad, n_pad) with d padded to 8 and n padded to ``block_n``
     multiples, both zero-filled (use :func:`pad_transposed`). ``centers``:
-    (k, d_pad). Returns raw ``(sums (k, d_pad), counts (k,), cost)``
-    INCLUDING the padding rows' contribution — callers subtract it in
-    closed form (see :func:`lloyd_fused`).
+    (k, d_pad). Returns raw ``(sums (k, d_pad), counts (k,), cost,
+    c2 (k,))`` INCLUDING the padding rows' contribution — callers subtract
+    it in closed form (see :func:`lloyd_fused`). ``c2`` is the EXACT
+    squared-norm row the kernel scored against (computed from the
+    transposed ``ct`` buffer): the padding correction must take its argmin
+    over THIS buffer, not a recomputation from ``centers`` — a different
+    reduction order/layout can flip the argmin on a near-tie (e.g. cosine
+    mode where every unit-norm center has c2 ~ 1), subtracting the padding
+    count from a different cluster than the kernel assigned it to
+    (ADVICE r4).
     """
     d_pad, n_pad = xt.shape
     k = centers.shape[0]
@@ -166,7 +173,7 @@ def assign_stats_fused(
         ),
         interpret=interpret,
     )(xt, ct, c2)
-    return sums[:k], counts[0, :k], cost[0, 0]
+    return sums[:k], counts[0, :k], cost[0, 0], c2[0, :k]
 
 
 def fused_feasible(d: int, k: int) -> bool:
@@ -243,9 +250,11 @@ def lloyd_fused(
         ((0, 0), (0, d_pad - init_centers.shape[1])),
     )
 
-    def correct(stats, centers):
-        sums, counts, cost = stats
-        c2 = jnp.sum(centers * centers, axis=1)  # (k,)
+    def correct(stats):
+        # c2 comes back from the kernel call — the same buffer the scores
+        # were computed against, so this argmin agrees with the kernel's
+        # padding-row assignment even on exact ties (ADVICE r4).
+        sums, counts, cost, c2 = stats
         pad_label = jnp.argmin(c2)
         counts = counts.at[pad_label].add(-jnp.float32(n_pad_rows))
         cost = cost - n_pad_rows * c2[pad_label]
@@ -256,7 +265,7 @@ def lloyd_fused(
             xt, centers, block_n=block_n, precision=precision,
             interpret=interpret,
         )
-        sums, counts, cost = correct(stats, centers)
+        sums, counts, cost = correct(stats)
         new_centers = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
         )
@@ -287,7 +296,6 @@ def lloyd_fused(
         assign_stats_fused(
             xt, centers, block_n=block_n, precision=precision,
             interpret=interpret,
-        ),
-        centers,
+        )
     )
     return centers, cost, n_iter

@@ -67,7 +67,7 @@ class TestFusedOps:
         xj = jnp.asarray(x)
         init = random_init(xj, jnp.ones(x.shape[0], jnp.float32), jax.random.key(1), k)
         xt, n_true = pad_transposed(xj, block_n=256)
-        s, c, cost = assign_stats_fused(xt, init, block_n=256, interpret=True)
+        s, c, cost, _ = assign_stats_fused(xt, init, block_n=256, interpret=True)
         pad_rows = xt.shape[1] - n_true
         assert float(jnp.sum(c)) == pytest.approx(n_true + pad_rows)
 

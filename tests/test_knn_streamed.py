@@ -129,3 +129,22 @@ class TestStreamedEstimators:
         model = NearestNeighbors().setK(3).fit(factory)
         with pytest.raises(ValueError, match="persist"):
             model.write.overwrite().save(str(tmp_path / "m"))
+
+    def test_streamed_model_does_not_pickle(self, corpus):
+        # ADVICE r4: cloudpickling a streamed model (Spark broadcast, UDF
+        # closure) must fail with the same clear contract as _save_impl,
+        # not ship the whole item set through the iterator factory.
+        import pickle
+
+        items, _ = corpus
+
+        def factory():
+            return iter(_blocks_of(items, 500))
+
+        for est in (
+            NearestNeighbors().setK(3),
+            ApproximateNearestNeighbors().setK(3).setAlgorithm("brute"),
+        ):
+            model = est.fit(factory)
+            with pytest.raises(ValueError, match="pickle"):
+                pickle.dumps(model)
