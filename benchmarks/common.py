@@ -32,26 +32,44 @@ def time_median(fn: Callable[[], None], repeats: int = 3) -> float:
 
 def time_amortized(dispatch: Callable[[], object], sync: Callable[[object], None],
                    inner: int = 8, repeats: int = 3) -> float:
-    """Median per-execution wall-clock with the device-sync cost amortized.
+    """Per-execution wall-clock with the FIXED sync cost removed by a
+    two-point slope.
 
-    The TPU here sits behind a relay tunnel whose scalar-readback round trip
-    is tens of milliseconds — comparable to the small configs' entire
-    compute. ``dispatch`` enqueues one (async) execution and returns its
-    output; ``inner`` executions are queued back-to-back and ``sync`` blocks
-    on the LAST one (the device stream is in-order), so the round trip is
-    paid once per ``inner`` runs instead of once per run.
+    The TPU here sits behind a relay tunnel whose sync round trip measured
+    ~120 ms in r5 — an order of magnitude above several configs' entire
+    compute, and AMORTIZING alone still leaves fixed/inner ms baked into
+    every per-exec figure (r4's config 2 reported 15.7 ms for a fit whose
+    device wall is ~3.9 ms). The batch wall is affine in the batch size,
+    ``T(i) = fixed + i * t`` (the device stream is in-order and
+    ``dispatch`` enqueues asynchronously; ``sync`` blocks on the LAST
+    output), so the slope between a small and a large batch recovers the
+    true steady-state per-execution time ``t`` with the fixed term
+    cancelled exactly. Median of ``repeats`` rounds per point; falls back
+    to the plain large-batch amortized figure if noise produces a
+    non-positive slope.
     """
     sync(dispatch())  # warmup: compile
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(inner):
-            out = dispatch()
-        sync(out)
-        times.append((time.perf_counter() - t0) / inner)
-    times.sort()
-    return times[len(times) // 2]
+    inner_small = max(1, inner // 4)
+    inner_big = max(inner, inner_small + 2)
+
+    def batch_wall(i: int) -> float:
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(i):
+                out = dispatch()
+            sync(out)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2]
+
+    t_small = batch_wall(inner_small)
+    t_big = batch_wall(inner_big)
+    slope = (t_big - t_small) / (inner_big - inner_small)
+    if slope <= 0:  # relay stall noise — keep the conservative estimate
+        return t_big / inner_big
+    return slope
 
 
 def _timed(fn: Callable[[], None]) -> float:

@@ -41,7 +41,8 @@ def main() -> None:
     # Deterministic global dataset; every worker derives the same one and
     # takes a DIFFERENT (deliberately uneven) slice as its local data.
     rng = np.random.default_rng(0)
-    n, d = 1003, int(os.environ.get("TPUML_TEST_D", "12"))
+    n = int(os.environ.get("TPUML_TEST_ROWS", "1003"))
+    d = int(os.environ.get("TPUML_TEST_D", "12"))
     x = rng.normal(size=(n, d)) * np.linspace(1.0, 2.0, d) + 100.0
     if os.environ.get("TPUML_TEST_EMPTY_LAST") == "1" and n_proc > 1:
         # Deployment reality: one executor may hold no rows; the fit must
@@ -86,6 +87,9 @@ def main() -> None:
             sys.exit(3)
         print("SURVIVOR_COMPLETED_UNEXPECTEDLY")
         sys.exit(4)
+    import time
+
+    t0 = time.monotonic()
     if os.environ.get("TPUML_TEST_STREAMING") == "1":
         # Stream the local rows as a one-shot generator of small blocks —
         # per-process constant-memory scan + cross-process moment merge.
@@ -93,6 +97,9 @@ def main() -> None:
         model = PCA(mesh=mesh).setK(3).fit(blocks)
     else:
         model = PCA(mesh=mesh).setK(3).fit([local] if local.shape[0] else [])
+    # Fit wall (post-bringup, incl. compile + collectives): the
+    # weak-scaling record in BASELINE.md config 5 reads these lines.
+    print(f"FIT_WALL {time.monotonic() - t0:.3f}")
 
     from spark_rapids_ml_tpu.utils.testing import assert_components_close
 

@@ -226,6 +226,41 @@ class TestMultiProcess:
             assert clear_error, f"survivor {pid} died without a clear error:\n{err[-2000:]}"
         assert elapsed < 110, f"survivors took {elapsed:.0f}s — effectively a hang"
 
+    def test_8_process_north_star_8x1(self):
+        """VERDICT r4 #4: the EXACT north-star software topology — 8
+        processes, one (virtual) device each, streamed per-executor
+        blocks, psum moment merge on an (8, 1) mesh. The BASELINE config
+        5 ×8 projection's software preconditions (bring-up, wire format,
+        collective schedule at 8 members) all execute here; only the
+        chips are virtual."""
+        self._run(
+            8,
+            extra_env={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "TPUML_TEST_STREAMING": "1",
+                "TPUML_TEST_MESH_SHAPE": "8,1",
+            },
+        )
+
+    def test_8_device_north_star_4x2_streamed(self):
+        """The same 8 mesh members on a (4, 2) data x model mesh — rows
+        over 4 executor groups, features over 2 — STREAMED, with d=13
+        exercising the model-axis zero-pad + strip path. Runs as 4
+        processes x 2 devices: the placement layer requires the model
+        axis to divide each process's local device count (a process's
+        addressable shards must span whole mesh rows —
+        parallel/distributed.shard_rows_process_local), so a
+        model-sharded deployment pairs chips within an executor, it does
+        not split one chip's features across executors."""
+        self._run(
+            4,
+            extra_env={
+                "TPUML_TEST_STREAMING": "1",
+                "TPUML_TEST_MESH_SHAPE": "4,2",
+                "TPUML_TEST_D": "13",
+            },
+        )
+
     def test_streaming_without_x64(self):
         """The real-TPU configuration: fp32 compute, and the fp64 moment
         payload crosses the allgather as a double-float (hi, lo) pair —
