@@ -50,9 +50,15 @@ def time_amortized(dispatch: Callable[[], object], sync: Callable[[object], None
     """
     sync(dispatch())  # warmup: compile
     inner_small = max(1, inner // 4)
-    inner_big = max(inner, inner_small + 2)
+    inner_big = max(2 * inner, inner_small + 4)
 
     def batch_wall(i: int) -> float:
+        # MIN over repeats (standard minimum-time practice): the relay
+        # occasionally stalls for hundreds of ms, and a stall landing in
+        # the SMALL batch would deflate the slope below the true per-exec
+        # time — an impossible >100%-of-roofline reading (observed once
+        # at median-of-3). Stalls only ever ADD time, so the minimum is
+        # the clean estimate of fixed + i*t.
         times = []
         for _ in range(repeats):
             t0 = time.perf_counter()
@@ -61,8 +67,7 @@ def time_amortized(dispatch: Callable[[], object], sync: Callable[[object], None
                 out = dispatch()
             sync(out)
             times.append(time.perf_counter() - t0)
-        times.sort()
-        return times[len(times) // 2]
+        return min(times)
 
     t_small = batch_wall(inner_small)
     t_big = batch_wall(inner_big)
