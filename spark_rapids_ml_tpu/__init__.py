@@ -14,6 +14,8 @@ Layer map (mirrors SURVEY.md §1 of the reference):
   - ``parallel``              — device-mesh sharding + collectives (the
     reference delegates this to Spark RDD reduce/broadcast)
   - ``utils.tracing``         — profiling ranges (reference L7: NvtxRange)
+  - ``robustness``            — fault injection + retry/degradation policy
+    (the reference delegated its whole failure story to Spark task retry)
   - ``native``                — C++ host runtime (reference: native/ JNI lib)
 """
 

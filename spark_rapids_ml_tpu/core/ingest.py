@@ -38,6 +38,9 @@ from typing import Any, NamedTuple, Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.core.data import as_partitions, is_device_array
+from spark_rapids_ml_tpu.robustness.degrade import cpu_device, run_degradable
+from spark_rapids_ml_tpu.robustness.faults import fault_point
+from spark_rapids_ml_tpu.robustness.retry import default_policy
 
 
 def default_dtype():
@@ -100,7 +103,16 @@ def prepare_rows(
             pad_d = (-d) % mp
             if pad_n or pad_d:
                 x = jnp.pad(x, ((0, pad_n), (0, pad_d)))
-            x = jax.device_put(x, row_sharding(mesh))
+
+            def _reshard(arr=x):
+                # Resharding a live device array over the mesh: retryable
+                # (pure placement), but never degradable — a mesh fit
+                # quietly moving to one CPU device would change the
+                # collective topology under the caller.
+                fault_point("ingest.device_put")
+                return jax.device_put(arr, row_sharding(mesh))
+
+            x = default_policy().run(_reshard, name="ingest.device_put")
             mask = (jnp.arange(n + pad_n) < n).astype(m_dtype)
             mask = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
         else:
@@ -123,7 +135,21 @@ def prepare_rows(
     else:
         x_host = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
         device = jax.devices()[device_id] if device_id >= 0 else None
-        x = jax.device_put(jnp.asarray(x_host), device)
+
+        def _place():
+            fault_point("ingest.device_put")
+            return jax.device_put(jnp.asarray(x_host), device)
+
+        # Single-process placement is the degradable rung: if the
+        # accelerator is unavailable (or placement exhausts its retry
+        # budget) and TPUML_DEGRADE=cpu, the fit continues on the host
+        # CPU device with a structured warning instead of raising.
+        x = run_degradable(
+            lambda: default_policy().run(_place, name="ingest.device_put"),
+            lambda: jax.device_put(jnp.asarray(x_host), cpu_device()),
+            what="estimator input placement",
+            site="ingest.device_put",
+        )
         mask = jnp.ones(n, dtype=m_dtype)
     if weights is not None:
         mask = weights_as_mask(

@@ -31,6 +31,8 @@ try:
 except ImportError:  # pragma: no cover
     _HAS_ARROW = False
 
+from spark_rapids_ml_tpu.robustness.faults import fault_point
+from spark_rapids_ml_tpu.robustness.retry import default_policy
 from spark_rapids_ml_tpu.version import __version__
 
 
@@ -205,6 +207,11 @@ def save_data(path: str, columns: Dict[str, tuple]) -> None:
     """
     data_dir = os.path.join(path, "data")
     os.makedirs(data_dir, exist_ok=True)
+    # Injection site AFTER the directory exists but BEFORE any data file:
+    # a fault here leaves exactly the half-written layout (metadata
+    # present, data missing) that the atomic MLWriter.save must keep
+    # invisible to load().
+    fault_point("persistence.write")
     if _HAS_ARROW:
         fields, arrays = [], []
         for name, (kind, value) in columns.items():
@@ -237,6 +244,7 @@ def save_rows(path: str, columns: Dict[str, tuple]) -> None:
     (clusterIdx: int, clusterCenter: VectorUDT))."""
     data_dir = os.path.join(path, "data")
     os.makedirs(data_dir, exist_ok=True)
+    fault_point("persistence.write")
     if _HAS_ARROW:
         fields, arrays = [], []
         for name, (kind, values) in columns.items():
@@ -314,7 +322,19 @@ def load_data(path: str) -> Dict[str, Any]:
 
 
 class MLWriter:
-    """Spark-style ``model.write.overwrite().save(path)`` chain."""
+    """Spark-style ``model.write.overwrite().save(path)`` chain.
+
+    ``save`` is ATOMIC at the directory level: the model is written to a
+    hidden temp sibling (same filesystem, so the final move is a rename)
+    and ``os.replace``d into place only once COMPLETE. A writer killed
+    mid-save — or a ``persistence.write`` injected fault — leaves at most
+    a temp directory that ``load`` never looks at, never a half-written
+    model at ``path`` (the pre-r6 writer built ``path`` in place, so a
+    mid-save kill left metadata without data — and with ``overwrite()``
+    it had already deleted the previous good model). The write itself
+    runs under the shared RetryPolicy: transient filesystem errors
+    re-attempt against a fresh temp dir.
+    """
 
     def __init__(self, instance):
         self._instance = instance
@@ -325,13 +345,31 @@ class MLWriter:
         return self
 
     def save(self, path: str) -> None:
-        if os.path.exists(path):
-            if not self._overwrite:
-                raise FileExistsError(f"{path} exists; use .overwrite()")
-            import shutil
+        import shutil
+        import uuid
 
-            shutil.rmtree(path)
-        self._instance._save_impl(path)
+        if os.path.exists(path) and not self._overwrite:
+            raise FileExistsError(f"{path} exists; use .overwrite()")
+        parent = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(parent, exist_ok=True)
+        tmp = os.path.join(
+            parent,
+            f".{os.path.basename(path)}.tmp-save-{uuid.uuid4().hex[:12]}",
+        )
+
+        def _write_complete():
+            if os.path.exists(tmp):  # a failed earlier attempt
+                shutil.rmtree(tmp)
+            self._instance._save_impl(tmp)
+
+        try:
+            default_policy().run(_write_complete, name="persistence.write")
+            if os.path.exists(path):  # _overwrite, checked above
+                shutil.rmtree(path)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp, ignore_errors=True)
 
 
 class MLReadable:

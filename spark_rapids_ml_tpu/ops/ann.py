@@ -378,7 +378,7 @@ def _sharded_ann_fn(mesh, is_pq: bool, n_fields: int, k: int, n_probe: int,
     """Build (and cache) the jitted shard_map search for one configuration —
     jit's cache is keyed on the function object, so the closure must not be
     rebuilt per call (same discipline as ops.knn._sharded_knn_fn)."""
-    from jax import shard_map
+    from spark_rapids_ml_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS

@@ -287,7 +287,7 @@ def _sharded_dbscan_fn(mesh, n_tot: int, n_loc: int, block_q: int,
     the function object, so the closure must not be rebuilt per call (same
     discipline as ops.knn._sharded_knn_fn). eps/min_pts are traced
     arguments: a parameter sweep reuses one compiled program."""
-    from jax import shard_map
+    from spark_rapids_ml_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS

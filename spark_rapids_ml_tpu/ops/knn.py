@@ -301,7 +301,7 @@ def _sharded_knn_fn(mesh, k: int, n_shard: int, precision: str, approx: bool = F
     """Build (and cache) the jitted shard_map program for one
     (mesh, k, shard-size, precision) combination — jit's cache is keyed on
     the function object, so the closure must not be rebuilt per call."""
-    from jax import shard_map
+    from spark_rapids_ml_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     prec = _dot_precision(precision)

@@ -104,6 +104,20 @@ def fit_logistic(
     c = n_classes if (multinomial or n_classes > 2) else 1
     d = x.shape[1]
     dtype = x.dtype
+    # Older optax cannot trace its zoom linesearch with f32 params when
+    # x64 is on (weak-f64 literals leak into the f32 linesearch state —
+    # utils/compat.optax_lbfgs_f32_works probes it). Solve in f64 there
+    # and cast the fitted params back: numerics only improve, device
+    # residence is unchanged.
+    out_dtype = None
+    if dtype == jnp.float32 and jax.config.jax_enable_x64:
+        from spark_rapids_ml_tpu.utils.compat import optax_lbfgs_f32_works
+
+        if not optax_lbfgs_f32_works():
+            out_dtype = dtype
+            dtype = jnp.float64
+            x = x.astype(dtype)
+            mask = mask.astype(dtype)
     prec = _dot_precision(precision)
     n = jnp.sum(mask)
 
@@ -168,7 +182,9 @@ def fit_logistic(
     params0 = (w0, b0)
 
     solver = optax.lbfgs()
-    value_and_grad = optax.value_and_grad_from_state(loss_fn)
+    from spark_rapids_ml_tpu.utils.compat import value_and_grad_from_state
+
+    value_and_grad = value_and_grad_from_state(loss_fn)
     state0 = solver.init(params0)
 
     def cond(carry):
@@ -198,6 +214,10 @@ def fit_logistic(
     w_orig = w / scale[:, None]
     b_orig = b - jnp.matmul(offset, w_orig, precision=prec) if fit_intercept else b
     final_loss = loss_fn((w, b))
+    if out_dtype is not None:  # f64 fallback solve: hand back f32
+        w_orig = w_orig.astype(out_dtype)
+        b_orig = b_orig.astype(out_dtype)
+        final_loss = final_loss.astype(out_dtype)
     return LogisticFit(w_orig, b_orig, n_iter, final_loss)
 
 

@@ -79,7 +79,9 @@ def _assign_stats_kernel(xt_ref, ct_ref, c2_ref, sums_ref, counts_ref,
     def _():
         sums_ref[:] = jnp.zeros_like(sums_ref)
         counts_ref[:] = jnp.zeros_like(counts_ref)
-        cost_ref[0, 0] = 0.0
+        # Dtype pinned explicitly: under x64, older interpret-mode state
+        # discharge writes the weak 0.0 literal as f64 into the f32 ref.
+        cost_ref[0, 0] = jnp.float32(0.0)
 
     xt = xt_ref[:]  # (d_pad, bn)
     # scores = c2 - 2 x.c  (the x2 term is argmin-invariant per row; the

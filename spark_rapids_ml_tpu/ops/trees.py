@@ -524,7 +524,7 @@ def grow_forest_sharded(
     size with zero weight (padded rows contribute nothing to any histogram).
     The returned forest is replicated — identical on every device.
     """
-    from jax import shard_map
+    from spark_rapids_ml_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS

@@ -287,7 +287,7 @@ def _sharded_layout_fn(
     or min_dist must reuse one executable, not pin one per float value.
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from spark_rapids_ml_tpu.utils.compat import axis_size, shard_map
 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 
@@ -308,7 +308,7 @@ def _sharded_layout_fn(
             key = shard_key
         n_local = dst_b.shape[0]
         row0 = lax.axis_index(DATA_AXIS) * n_local
-        n_pad_total = n_local * lax.axis_size(DATA_AXIS)
+        n_pad_total = n_local * axis_size(DATA_AXIS)
         dim = y0.shape[1]
         w_sum_b = jnp.sum(w_b, axis=1)  # (n_local,)
 

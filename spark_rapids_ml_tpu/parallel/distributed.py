@@ -39,6 +39,9 @@ from spark_rapids_ml_tpu.parallel.mesh import (
     make_mesh,
     model_axis_size,
 )
+from spark_rapids_ml_tpu.robustness.faults import fault_point
+from spark_rapids_ml_tpu.robustness.retry import default_policy
+from spark_rapids_ml_tpu.utils.envknobs import env_int
 
 _initialized = False
 
@@ -68,26 +71,35 @@ def initialize(
     global _initialized
     if _initialized:
         return
+    # env_int (utils/envknobs.py) names the variable, the bad value, and
+    # the expected form — a launcher typo used to surface as an anonymous
+    # `invalid literal for int()` on every gang member at once.
     coordinator_address = coordinator_address or os.environ.get("TPUML_COORDINATOR")
-    if num_processes is None and "TPUML_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["TPUML_NUM_PROCESSES"])
-    if process_id is None and "TPUML_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["TPUML_PROCESS_ID"])
-    if (
-        heartbeat_timeout_seconds is None
-        and "TPUML_HEARTBEAT_TIMEOUT" in os.environ
-    ):
-        heartbeat_timeout_seconds = int(os.environ["TPUML_HEARTBEAT_TIMEOUT"])
-    kwargs = {}
-    if heartbeat_timeout_seconds is not None:
-        kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout_seconds
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-        **kwargs,
-    )
+    if num_processes is None:
+        num_processes = env_int("TPUML_NUM_PROCESSES", minimum=1)
+    if process_id is None:
+        process_id = env_int("TPUML_PROCESS_ID", minimum=0)
+    if heartbeat_timeout_seconds is None:
+        heartbeat_timeout_seconds = env_int("TPUML_HEARTBEAT_TIMEOUT", minimum=1)
+
+    from spark_rapids_ml_tpu.utils.compat import distributed_initialize
+
+    def _bring_up():
+        # The coordination-service connect is the canonically flaky step
+        # of a gang bring-up (members race the coordinator's bind); the
+        # shared RetryPolicy owns the attempts/backoff/classification that
+        # used to be delegated entirely to the launcher, and each attempt
+        # is a profiler range so slow bring-ups are visible in traces.
+        fault_point("distributed.initialize")
+        distributed_initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+            heartbeat_timeout_seconds=heartbeat_timeout_seconds,
+        )
+
+    default_policy().run(_bring_up, name="distributed.initialize")
     _initialized = True
 
 
@@ -314,8 +326,16 @@ def streaming_covariance_process_local(
         s = np.zeros(d)
 
     if merge == "psum":
-        return _psum_merge_moments(
-            shift, gram, s, n_local, counts, d, center, dtype
+        # One retry unit around the whole device merge: the rebase is
+        # pure host math and the replicated sum is deterministic, so a
+        # re-run after a transient collective failure is exact — and the
+        # TPUML_FAULTS spec is process-identical, so every gang member
+        # retries in lockstep.
+        return default_policy().run(
+            lambda: _psum_merge_moments(
+                shift, gram, s, n_local, counts, d, center, dtype
+            ),
+            name="collective.psum",
         )
 
     # One allgather of the packed per-process moments: [shift | s | gram].
@@ -370,6 +390,7 @@ def _psum_merge_moments(shift, gram, s, n_local, counts, d, center, dtype):
     network. The payload travels at the device dtype: on no-x64 platforms
     that matches the f32 grams' own information content (dd, which
     carries more, is excluded by the caller)."""
+    fault_point("collective.psum")
     import jax.numpy as jnp
 
     from jax.experimental import multihost_utils

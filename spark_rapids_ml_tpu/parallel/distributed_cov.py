@@ -23,7 +23,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from spark_rapids_ml_tpu.utils.compat import shard_map
 
 from spark_rapids_ml_tpu.ops.linalg import _dot_precision
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS

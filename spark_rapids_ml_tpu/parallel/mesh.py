@@ -153,23 +153,38 @@ def shard_rows_from_partitions(partitions, mesh: Mesh, dtype=None):
 
     x_sharding = row_sharding(mesh)
     m_sharding = NamedSharding(mesh, P(DATA_AXIS))
-    x_shards, m_shards = [], []
     mesh_devs = np.asarray(mesh.devices).reshape(dp, mp)
-    for di in range(dp):
-        block = rows_slice(di * rows_per, (di + 1) * rows_per)
-        mask_blk = np.zeros(rows_per, dtype=np_dtype)
-        n_valid = min(max(n - di * rows_per, 0), rows_per)
-        mask_blk[:n_valid] = 1.0
-        for mi in range(mp):
-            dev = mesh_devs[di, mi]
-            x_shards.append(
-                jax.device_put(block[:, mi * cols_per : (mi + 1) * cols_per], dev)
-            )
-            m_shards.append(jax.device_put(mask_blk, dev))
-    xs = jax.make_array_from_single_device_arrays(
-        (n_tot, d_tot), x_sharding, x_shards
-    )
-    ms = jax.make_array_from_single_device_arrays((n_tot,), m_sharding, m_shards)
+
+    def _place_shards():
+        # Pure host->device placement: safe to re-run wholesale, so the
+        # whole loop is one retry unit (robustness.retry) with one named
+        # injection site (robustness.faults).
+        from spark_rapids_ml_tpu.robustness.faults import fault_point
+
+        fault_point("ingest.device_put")
+        x_shards, m_shards = [], []
+        for di in range(dp):
+            block = rows_slice(di * rows_per, (di + 1) * rows_per)
+            mask_blk = np.zeros(rows_per, dtype=np_dtype)
+            n_valid = min(max(n - di * rows_per, 0), rows_per)
+            mask_blk[:n_valid] = 1.0
+            for mi in range(mp):
+                dev = mesh_devs[di, mi]
+                x_shards.append(
+                    jax.device_put(block[:, mi * cols_per : (mi + 1) * cols_per], dev)
+                )
+                m_shards.append(jax.device_put(mask_blk, dev))
+        xs = jax.make_array_from_single_device_arrays(
+            (n_tot, d_tot), x_sharding, x_shards
+        )
+        ms = jax.make_array_from_single_device_arrays(
+            (n_tot,), m_sharding, m_shards
+        )
+        return xs, ms
+
+    from spark_rapids_ml_tpu.robustness.retry import default_policy
+
+    xs, ms = default_policy().run(_place_shards, name="ingest.device_put")
     return xs, ms, n
 
 

@@ -1,0 +1,49 @@
+"""Robustness subsystem: deterministic fault injection, the unified
+retry/degradation policy, and the structured warnings they emit.
+
+Three modules, one story (the executable half of docs/PARITY.md "Failure
+injection & retry knobs"):
+
+  - :mod:`~spark_rapids_ml_tpu.robustness.faults` — named injection
+    sites (``TPUML_FAULTS`` / ``inject(...)``) threaded through every
+    layer that can fail, so recovery paths are TESTED code;
+  - :mod:`~spark_rapids_ml_tpu.robustness.retry` — the one
+    :class:`RetryPolicy` (attempts, backoff + deterministic jitter,
+    deadline, retryable-vs-fatal classification) those layers share;
+  - :mod:`~spark_rapids_ml_tpu.robustness.degrade` — the
+    ``TPUML_DEGRADE``-gated CPU fallback for single-process fits.
+"""
+
+from spark_rapids_ml_tpu.robustness.degrade import (
+    DegradationWarning,
+    degrade_mode,
+    run_degradable,
+)
+from spark_rapids_ml_tpu.robustness.faults import (
+    InjectedFault,
+    arm,
+    disarm,
+    fault_point,
+    inject,
+)
+from spark_rapids_ml_tpu.robustness.retry import (
+    RetryExhaustedError,
+    RetryPolicy,
+    classify,
+    default_policy,
+)
+
+__all__ = [
+    "DegradationWarning",
+    "InjectedFault",
+    "RetryExhaustedError",
+    "RetryPolicy",
+    "arm",
+    "classify",
+    "default_policy",
+    "degrade_mode",
+    "disarm",
+    "fault_point",
+    "inject",
+    "run_degradable",
+]

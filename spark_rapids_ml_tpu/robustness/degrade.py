@@ -1,0 +1,121 @@
+"""Graceful degradation — the CPU fallback ladder for single-process fits.
+
+The reference had no such rung: when the GPU was gone, the JNI call threw
+and the whole job died (SURVEY §5). For a serving-scale deployment
+(ROADMAP north star) the right behavior for SINGLE-PROCESS estimators is
+one rung down, not zero: when the accelerator backend is unavailable, or
+a recoverable operation exhausts its whole retry budget, finish the fit
+on the CPU path and say so loudly — a structured :class:`DegradationWarning`
+carrying what failed, why, and what the fallback was.
+
+Gated by ``TPUML_DEGRADE``:
+
+  - ``off`` (default): degradation disabled — errors propagate, classified
+    by the retry layer. The safe choice for batch jobs where a silent 50x
+    slowdown is worse than a loud failure.
+  - ``cpu``: single-process fits fall back to the CPU backend. The right
+    choice for serving paths where an answer late beats no answer.
+
+Distributed (mesh / multi-process) fits never degrade — a gang member
+quietly switching backends would desynchronize the cohort; those paths
+relaunch instead (spark/barrier.py).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Optional, TypeVar
+
+from spark_rapids_ml_tpu.robustness.retry import RetryExhaustedError
+from spark_rapids_ml_tpu.utils.envknobs import env_choice
+
+T = TypeVar("T")
+
+DEGRADE_ENV = "TPUML_DEGRADE"
+MODES = ("off", "cpu")
+
+
+class DegradationWarning(UserWarning):
+    """Structured record of a degradation event: ``what`` was attempted,
+    ``why`` it could not run accelerated, ``fallback`` that served it."""
+
+    def __init__(self, what: str, why: str, fallback: str):
+        self.what = what
+        self.why = why
+        self.fallback = fallback
+        super().__init__(
+            f"degraded {what}: {why}; continuing on {fallback} "
+            f"(set {DEGRADE_ENV}=off to fail instead)"
+        )
+
+
+def degrade_mode() -> str:
+    """The active ``TPUML_DEGRADE`` mode (malformed values raise a named
+    EnvKnobError, never a silent default)."""
+    return env_choice(DEGRADE_ENV, MODES, "off")
+
+
+def backend_unavailable(exc: BaseException) -> bool:
+    """Does this error mean the accelerator BACKEND is gone (vs. a bug)?
+    jax surfaces backend-initialization failures as RuntimeErrors with a
+    small set of recognizable messages."""
+    if not isinstance(exc, RuntimeError):
+        return False
+    text = str(exc).lower()
+    return any(
+        marker in text
+        for marker in (
+            "unable to initialize backend",
+            "no visible tpu",
+            "failed to initialize",
+            "backend 'tpu'",
+            "device unavailable",
+        )
+    )
+
+
+def cpu_device():
+    """The host CPU device, reachable even when the default backend is an
+    accelerator (jax keeps the cpu platform registered alongside)."""
+    import jax
+
+    return jax.devices("cpu")[0]
+
+
+def run_degradable(
+    accel_fn: Callable[[], T],
+    cpu_fn: Callable[[], Any],
+    what: str,
+    site: Optional[str] = None,
+) -> Any:
+    """Run ``accel_fn``; on retry exhaustion or backend unavailability,
+    either re-raise (mode ``off``) or warn-and-run ``cpu_fn`` (``cpu``).
+
+    Only the two degradable error shapes trigger the fallback — a fatal
+    classification (ValueError and friends) propagates untouched in every
+    mode, because wrong arguments are wrong on the CPU too.
+    """
+    try:
+        return accel_fn()
+    except RetryExhaustedError as exc:
+        if degrade_mode() != "cpu":
+            raise
+        warnings.warn(
+            DegradationWarning(
+                what,
+                f"retry budget exhausted at {site or exc.name}",
+                "the CPU path",
+            ),
+            stacklevel=2,
+        )
+        return cpu_fn()
+    except RuntimeError as exc:
+        if not backend_unavailable(exc) or degrade_mode() != "cpu":
+            raise
+        warnings.warn(
+            DegradationWarning(
+                what, f"accelerator backend unavailable ({exc})", "the CPU path"
+            ),
+            stacklevel=2,
+        )
+        return cpu_fn()

@@ -1,0 +1,230 @@
+"""Deterministic fault injection — named sites, seedless schedules.
+
+The reference never needed this: its failure story was "CUDA throws
+through JNI, Spark retries the task" (SURVEY §5), and the recovery path
+was exercised only by whatever real hardware happened to do. Here the
+recovery paths (retry, gang relaunch, degradation) are first-class code,
+so they get a first-class way to be PROVOKED: every layer that can fail
+declares a named injection site, and a schedule says which invocations of
+that site raise.
+
+Sites (the complete vocabulary — a spec naming anything else is an error):
+
+  - ``ingest.device_put``       host->device placement (core/ingest.py,
+                                parallel/mesh.py)
+  - ``distributed.initialize``  jax.distributed bring-up
+                                (parallel/distributed.py)
+  - ``barrier.attempt``         a barrier-stage gang attempt
+                                (spark/barrier.py)
+  - ``collective.psum``         the cross-process moment merge
+                                (parallel/distributed.py)
+  - ``persistence.write``       model data write (core/persistence.py)
+
+Schedules are counters, not random draws — the same spec always fails the
+same invocations, so a chaos test is exactly reproducible:
+
+  - ``site=N``           fail the first N invocations, then succeed
+  - ``site=always``      fail every invocation
+  - append ``:fatal``    raise a fault classified FATAL (never retried)
+
+Specs come from the ``TPUML_FAULTS`` env var (semicolon- or
+comma-separated entries, e.g. ``persistence.write=1;barrier.attempt=2``)
+or the :func:`inject` context manager. When no plan is active,
+:func:`fault_point` is one ``None`` check — zero overhead in production.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple, Union
+
+KNOWN_SITES = frozenset(
+    {
+        "ingest.device_put",
+        "distributed.initialize",
+        "barrier.attempt",
+        "collective.psum",
+        "persistence.write",
+    }
+)
+
+ALWAYS = -1  # sentinel count: fail every invocation
+
+FAULTS_ENV = "TPUML_FAULTS"
+
+
+class InjectedFault(RuntimeError):
+    """The error an armed fault site raises. Transient by default (the
+    retry layer classifies it retryable); ``fatal=True`` models a
+    non-recoverable failure (classified fatal, never retried)."""
+
+    def __init__(self, site: str, invocation: int, fatal: bool = False):
+        self.site = site
+        self.invocation = invocation
+        self.fatal = fatal
+        kind = "fatal" if fatal else "transient"
+        super().__init__(
+            f"injected {kind} fault at site {site!r} (invocation {invocation})"
+        )
+
+
+class Schedule:
+    """One site's failure schedule: fail invocations [0, count) — or all
+    of them for ``count=ALWAYS`` — raising fatal or transient faults."""
+
+    def __init__(self, count: int, fatal: bool = False):
+        if count != ALWAYS and count < 0:
+            raise ValueError(f"schedule count must be >= 0 or ALWAYS, got {count}")
+        self.count = count
+        self.fatal = fatal
+
+    def should_fail(self, invocation: int) -> bool:
+        return self.count == ALWAYS or invocation < self.count
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        n = "always" if self.count == ALWAYS else str(self.count)
+        return f"Schedule({n}{', fatal' if self.fatal else ''})"
+
+
+def parse_spec(spec: str) -> Dict[str, Schedule]:
+    """Parse a ``TPUML_FAULTS`` spec string into {site: Schedule}."""
+    plan: Dict[str, Schedule] = {}
+    for entry in spec.replace(",", ";").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise ValueError(
+                f"malformed fault entry {entry!r}: expected "
+                "site=N | site=always, optionally suffixed :fatal"
+            )
+        site, _, sched = entry.partition("=")
+        site = site.strip()
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}: known sites are "
+                f"{sorted(KNOWN_SITES)}"
+            )
+        sched = sched.strip()
+        fatal = False
+        if sched.endswith(":fatal"):
+            fatal = True
+            sched = sched[: -len(":fatal")]
+        if sched == "always":
+            count = ALWAYS
+        else:
+            try:
+                count = int(sched)
+            except ValueError:
+                raise ValueError(
+                    f"malformed schedule {sched!r} for site {site!r}: "
+                    "expected an integer count or 'always'"
+                ) from None
+            if count < 0:
+                raise ValueError(
+                    f"schedule count for site {site!r} must be >= 0, got {count}"
+                )
+        plan[site] = Schedule(count, fatal=fatal)
+    return plan
+
+
+class FaultPlan:
+    """An active set of schedules plus per-site invocation counters.
+
+    Counters are per-plan (a fresh ``inject`` starts from zero) and
+    thread-safe; ``fired`` records every fault actually raised so tests
+    can assert the injection really happened."""
+
+    def __init__(self, schedules: Dict[str, Schedule]):
+        self._schedules = dict(schedules)
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.fired: List[Tuple[str, int]] = []
+
+    def invocations(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def check(self, site: str) -> None:
+        sched = self._schedules.get(site)
+        if sched is None:
+            return
+        with self._lock:
+            invocation = self._counts.get(site, 0)
+            self._counts[site] = invocation + 1
+            if sched.should_fail(invocation):
+                self.fired.append((site, invocation))
+                raise InjectedFault(site, invocation, fatal=sched.fatal)
+
+
+# The active plan. None (the production state) makes fault_point a single
+# attribute load + comparison; TPUML_FAULTS arms one at import time so a
+# launcher can inject into any process without code changes.
+_active: Optional[FaultPlan] = None
+
+
+def fault_point(site: str) -> None:
+    """Declare a named injection site. Raises :class:`InjectedFault` when
+    an active plan schedules a failure for this invocation; otherwise a
+    no-op."""
+    if _active is None:
+        return
+    _active.check(site)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _active
+
+
+def arm(spec: Union[str, Dict[str, Schedule]]) -> FaultPlan:
+    """Install a fault plan (replacing any active one) and return it."""
+    global _active
+    plan = FaultPlan(parse_spec(spec) if isinstance(spec, str) else spec)
+    _active = plan
+    return plan
+
+
+def disarm() -> None:
+    global _active
+    _active = None
+
+
+class inject:
+    """Context manager: arm a plan for the block, restore the previous
+    plan (usually none) on exit.
+
+    >>> with inject("persistence.write=1") as plan:
+    ...     model.write.overwrite().save(path)   # first write fails, retried
+    >>> plan.fired
+    [('persistence.write', 0)]
+    """
+
+    def __init__(self, spec: Union[str, Dict[str, Schedule]]):
+        self._spec = spec
+        self._prev: Optional[FaultPlan] = None
+        self.plan: Optional[FaultPlan] = None
+
+    def __enter__(self) -> FaultPlan:
+        global _active
+        self._prev = _active
+        self.plan = arm(self._spec)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        global _active
+        _active = self._prev
+
+
+def arm_from_env() -> Optional[FaultPlan]:
+    """Arm a plan from ``TPUML_FAULTS`` when set (no-op otherwise).
+    Runs once at import so a launcher can inject into any process with
+    zero code changes; callable again by harnesses that set the env
+    after import."""
+    spec = os.environ.get(FAULTS_ENV)
+    if spec:
+        return arm(spec)
+    return None
+
+
+arm_from_env()

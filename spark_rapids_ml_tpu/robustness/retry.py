@@ -1,0 +1,175 @@
+"""The ONE retry/backoff/classification policy for every recoverable layer.
+
+Before this module, each call site that could fail hand-rolled its own
+recovery: the barrier launcher leaned on Spark's opaque stage-attempt
+budget, ``distributed.initialize`` trusted jax's heartbeat to surface the
+error and hoped the launcher would relaunch, persistence didn't retry at
+all. One :class:`RetryPolicy` now owns the decisions all of them share —
+how many attempts, how long between them (exponential backoff with
+DETERMINISTIC jitter, so two runs of a chaos schedule behave identically),
+when the overall deadline has passed, and which errors are even worth
+retrying.
+
+Classification is structural, not stringly: programming/usage errors
+(``ValueError``/``TypeError``/... ) are FATAL and re-raise immediately
+untouched; environmental errors (``OSError``, timeouts, distributed
+runtime ``RuntimeError``) are RETRYABLE. An injected fault
+(robustness.faults) carries its own classification so chaos tests can
+exercise both paths. Exhausting the budget raises
+:class:`RetryExhaustedError` with the attempt count and the last error
+chained — one classified error, never a hang and never a bare traceback
+from deep inside an attempt.
+
+Every attempt runs inside a ``utils/tracing.py`` range
+(``retry:<name>#<attempt>``) so recovery is visible in profiles exactly
+like the compute it protects.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from spark_rapids_ml_tpu.robustness.faults import InjectedFault
+from spark_rapids_ml_tpu.utils.envknobs import env_float, env_int
+
+T = TypeVar("T")
+
+# Env knobs (docs/PARITY.md "Failure injection & retry knobs").
+MAX_ATTEMPTS_ENV = "TPUML_RETRY_MAX_ATTEMPTS"
+BASE_DELAY_ENV = "TPUML_RETRY_BASE_DELAY"
+MAX_DELAY_ENV = "TPUML_RETRY_MAX_DELAY"
+DEADLINE_ENV = "TPUML_RETRY_DEADLINE"
+
+# Error types that indicate a bug or a caller mistake, not an environment
+# hiccup: retrying cannot help and would only bury the real traceback.
+FATAL_TYPES: Tuple[Type[BaseException], ...] = (
+    ValueError,
+    TypeError,
+    KeyError,
+    IndexError,
+    AttributeError,
+    AssertionError,
+    NotImplementedError,
+)
+
+
+class RetryExhaustedError(RuntimeError):
+    """The retry budget (attempts or deadline) ran out. ``__cause__`` is
+    the last underlying error; ``attempts`` how many were made."""
+
+    def __init__(self, name: str, attempts: int, last: BaseException, why: str):
+        self.name = name
+        self.attempts = attempts
+        super().__init__(
+            f"{name}: {why} after {attempts} attempt(s); "
+            f"last error: {type(last).__name__}: {last}"
+        )
+
+
+def classify(exc: BaseException) -> str:
+    """``"retryable"`` or ``"fatal"`` for one raised error."""
+    if isinstance(exc, InjectedFault):
+        return "fatal" if exc.fatal else "retryable"
+    if isinstance(exc, FATAL_TYPES):
+        return "fatal"
+    # Everything environmental — OSError/ConnectionError/TimeoutError and
+    # the distributed-runtime RuntimeErrors (heartbeat loss, coordination
+    # service unavailable) — is worth another attempt.
+    return "retryable"
+
+
+def _deterministic_jitter(name: str, attempt: int) -> float:
+    """A stable fraction in [0, 1) from (name, attempt) — backoff spreads
+    like random jitter but identically on every run and every process, so
+    chaos schedules and multi-process cohorts stay in lockstep."""
+    digest = hashlib.sha256(f"{name}#{attempt}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") / 2**32
+
+
+class RetryPolicy:
+    """max attempts + exponential backoff + deterministic jitter + an
+    overall deadline + error classification, as one reusable value.
+
+    ``run(fn, name)`` executes ``fn`` under the policy: fatal errors
+    re-raise immediately, retryable ones back off and re-attempt, and an
+    exhausted budget raises :class:`RetryExhaustedError` with the last
+    error chained.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        base_delay: float = 0.05,
+        max_delay: float = 2.0,
+        deadline: Optional[float] = None,
+    ):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        if base_delay < 0 or max_delay < 0:
+            raise ValueError("delays must be >= 0")
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.deadline = deadline
+
+    @classmethod
+    def from_env(cls, max_attempts: int = 3, base_delay: float = 0.05,
+                 max_delay: float = 2.0, deadline: Optional[float] = None) -> "RetryPolicy":
+        """The defaults, overridable per process via ``TPUML_RETRY_*``."""
+        return cls(
+            max_attempts=env_int(MAX_ATTEMPTS_ENV, max_attempts, minimum=1),
+            base_delay=env_float(BASE_DELAY_ENV, base_delay, minimum=0.0),
+            max_delay=env_float(MAX_DELAY_ENV, max_delay, minimum=0.0),
+            deadline=env_float(DEADLINE_ENV, deadline, minimum=0.0),
+        )
+
+    def backoff(self, name: str, attempt: int) -> float:
+        """Delay before re-attempt ``attempt`` (>= 1): exponential in the
+        attempt number, capped, jittered deterministically into
+        [0.5x, 1.0x] of the cap so cohort members don't stampede."""
+        raw = min(self.base_delay * (2 ** (attempt - 1)), self.max_delay)
+        return raw * (0.5 + 0.5 * _deterministic_jitter(name, attempt))
+
+    def run(
+        self,
+        fn: Callable[[], T],
+        name: str,
+        on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    ) -> T:
+        from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+        start = time.monotonic()
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            if (
+                self.deadline is not None
+                and time.monotonic() - start > self.deadline
+            ):
+                # last is non-None here: attempt 0 starts before any
+                # deadline check can trip (time 0 <= deadline).
+                raise RetryExhaustedError(
+                    name, attempt, last, f"deadline of {self.deadline}s exceeded"
+                ) from last
+            try:
+                with TraceRange(f"retry:{name}#{attempt}", TraceColor.YELLOW):
+                    return fn()
+            except BaseException as exc:
+                if classify(exc) == "fatal":
+                    raise
+                last = exc
+                if on_retry is not None and attempt + 1 < self.max_attempts:
+                    on_retry(attempt, exc)
+            delay = self.backoff(name, attempt + 1)
+            if delay > 0 and attempt + 1 < self.max_attempts:
+                time.sleep(delay)
+        raise RetryExhaustedError(
+            name, self.max_attempts, last, "retry budget exhausted"
+        ) from last
+
+
+def default_policy() -> RetryPolicy:
+    """The process-wide policy, re-read from env per call so tests (and
+    launchers that tune knobs between stages) see changes immediately."""
+    return RetryPolicy.from_env()

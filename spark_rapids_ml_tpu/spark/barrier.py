@@ -31,12 +31,23 @@ from __future__ import annotations
 
 from typing import Callable, Iterable, Iterator, Optional
 
+from spark_rapids_ml_tpu.robustness.degrade import run_degradable
+from spark_rapids_ml_tpu.robustness.retry import RetryPolicy
+from spark_rapids_ml_tpu.utils.envknobs import env_int
+
 DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed's conventional port
+
+# Driver-side STAGE resubmissions (whole-gang, on top of the scheduler's
+# own spark.stage.maxConsecutiveAttempts budget). Default 1 = submit once
+# and trust the scheduler, exactly the pre-policy behavior; raise it when
+# the cluster's stage budget is too small for the failure domain.
+BARRIER_RESUBMITS_ENV = "TPUML_BARRIER_RESUBMITS"
 
 
 def barrier_gang_run(
     rdd,
     task_fn: Callable[[Optional[object], Iterator], Iterable],
+    policy: Optional[RetryPolicy] = None,
 ) -> list:
     """Run ``task_fn(barrier_ctx, partition_iterator)`` over every
     partition as ONE barrier stage and return the collected outputs.
@@ -47,24 +58,63 @@ def barrier_gang_run(
     is scheduled — a member that fails at launch aborts the attempt
     before any collective can strand survivors. Any exception in any
     task relaunches ALL tasks (Spark barrier-stage retry); after the
-    scheduler's stage-attempt limit the error reaches the driver.
+    scheduler's stage-attempt limit the error reaches the driver, where
+    the shared :class:`RetryPolicy` (robustness.retry) owns what happens
+    next: classification (a ``ValueError`` from the task is a bug and
+    re-raises untouched; a runtime failure is retryable), optional
+    whole-stage resubmission (``TPUML_BARRIER_RESUBMITS``, default 1 =
+    no resubmit), a profiler range per attempt, and one classified
+    ``RetryExhaustedError`` when the budget is gone — never a hang.
+
+    With ``TPUML_DEGRADE=cpu`` an exhausted budget degrades instead of
+    raising: the partitions re-run on the driver as a plain (non-barrier,
+    non-gang) stage with ``ctx=None`` — there is no cohort left to
+    strand — under a structured :class:`DegradationWarning`.
 
     Fits are stateless one-pass reductions in this framework, so the
     relaunched gang simply refits from the same lineage — no partial
     state to reconcile (iterative fits resume from their last persisted
     model via the warm starts: ``KMeans.setInitialModel``,
     ``UMAP.setInitEmbedding``).
+
+    Each gang member declares the ``barrier.attempt`` fault site
+    (robustness.faults) right after the launch barrier, so chaos tests
+    can kill attempt 0 and assert the relaunch refits bit-identically.
     """
 
     def wrapped(it):
         from pyspark import BarrierTaskContext
 
+        from spark_rapids_ml_tpu.robustness.faults import fault_point
+
         ctx = BarrierTaskContext.get()
         if ctx is not None:
             ctx.barrier()
+        fault_point("barrier.attempt")
         return task_fn(ctx, it)
 
-    return rdd.barrier().mapPartitions(wrapped).collect()
+    def fallback(it):
+        # Degraded (driver-local) execution: no barrier, no gang, ctx=None
+        # — and no barrier.attempt fault site, the gang is what failed.
+        return task_fn(None, it)
+
+    if policy is None:
+        # Deliberately NOT the generic TPUML_RETRY_MAX_ATTEMPTS knob: the
+        # scheduler already retries the stage internally, so driver-side
+        # resubmission has its own (default-off) budget.
+        policy = RetryPolicy(
+            max_attempts=env_int(BARRIER_RESUBMITS_ENV, 1, minimum=1)
+        )
+
+    return run_degradable(
+        lambda: policy.run(
+            lambda: rdd.barrier().mapPartitions(wrapped).collect(),
+            name="barrier.stage",
+        ),
+        lambda: rdd.mapPartitions(fallback).collect(),
+        what="barrier gang fit",
+        site="barrier.attempt",
+    )
 
 
 def gang_coordinates(ctx, port: int = DEFAULT_COORDINATOR_PORT) -> dict:

@@ -1,0 +1,74 @@
+"""One home for ``TPUML_*`` environment-knob parsing.
+
+Every env knob used to be read with a bare ``int(os.environ[...])``, so a
+malformed value (``TPUML_HEARTBEAT_TIMEOUT=ten``) surfaced as an anonymous
+``ValueError: invalid literal for int()`` with no hint of WHICH variable
+was broken or what shape it expects — the exact failure mode a launcher
+typo produces on every gang member at once. These helpers raise one
+uniform, named error instead: variable, offending value, expected form.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+
+class EnvKnobError(ValueError):
+    """A ``TPUML_*`` environment variable holds a malformed value."""
+
+    def __init__(self, name: str, value: str, expected: str):
+        self.name = name
+        self.value = value
+        self.expected = expected
+        super().__init__(
+            f"environment variable {name}={value!r} is malformed: "
+            f"expected {expected}"
+        )
+
+
+def env_int(
+    name: str,
+    default: Optional[int] = None,
+    minimum: Optional[int] = None,
+) -> Optional[int]:
+    """``int(os.environ[name])`` with a named, actionable error."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = int(raw.strip())
+    except ValueError:
+        raise EnvKnobError(name, raw, "an integer (e.g. 100)") from None
+    if minimum is not None and value < minimum:
+        raise EnvKnobError(name, raw, f"an integer >= {minimum}")
+    return value
+
+
+def env_float(
+    name: str,
+    default: Optional[float] = None,
+    minimum: Optional[float] = None,
+) -> Optional[float]:
+    """``float(os.environ[name])`` with a named, actionable error."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = float(raw.strip())
+    except ValueError:
+        raise EnvKnobError(name, raw, "a number (e.g. 0.5)") from None
+    if minimum is not None and value < minimum:
+        raise EnvKnobError(name, raw, f"a number >= {minimum}")
+    return value
+
+
+def env_choice(name: str, choices: Sequence[str], default: str) -> str:
+    """A string knob restricted to an explicit vocabulary."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    value = raw.strip().lower()
+    if value not in choices:
+        raise EnvKnobError(name, raw, f"one of {'|'.join(choices)}")
+    return value

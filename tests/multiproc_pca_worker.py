@@ -21,6 +21,13 @@ import numpy as np
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# Cross-process collectives on the CPU backend need an explicit transport
+# on older jaxlibs (the default "none" raises "Multiprocess computations
+# aren't implemented on the CPU backend").
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:  # newer jax: gloo is the default, the knob may be gone
+    pass
 # Default x64 for tight oracle tolerances; TPUML_TEST_NO_X64 exercises the
 # real-TPU configuration (fp32 compute, double-float moment wire format).
 _x64 = os.environ.get("TPUML_TEST_NO_X64") != "1"

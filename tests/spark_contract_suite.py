@@ -1126,3 +1126,55 @@ class TestBarrierGangRecovery:
         assert all(c["num_processes"] == 2 for c in coords)
         assert len({c["coordinator_address"] for c in coords}) == 1
         assert coords[0]["coordinator_address"].endswith(":8476")
+
+    def test_relaunched_gang_gets_fresh_coordinator_port(
+        self, spark_env, rng, tmp_path
+    ):
+        """The attempt number offsets the coordinator port: a RELAUNCHED
+        gang (attempt 1) must derive a different coordinator address than
+        the attempt it replaces, so it can never rejoin the dead cohort's
+        coordination service (which may outlive its tasks by up to the
+        heartbeat timeout while still bound to the old port)."""
+        adapter, spark = spark_env
+        from spark_rapids_ml_tpu.spark.barrier import (
+            barrier_gang_run,
+            gang_coordinates,
+        )
+
+        x = rng.normal(size=(40, 3))
+        df = _vector_df(spark, x, n_parts=2)
+        sentinel = str(tmp_path / "port_fault")
+        log_dir = str(tmp_path)
+
+        def task(ctx, it):
+            import os
+
+            list(it)
+            if ctx is None:
+                return
+            coords = gang_coordinates(ctx)
+            attempt = int(ctx.attemptNumber())
+            with open(
+                os.path.join(log_dir, f"addr_a{attempt}_p{ctx.partitionId()}"),
+                "w",
+            ) as fh:
+                fh.write(coords["coordinator_address"])
+            if not os.path.exists(sentinel):
+                open(sentinel, "w").close()
+                raise RuntimeError("injected failure on the first attempt")
+            yield coords
+
+        coords = barrier_gang_run(df.select("features").rdd, task)
+
+        import os
+
+        with open(os.path.join(log_dir, "addr_a0_p0")) as fh:
+            addr_attempt0 = fh.read()
+        addrs_final = {c["coordinator_address"] for c in coords}
+        assert len(addrs_final) == 1  # the relaunched gang agrees
+        addr_attempt1 = addrs_final.pop()
+        assert addr_attempt1 != addr_attempt0
+        host0, _, port0 = addr_attempt0.rpartition(":")
+        host1, _, port1 = addr_attempt1.rpartition(":")
+        assert host1 == host0
+        assert int(port1) == int(port0) + 1  # port + attempt

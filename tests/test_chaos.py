@@ -1,0 +1,560 @@
+"""Chaos suite — deterministic fault injection × representative fits.
+
+The recovery paths (robustness.retry / robustness.degrade, the gang
+relaunch, the atomic model writer) are first-class code; this suite is
+what keeps them that way. Every instrumented site is provoked through a
+REAL fit with a schedule that fails the first attempt(s), and the
+recovered result is asserted BIT-IDENTICAL to a no-fault run — retries
+must re-execute deterministic work, not approximately redo it. Exhausting
+the budget must surface exactly one classified error (RetryExhaustedError
+with the cause chained) or, under ``TPUML_DEGRADE=cpu``, the documented
+CPU degradation with a structured warning — never a hang, never a
+half-written artifact.
+
+Representative fits per the r6 issue: PCA (the distributed-moments
+family), KMeans warm-restart (the checkpoint-resume family), logistic
+regression (the iterative-solver family); the barrier site runs a
+moments fit under the pyspark stub's stage-level gang retry.
+"""
+
+import glob
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+from spark_rapids_ml_tpu.robustness import (
+    DegradationWarning,
+    InjectedFault,
+    RetryExhaustedError,
+    RetryPolicy,
+    classify,
+    inject,
+)
+from spark_rapids_ml_tpu.robustness.faults import disarm, parse_spec
+from spark_rapids_ml_tpu.utils.envknobs import EnvKnobError, env_int
+
+_STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)), "pyspark_stub")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    """A test that dies mid-inject must not poison its neighbors."""
+    yield
+    disarm()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    """Zero backoff: the chaos matrix retries dozens of times per run."""
+    monkeypatch.setenv("TPUML_RETRY_BASE_DELAY", "0")
+
+
+@pytest.fixture
+def data(rng):
+    return rng.normal(size=(120, 5))
+
+
+def _pca_state(x):
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    m = PCA().setK(2).fit(x)
+    return m, (m.pc.tobytes(), m.explainedVariance.tobytes())
+
+
+def _kmeans_warm_state(x):
+    """The warm-restart path: a short cold fit checkpoints centers, the
+    measured fit resumes from them (mllib setInitialModel semantics)."""
+    from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+    cold = KMeans().setK(3).setMaxIter(2).setSeed(7).fit(x)
+    warm = (
+        KMeans()
+        .setK(3)
+        .setMaxIter(5)
+        .setSeed(7)
+        .setInitialModel(cold)
+        .fit(x)
+    )
+    return warm, (np.asarray(warm.clusterCenters()).tobytes(),)
+
+
+def _logistic_state(x):
+    from spark_rapids_ml_tpu.models.logistic_regression import (
+        LogisticRegression,
+    )
+
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+    m = LogisticRegression().setMaxIter(40).fit((x, y))
+    return m, (
+        np.asarray(m.coefficients).tobytes(),
+        np.asarray(m.intercept).tobytes(),
+    )
+
+
+_FITS = {
+    "pca": _pca_state,
+    "kmeans_warm": _kmeans_warm_state,
+    "logistic": _logistic_state,
+}
+
+
+class TestSpecParsing:
+    def test_known_sites_only(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            parse_spec("no.such.site=1")
+
+    def test_counts_and_always_and_fatal(self):
+        plan = parse_spec(
+            "ingest.device_put=2; barrier.attempt=always:fatal,"
+            "persistence.write=0"
+        )
+        assert plan["ingest.device_put"].count == 2
+        assert not plan["ingest.device_put"].fatal
+        assert plan["barrier.attempt"].fatal
+        assert plan["barrier.attempt"].should_fail(10**6)
+        assert not plan["persistence.write"].should_fail(0)
+
+    def test_malformed_entry_raises(self):
+        with pytest.raises(ValueError, match="malformed"):
+            parse_spec("ingest.device_put")
+        with pytest.raises(ValueError, match="malformed schedule"):
+            parse_spec("ingest.device_put=soon")
+
+    def test_env_spec_arms_without_code_changes(self, monkeypatch):
+        """TPUML_FAULTS arms a plan through the same entry the import
+        runs — the launcher path, no code changes in the process."""
+        from spark_rapids_ml_tpu.robustness import faults
+
+        monkeypatch.setenv("TPUML_FAULTS", "ingest.device_put=1")
+        plan = faults.arm_from_env()
+        assert plan is not None and faults.active_plan() is plan
+        with pytest.raises(InjectedFault):
+            faults.fault_point("ingest.device_put")
+        faults.fault_point("ingest.device_put")  # schedule spent
+
+    def test_zero_overhead_when_disarmed(self):
+        from spark_rapids_ml_tpu.robustness.faults import fault_point
+
+        assert fault_point("ingest.device_put") is None  # plain no-op
+
+
+class TestRetryPolicy:
+    def test_classification(self):
+        assert classify(ValueError("bug")) == "fatal"
+        assert classify(TypeError("bug")) == "fatal"
+        assert classify(OSError("io")) == "retryable"
+        assert classify(RuntimeError("heartbeat lost")) == "retryable"
+        assert classify(InjectedFault("s", 0)) == "retryable"
+        assert classify(InjectedFault("s", 0, fatal=True)) == "fatal"
+
+    def test_fatal_reraises_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("caller bug")
+
+        with pytest.raises(ValueError, match="caller bug"):
+            RetryPolicy(max_attempts=5, base_delay=0).run(fn, "t")
+        assert len(calls) == 1
+
+    def test_retryable_retries_then_succeeds(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert RetryPolicy(max_attempts=3, base_delay=0).run(fn, "t") == "ok"
+        assert len(calls) == 3
+
+    def test_exhaustion_is_one_classified_error(self):
+        def fn():
+            raise OSError("forever")
+
+        with pytest.raises(RetryExhaustedError) as ei:
+            RetryPolicy(max_attempts=2, base_delay=0).run(fn, "unit")
+        assert ei.value.attempts == 2
+        assert isinstance(ei.value.__cause__, OSError)
+
+    def test_deadline(self):
+        import itertools
+
+        clock = itertools.count()
+
+        def fn():
+            # Each attempt "takes" long via the monotonic mock below.
+            raise OSError("slow")
+
+        policy = RetryPolicy(max_attempts=100, base_delay=0, deadline=3.0)
+        import spark_rapids_ml_tpu.robustness.retry as retry_mod
+
+        real = retry_mod.time.monotonic
+        retry_mod.time.monotonic = lambda: float(next(clock))
+        try:
+            with pytest.raises(RetryExhaustedError, match="deadline"):
+                policy.run(fn, "slowpoke")
+        finally:
+            retry_mod.time.monotonic = real
+
+    def test_jitter_is_deterministic(self):
+        p = RetryPolicy(max_attempts=3, base_delay=0.1, max_delay=1.0)
+        assert p.backoff("x", 1) == p.backoff("x", 1)
+        assert p.backoff("x", 1) != p.backoff("y", 1)  # spread across names
+        assert p.backoff("x", 2) <= 1.0
+
+    def test_attempts_emit_trace_ranges(self):
+        from spark_rapids_ml_tpu.utils.tracing import (
+            clear_events,
+            recent_events,
+        )
+
+        clear_events()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError("once")
+            return 1
+
+        RetryPolicy(max_attempts=3, base_delay=0).run(fn, "traced")
+        names = [n for n, _, _ in recent_events()]
+        assert "retry:traced#0" in names and "retry:traced#1" in names
+
+    def test_env_knobs_reach_policy(self, monkeypatch):
+        monkeypatch.setenv("TPUML_RETRY_MAX_ATTEMPTS", "7")
+        monkeypatch.setenv("TPUML_RETRY_DEADLINE", "12.5")
+        p = RetryPolicy.from_env()
+        assert p.max_attempts == 7 and p.deadline == 12.5
+        monkeypatch.setenv("TPUML_RETRY_MAX_ATTEMPTS", "many")
+        with pytest.raises(EnvKnobError, match="TPUML_RETRY_MAX_ATTEMPTS"):
+            RetryPolicy.from_env()
+
+
+class TestEnvKnobHardening:
+    """Satellite: every TPUML_* int knob parses through one helper that
+    names the variable, the offending value, and the expected form."""
+
+    def test_env_int_names_the_variable(self, monkeypatch):
+        monkeypatch.setenv("TPUML_HEARTBEAT_TIMEOUT", "ten")
+        with pytest.raises(EnvKnobError) as ei:
+            env_int("TPUML_HEARTBEAT_TIMEOUT")
+        msg = str(ei.value)
+        assert "TPUML_HEARTBEAT_TIMEOUT" in msg
+        assert "'ten'" in msg
+        assert "integer" in msg
+
+    def test_initialize_surfaces_named_error(self, monkeypatch):
+        from spark_rapids_ml_tpu.parallel import distributed as dist
+
+        monkeypatch.setenv("TPUML_HEARTBEAT_TIMEOUT", "100s")
+        monkeypatch.setattr(dist, "_initialized", False)
+        monkeypatch.setattr(
+            jax.distributed, "initialize", lambda **kw: None
+        )
+        with pytest.raises(EnvKnobError, match="TPUML_HEARTBEAT_TIMEOUT"):
+            dist.initialize(
+                coordinator_address="127.0.0.1:1", num_processes=1, process_id=0
+            )
+        assert dist._initialized is False
+
+    def test_minimum_enforced(self, monkeypatch):
+        monkeypatch.setenv("TPUML_NUM_PROCESSES", "0")
+        with pytest.raises(EnvKnobError, match=">= 1"):
+            env_int("TPUML_NUM_PROCESSES", minimum=1)
+
+
+class TestIngestSiteRecovery:
+    """ingest.device_put: fail the first placement, assert the retried
+    fit is bit-identical to a no-fault run — for every representative
+    fit family that routes through the shared funnel."""
+
+    @pytest.mark.parametrize("family", ["kmeans_warm", "logistic"])
+    def test_fail_first_then_bit_identical(self, family, data):
+        _, want = _FITS[family](data)
+        with inject("ingest.device_put=1") as plan:
+            _, got = _FITS[family](data)
+        assert plan.fired == [("ingest.device_put", 0)]
+        assert got == want
+
+    @pytest.mark.parametrize("family", ["kmeans_warm", "logistic"])
+    def test_exhaustion_surfaces_classified_error(self, family, data):
+        with inject("ingest.device_put=always"):
+            with pytest.raises(RetryExhaustedError) as ei:
+                _FITS[family](data)
+        assert isinstance(ei.value.__cause__, InjectedFault)
+
+    def test_fatal_fault_skips_retry(self, data):
+        with inject("ingest.device_put=always:fatal") as plan:
+            with pytest.raises(InjectedFault):
+                _FITS["kmeans_warm"](data)
+        # fatal = classified unretryable: exactly one invocation consumed.
+        assert plan.invocations("ingest.device_put") == 1
+
+
+class TestCollectiveSiteRecovery:
+    """collective.psum: the cross-process moment merge re-runs exactly."""
+
+    def _moments(self, blocks, mesh):
+        from spark_rapids_ml_tpu.parallel.distributed import (
+            streaming_covariance_process_local,
+        )
+
+        mean, cov, n = streaming_covariance_process_local(
+            iter(blocks), mesh=mesh, merge="psum"
+        )
+        return mean.tobytes(), cov.tobytes(), n
+
+    def test_fail_first_then_bit_identical(self, rng):
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh((len(jax.devices()), 1))
+        blocks = [rng.normal(size=(40, 6)) for _ in range(3)]
+        want = self._moments(blocks, mesh)
+        with inject("collective.psum=1") as plan:
+            got = self._moments(blocks, mesh)
+        assert plan.fired == [("collective.psum", 0)]
+        assert got == want
+
+    def test_exhaustion_classified(self, rng):
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh((len(jax.devices()), 1))
+        blocks = [rng.normal(size=(40, 6)) for _ in range(2)]
+        with inject("collective.psum=always"):
+            with pytest.raises(RetryExhaustedError):
+                self._moments(blocks, mesh)
+
+
+class TestInitializeSiteRecovery:
+    """distributed.initialize: bring-up retries under the shared policy
+    (the real jax.distributed.initialize is mocked — a unit process must
+    not actually bind a coordination service mid-suite)."""
+
+    @pytest.fixture
+    def mocked_dist(self, monkeypatch):
+        from spark_rapids_ml_tpu.parallel import distributed as dist
+
+        calls = []
+        monkeypatch.setattr(dist, "_initialized", False)
+        monkeypatch.setattr(
+            jax.distributed, "initialize", lambda **kw: calls.append(kw)
+        )
+        return dist, calls
+
+    def test_fail_first_then_initialized(self, mocked_dist):
+        dist, calls = mocked_dist
+        with inject("distributed.initialize=1") as plan:
+            dist.initialize(
+                coordinator_address="127.0.0.1:1", num_processes=2, process_id=1
+            )
+        assert plan.fired == [("distributed.initialize", 0)]
+        assert len(calls) == 1  # the retry reached the real bring-up once
+        assert calls[0]["num_processes"] == 2 and calls[0]["process_id"] == 1
+        assert dist._initialized
+
+    def test_exhaustion_leaves_uninitialized(self, mocked_dist):
+        dist, calls = mocked_dist
+        with inject("distributed.initialize=always"):
+            with pytest.raises(RetryExhaustedError) as ei:
+                dist.initialize(
+                    coordinator_address="127.0.0.1:1",
+                    num_processes=2,
+                    process_id=1,
+                )
+        assert isinstance(ei.value.__cause__, InjectedFault)
+        assert calls == [] and dist._initialized is False
+
+
+class TestPersistenceSiteRecovery:
+    """persistence.write + the atomic writer: a killed/faulted save never
+    leaves a half-written model where load() can find it."""
+
+    @pytest.mark.parametrize("family", sorted(_FITS))
+    def test_fail_first_then_roundtrip_identical(self, family, data, tmp_path):
+        model, _ = _FITS[family](data)
+        path = str(tmp_path / "m")
+        with inject("persistence.write=1") as plan:
+            model.write.overwrite().save(path)
+        assert plan.fired == [("persistence.write", 0)]
+        loaded = type(model).load(path)
+        assert _state_bytes(loaded) == _state_bytes(model)
+
+    def test_exhaustion_leaves_no_artifact(self, data, tmp_path):
+        model, _ = _FITS["pca"](data)
+        path = str(tmp_path / "m")
+        with inject("persistence.write=always"):
+            with pytest.raises(RetryExhaustedError):
+                model.write.save(path)
+        assert not os.path.exists(path)
+        assert glob.glob(str(tmp_path / ".*tmp-save*")) == []
+
+    def test_kill_mid_save_is_invisible_to_load(self, data, tmp_path):
+        """A FATAL fault models SIGKILL mid-write: no retry, no cleanup
+        beyond the temp dir — the target path must simply not exist."""
+        model, _ = _FITS["pca"](data)
+        path = str(tmp_path / "m")
+        with inject("persistence.write=always:fatal"):
+            with pytest.raises(InjectedFault):
+                model.write.save(path)
+        assert not os.path.exists(path)
+        with pytest.raises(FileNotFoundError):
+            type(model).load(path)
+
+    def test_failed_overwrite_keeps_previous_model(self, data, tmp_path):
+        model, _ = _FITS["pca"](data)
+        path = str(tmp_path / "m")
+        model.write.save(path)
+        before = _state_bytes(type(model).load(path))
+        with inject("persistence.write=always"):
+            with pytest.raises(RetryExhaustedError):
+                model.write.overwrite().save(path)
+        assert _state_bytes(type(model).load(path)) == before
+
+
+def _state_bytes(model):
+    """The fitted arrays of any chaos-suite model family, as bytes."""
+    if hasattr(model, "pc"):
+        return [model.pc.tobytes(), model.explainedVariance.tobytes()]
+    if hasattr(model, "clusterCenters"):
+        return [np.asarray(model.clusterCenters()).tobytes()]
+    return [
+        np.asarray(model.coefficients).tobytes(),
+        np.asarray(model.intercept).tobytes(),
+    ]
+
+
+@pytest.fixture
+def stub_spark():
+    """The pyspark stub installed as ``pyspark`` (the contract-suite
+    arrangement, trimmed: the chaos tests need the session + barrier
+    scheduler, not the adapter)."""
+    saved = {
+        n: m for n, m in sys.modules.items() if n.startswith("pyspark")
+    }
+    for n in list(saved):
+        del sys.modules[n]
+    sys.path.insert(0, _STUB)
+    try:
+        from pyspark.sql import SparkSession
+
+        yield SparkSession.builder.master("local[2]").getOrCreate()
+    finally:
+        sys.path.remove(_STUB)
+        for n in [n for n in sys.modules if n.startswith("pyspark")]:
+            del sys.modules[n]
+        sys.modules.update(saved)
+
+
+def _moments_task(ctx, it):
+    """Per-partition normal-equation moments (the contract-suite fit)."""
+    xs = [np.asarray(r.features.toArray(), dtype=float) for r in it]
+    x = np.asarray(xs)
+    yield x.T @ x
+
+
+def _gang_fit(spark, x):
+    import spark_contract_suite as suite
+
+    from spark_rapids_ml_tpu.spark.barrier import barrier_gang_run
+
+    df = suite._vector_df(spark, x, n_parts=2)
+    parts = barrier_gang_run(df.select("features").rdd, _moments_task)
+    return sum(p for p in parts)
+
+
+class TestBarrierSiteRecovery:
+    """barrier.attempt: a gang member dies on attempt 0, the stub's
+    stage-level retry relaunches the WHOLE gang, and the refit matches
+    the no-fault run bit-for-bit."""
+
+    def test_fail_first_then_bit_identical(self, stub_spark, rng):
+        x = rng.normal(size=(80, 4))
+        want = _gang_fit(stub_spark, x)
+        with inject("barrier.attempt=1") as plan:
+            got = _gang_fit(stub_spark, x)
+        assert plan.fired == [("barrier.attempt", 0)]
+        assert got.tobytes() == want.tobytes()
+
+    def test_exhaustion_is_one_classified_error(self, stub_spark, rng):
+        x = rng.normal(size=(40, 4))
+        with inject("barrier.attempt=always"):
+            with pytest.raises(RetryExhaustedError) as ei:
+                _gang_fit(stub_spark, x)
+        assert isinstance(ei.value.__cause__, InjectedFault)
+
+    def test_stage_resubmit_knob(self, stub_spark, rng, monkeypatch):
+        """TPUML_BARRIER_RESUBMITS=2 gives the stage a second driver-side
+        submission after the scheduler's own budget burns out."""
+        from pyspark.sql import BARRIER_MAX_ATTEMPTS
+
+        x = rng.normal(size=(40, 4))
+        monkeypatch.setenv("TPUML_BARRIER_RESUBMITS", "2")
+        # Fail every task of every attempt of the FIRST submission only.
+        with inject(f"barrier.attempt={BARRIER_MAX_ATTEMPTS}") as plan:
+            got = _gang_fit(stub_spark, x)
+        assert plan.invocations("barrier.attempt") > BARRIER_MAX_ATTEMPTS
+        assert got.tobytes() == _gang_fit(stub_spark, x).tobytes()
+
+    def test_degrades_to_driver_local_run(self, stub_spark, rng, monkeypatch):
+        x = rng.normal(size=(40, 4))
+        want = _gang_fit(stub_spark, x)
+        monkeypatch.setenv("TPUML_DEGRADE", "cpu")
+        with inject("barrier.attempt=always"):
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                got = _gang_fit(stub_spark, x)
+        assert got.tobytes() == want.tobytes()
+        degraded = [w for w in caught if isinstance(w.message, DegradationWarning)]
+        assert degraded and "barrier gang fit" in str(degraded[0].message)
+
+
+class TestDegradation:
+    """TPUML_DEGRADE=cpu: single-process fits finish on the CPU path with
+    a structured warning instead of raising."""
+
+    def test_ingest_degrades_to_cpu(self, data, monkeypatch):
+        monkeypatch.setenv("TPUML_DEGRADE", "cpu")
+        _, want = _FITS["kmeans_warm"](data)
+        with inject("ingest.device_put=always"):
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                _, got = _FITS["kmeans_warm"](data)
+        degraded = [w for w in caught if isinstance(w.message, DegradationWarning)]
+        assert degraded, "expected a structured DegradationWarning"
+        msg = degraded[0].message
+        assert msg.fallback == "the CPU path"
+        assert "ingest.device_put" in msg.why
+        # On the CPU test platform the fallback device IS the accelerator
+        # device, so the degraded fit is bit-identical.
+        assert got == want
+
+    def test_degrade_off_raises(self, data, monkeypatch):
+        monkeypatch.setenv("TPUML_DEGRADE", "off")
+        with inject("ingest.device_put=always"):
+            with pytest.raises(RetryExhaustedError):
+                _FITS["kmeans_warm"](data)
+
+    def test_malformed_mode_is_named(self, monkeypatch):
+        from spark_rapids_ml_tpu.robustness.degrade import degrade_mode
+
+        monkeypatch.setenv("TPUML_DEGRADE", "gpu")
+        with pytest.raises(EnvKnobError, match="TPUML_DEGRADE"):
+            degrade_mode()
+
+    def test_fatal_errors_never_degrade(self, data, monkeypatch):
+        """Wrong arguments are wrong on the CPU too: ValueError must
+        propagate untouched even in degrade mode."""
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        monkeypatch.setenv("TPUML_DEGRADE", "cpu")
+        with pytest.raises(ValueError, match="exceeds number of rows"):
+            KMeans().setK(10**6).fit(data)

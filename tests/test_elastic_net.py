@@ -138,10 +138,13 @@ class TestLogisticElasticNet:
             .fit((x, y))
         )
         # sklearn: min ||w||_1 + C sum logloss  <=>  ours with reg = 1/(C n).
-        # saga, not liblinear: liblinear penalizes the intercept.
+        # saga, not liblinear: liblinear penalizes the intercept. penalty
+        # must be EXPLICIT: without it sklearn keeps the default l2 and
+        # silently ignores l1_ratio — the oracle would be a different
+        # optimization problem.
         skl = linear_model.LogisticRegression(
-            l1_ratio=1.0, C=1.0 / (reg * n), solver="saga", tol=1e-12,
-            max_iter=100_000,
+            penalty="elasticnet", l1_ratio=1.0, C=1.0 / (reg * n),
+            solver="saga", tol=1e-12, max_iter=100_000,
         ).fit(x, y)
         np.testing.assert_allclose(
             model.coefficients, skl.coef_.ravel(), atol=1e-4

