@@ -32,6 +32,7 @@ CONFIGS = [
     ("config12_dbscan.py", {}),
     ("config13_umap.py", {}),
     ("config14_evaluators.py", {}),
+    ("config15_serving.py", {}),
 ]
 
 

@@ -84,7 +84,6 @@ def prepare_rows(
         model_axis_size,
         row_sharding,
         shard_rows_from_partitions,
-        weights_as_mask,
     )
 
     if is_device_array(rows):
@@ -118,9 +117,7 @@ def prepare_rows(
         else:
             mask = jnp.ones(n, dtype=m_dtype)
         if weights is not None:
-            mask = weights_as_mask(
-                np.asarray(weights), int(x.shape[0]), np.dtype(m_dtype), mesh
-            )
+            mask = _combine_weights(mask, weights, n, np.dtype(m_dtype), mesh)
         return PreparedRows(x, mask, n, d)
 
     np_dtype = np.dtype(dtype or default_dtype())
@@ -152,10 +149,26 @@ def prepare_rows(
         )
         mask = jnp.ones(n, dtype=m_dtype)
     if weights is not None:
-        mask = weights_as_mask(
-            np.asarray(weights), int(x.shape[0]), np.dtype(m_dtype), mesh
-        )
+        mask = _combine_weights(mask, weights, n, np.dtype(m_dtype), mesh)
     return PreparedRows(x, mask, n, d)
+
+
+def _combine_weights(mask, weights, n_true: int, m_dtype, mesh):
+    """User weightCol weights COMBINED with the padding-validity mask
+    (product), never substituted for it: the mask is what keeps padding
+    rows out of every reduction, so a weight vector must not be able to
+    hand a padded row nonzero weight — whatever length the caller passed.
+    """
+    from spark_rapids_ml_tpu.parallel.mesh import weights_as_mask
+
+    w_host = np.asarray(weights).ravel()
+    if w_host.shape[0] != n_true:
+        raise ValueError(
+            f"weight vector has {w_host.shape[0]} entries but the data has "
+            f"{n_true} rows"
+        )
+    w = weights_as_mask(w_host, int(mask.shape[0]), m_dtype, mesh)
+    return mask * w
 
 
 def matrix_like(x: Any, dtype=None):

@@ -1,0 +1,463 @@
+"""Serving-path program cache — compile-free, copy-minimal transform/predict.
+
+The reference's steady-state win is amortization: one native library is
+loaded per executor and reused across every Spark task (SURVEY.md §3.5).
+The JAX port's equivalent asset is a compiled XLA executable — but
+``jax.jit`` keys its cache on the EXACT input shape, so a serving workload
+whose batch sizes wander (every micro-batch from a request queue is a new
+row count) re-traces and re-compiles endlessly, and a transform called
+from host data re-ingests the batch synchronously before each program
+runs. "Large Scale Distributed Linear Algebra With TPUs" (arxiv
+2112.09017) shows TPU throughput lives or dies on keeping programs and
+buffers resident; "Memory Safe Computations with XLA" (arxiv 2206.14148)
+motivates bounding the executable working set explicitly rather than
+letting caches grow without limit. This module is the one home for both:
+
+  - **Shape buckets** (:func:`bucket_rows`): row counts round up to the
+    next power of two (features stay exact — they are model state, not
+    traffic), so arbitrary batch sizes hit a SMALL set of programs. Rows
+    are padded with zeros and sliced back off after the program runs;
+    every serving kernel is row-wise, so padding rows can never leak into
+    real outputs.
+  - **AOT executable cache** (:func:`serve_rows`): programs are built
+    with ``jit(fn).lower(specs).compile()`` and held in a module-global
+    LRU keyed on (kernel, static config, bucketed input spec, weight
+    specs, device set, donation) — model parameters enter at RUN time, so
+    two models with identical shapes share one program. The LRU is
+    bounded by ``TPUML_SERVING_CACHE_SIZE`` (default 32 programs) and its
+    hit/miss/evict/compile totals are published through
+    ``utils.tracing`` counters (``serving.cache.*`` / ``serving.compile``)
+    so tests can assert "compiles == buckets, not calls".
+  - **Buffer donation**: when the padded scratch input is a buffer this
+    layer created (a host ingest or a device-side pad), it is donated to
+    the executable (``donate_argnums``) so XLA may reuse its bytes for
+    outputs/temporaries — steady-state serving then allocates nothing new
+    on device. Caller-owned arrays are NEVER donated (the caller may
+    reuse them); backends that cannot honor a donation just ignore it
+    (counted under ``serving.donate.unusable``).
+  - **Double-buffered streaming** (:func:`serve_stream`): for
+    host-resident block sources, the H2D ``device_put`` of block k+1 is
+    issued while the program for block k is still running (dispatch is
+    async), overlapping transfer with compute.
+  - **Persistent compilation cache** (:func:`configure_compile_cache`):
+    ``TPUML_COMPILE_CACHE_DIR`` wires ``jax_compilation_cache_dir`` so a
+    process restart replays compiles from disk instead of paying them
+    cold. Guarded OFF on the CPU backend by default — XLA:CPU's
+    executable (de)serialization has crashed mid-suite on this jaxlib
+    (see tests/conftest.py); ``TPUML_COMPILE_CACHE_FORCE=1`` overrides.
+
+Residence contract (mirrors the model families'): host batches in, host
+results out; device batches in, device results out. Multi-device (mesh-
+sharded) inputs are served at their exact shape with their sharding baked
+into the program key — padding a live sharded array would reshard it
+under the caller — so they amortize compiles across repeated same-shape
+calls but do not bucket.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.utils.envknobs import env_choice, env_int, env_str
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
+
+#: Smallest row bucket — tiny interactive batches (a single scored row, a
+#: 3-row unit test) all share one program instead of one each.
+MIN_ROW_BUCKET = 8
+
+#: Default bound on the AOT program LRU (``TPUML_SERVING_CACHE_SIZE``).
+DEFAULT_CACHE_SIZE = 32
+
+
+def bucket_rows(n: int, min_bucket: int = MIN_ROW_BUCKET) -> int:
+    """The pow-2 row bucket ``n`` pads into (features are never bucketed)."""
+    if n <= 0:
+        raise ValueError(f"batch must have at least one row, got {n}")
+    if n <= min_bucket:
+        return min_bucket
+    return 1 << (n - 1).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# Persistent XLA compilation cache (process-restart warm starts)
+# ---------------------------------------------------------------------------
+
+_cache_lock = threading.Lock()
+_cache_wired: Optional[str] = None
+_cache_checked = False
+
+
+def configure_compile_cache(path: Optional[str] = None, *, force: bool = False):
+    """Wire jax's persistent compilation cache to ``path`` (or the
+    ``TPUML_COMPILE_CACHE_DIR`` knob). Idempotent; returns the active
+    directory or None.
+
+    CPU guard: XLA:CPU's AOT (de)serializer has SIGABRT/SIGSEGVed on this
+    jaxlib when replaying or writing cache entries (tests/conftest.py
+    documents both crashes), so on the ``cpu`` backend the knob is
+    ignored unless forced (``force=True`` / ``TPUML_COMPILE_CACHE_FORCE=1``).
+    """
+    global _cache_wired, _cache_checked
+    with _cache_lock:
+        if _cache_checked and path is None:
+            return _cache_wired
+        _cache_checked = True
+        path = path or env_str("TPUML_COMPILE_CACHE_DIR")
+        if not path or path == _cache_wired:
+            return _cache_wired
+        import jax
+
+        force = force or env_choice(
+            "TPUML_COMPILE_CACHE_FORCE", ("0", "1"), "0"
+        ) == "1"
+        if jax.default_backend() == "cpu" and not force:
+            return _cache_wired
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Serving programs are small and compile fast — cache them all,
+        # not just the slow ones jax's defaults keep.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _cache_wired = path
+        return _cache_wired
+
+
+def _reset_compile_cache_wiring_for_tests() -> None:
+    global _cache_wired, _cache_checked
+    with _cache_lock:
+        _cache_wired = None
+        _cache_checked = False
+
+
+# ---------------------------------------------------------------------------
+# AOT program cache
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.RLock()
+_PROGRAMS: "OrderedDict[tuple, Any]" = OrderedDict()
+_STATS = {"hits": 0, "misses": 0, "evictions": 0, "compiles": 0}
+
+
+def _capacity() -> int:
+    return env_int("TPUML_SERVING_CACHE_SIZE", DEFAULT_CACHE_SIZE, minimum=1)
+
+
+def _donation_enabled() -> bool:
+    return env_choice("TPUML_SERVING_DONATE", ("on", "off"), "on") == "on"
+
+
+def program_cache_stats() -> dict:
+    """Snapshot: {hits, misses, evictions, compiles, size, capacity}."""
+    with _LOCK:
+        out = dict(_STATS)
+        out["size"] = len(_PROGRAMS)
+        out["capacity"] = _capacity()
+        return out
+
+
+def clear_program_cache() -> None:
+    """Drop every cached executable and zero the stats (tests, reconfigs)."""
+    with _LOCK:
+        _PROGRAMS.clear()
+        _JIT_FALLBACKS.clear()
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _spec_key(spec) -> tuple:
+    sharding = getattr(spec, "sharding", None)
+    return (tuple(spec.shape), str(spec.dtype), sharding)
+
+
+def _args_specs_and_key(args: tuple):
+    """ShapeDtypeStruct pytree + hashable key for the weight arguments."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    specs = [jax.ShapeDtypeStruct(np.shape(a), a.dtype) for a in leaves]
+    key = (treedef, tuple(_spec_key(s) for s in specs))
+    return jax.tree_util.tree_unflatten(treedef, specs), key
+
+
+def _get_program(fn: Callable, x_spec, args: tuple, static: dict, donate: bool):
+    """The cached AOT executable for (fn, static, specs, donation)."""
+    import jax
+
+    arg_specs, args_key = _args_specs_and_key(args)
+    key = (
+        fn,
+        tuple(sorted(static.items())),
+        _spec_key(x_spec),
+        args_key,
+        donate,
+    )
+    with _LOCK:
+        exe = _PROGRAMS.get(key)
+        if exe is not None:
+            _PROGRAMS.move_to_end(key)
+            _STATS["hits"] += 1
+            bump_counter("serving.cache.hit")
+            return exe
+        _STATS["misses"] += 1
+        bump_counter("serving.cache.miss")
+
+    jitted = jax.jit(
+        fn,
+        static_argnames=tuple(static) or None,
+        donate_argnums=(0,) if donate else (),
+    )
+    with TraceRange("serving compile", TraceColor.YELLOW):
+        with warnings.catch_warnings(record=True) as caught:
+            # A donated scratch whose bytes no output can alias is a
+            # no-op, not an error — drop jax's warning, keep a counter.
+            warnings.simplefilter("always")
+            exe = jitted.lower(x_spec, *arg_specs, **static).compile()
+        for w in caught:
+            if "donated buffers" in str(w.message):
+                bump_counter("serving.donate.unusable")
+            else:  # pragma: no cover - foreign warnings pass through
+                warnings.warn_explicit(
+                    w.message, w.category, w.filename, w.lineno
+                )
+    with _LOCK:
+        _STATS["compiles"] += 1
+        bump_counter("serving.compile")
+        if key not in _PROGRAMS:
+            _PROGRAMS[key] = exe
+            while len(_PROGRAMS) > _capacity():
+                _PROGRAMS.popitem(last=False)
+                _STATS["evictions"] += 1
+                bump_counter("serving.cache.evict")
+        return _PROGRAMS[key]
+
+
+# ---------------------------------------------------------------------------
+# serve_rows — the bucketed single-batch entry
+# ---------------------------------------------------------------------------
+
+
+def _compute_dtype(host_dtype):
+    """Host batches keep their floating dtype (canonicalized: f64 becomes
+    f32 when x64 is off — same coercion ``jnp.asarray`` applies);
+    non-float sources take the estimators' compute dtype."""
+    import jax
+
+    from spark_rapids_ml_tpu.core.ingest import default_dtype
+
+    if np.issubdtype(host_dtype, np.floating):
+        return jax.dtypes.canonicalize_dtype(host_dtype)
+    return np.dtype(default_dtype())
+
+
+def _slice_outputs(outs, bucket: int, n: int, to_host: bool):
+    """Strip padding rows from every output that carries them. Host-bound
+    results convert FIRST and slice in numpy — a device-side slice would
+    compile one tiny program per distinct ``n`` and defeat the
+    compiles == buckets contract for host callers."""
+    import jax
+
+    def one(leaf):
+        if to_host:
+            leaf = np.asarray(leaf)
+        if n != bucket and np.ndim(leaf) >= 1 and np.shape(leaf)[0] == bucket:
+            return leaf[:n]
+        return leaf
+
+    return jax.tree_util.tree_map(one, outs)
+
+
+def _is_multi_device(x) -> bool:
+    try:
+        return len(x.sharding.device_set) > 1
+    except AttributeError:  # pragma: no cover - non-sharded array types
+        return False
+
+
+def _any_multi_device(tree) -> bool:
+    import jax
+
+    return any(
+        _is_multi_device(leaf)
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "sharding")
+    )
+
+
+def _jit_fallback(fn: Callable, static: dict):
+    """A cached plain-jit twin of ``fn`` for mesh-sharded operands: jit
+    adapts to live shardings (GSPMD) and moves uncommitted inputs, which
+    strict AOT executables refuse; its own cache still amortizes compiles
+    across repeated exact shapes. One wrapper per (fn, static) so the
+    jit cache accumulates instead of being thrown away per call."""
+    import jax
+
+    key = (fn, tuple(sorted(static.items())))
+    with _LOCK:
+        jitted = _JIT_FALLBACKS.get(key)
+        if jitted is None:
+            jitted = jax.jit(fn, static_argnames=tuple(static) or None)
+            _JIT_FALLBACKS[key] = jitted
+        return jitted
+
+
+_JIT_FALLBACKS: Dict[tuple, Any] = {}
+
+
+def serve_rows(
+    fn: Callable,
+    x: Any,
+    args: tuple = (),
+    *,
+    name: str,
+    static: Optional[dict] = None,
+    donate: Optional[bool] = None,
+    to_host: Optional[bool] = None,
+):
+    """Run the row-wise kernel ``fn(x, *args, **static)`` through the
+    shape-bucketed AOT program cache.
+
+    ``x`` may be a host array (padded into a fresh host scratch, placed
+    once, result pulled back) or a ``jax.Array`` (padded on device when
+    the bucket requires it; result stays on device). ``args`` are the
+    model's weight arrays (any pytree) — pass DEVICE-RESIDENT weights so
+    repeated calls don't re-upload them. ``static`` entries become
+    ``static_argnames`` and part of the program key. Outputs whose
+    leading axis is the bucket are sliced back to the true row count.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.core.data import is_device_array
+
+    static = dict(static or {})
+    configure_compile_cache()
+    device_in = is_device_array(x)
+    if to_host is None:
+        to_host = not device_in
+
+    if (device_in and _is_multi_device(x)) or _any_multi_device(args):
+        # Mesh-sharded batch or weights: cached plain-jit path — padding
+        # would reshard the operands under the caller, and strict AOT
+        # executables reject live shardings they were not compiled for.
+        # jax's own jit cache still amortizes compiles per exact shape.
+        bump_counter("serving.fallback")
+        with TraceRange(f"serve {name}", TraceColor.GREEN):
+            outs = _jit_fallback(fn, static)(x, *args, **static)
+        n = int(np.shape(x)[0])
+        return _slice_outputs(outs, n, n, to_host)
+
+    if device_in:
+        if x.ndim == 1:
+            x = x[None, :]
+        n, d = int(x.shape[0]), int(x.shape[1])
+        bucket = bucket_rows(n)
+        if bucket == n:
+            x_pad, owned = x, False
+        else:
+            # Device-side pad: a small per-exact-shape program, amortized
+            # the first time each row count appears; the bucket program —
+            # the expensive one — is shared.
+            x_pad, owned = jnp.pad(x, ((0, bucket - n), (0, 0))), True
+        dtype = x.dtype
+    else:
+        x_host = np.asarray(x)
+        if x_host.ndim == 1:
+            x_host = x_host[None, :]
+        if x_host.ndim != 2:
+            raise ValueError(f"serving input must be 2-D, got {x_host.ndim}-D")
+        n, d = x_host.shape
+        bucket = bucket_rows(n)
+        dtype = _compute_dtype(x_host.dtype)
+        # A FRESH padded scratch per call: jax may alias (zero-copy) a
+        # numpy buffer on the CPU backend and H2D transfers may read it
+        # asynchronously, so a reused scratch could be mutated under a
+        # live array.
+        pad_host = np.zeros((bucket, d), dtype=dtype)
+        pad_host[:n] = x_host
+        with TraceRange(f"serve {name} H2D", TraceColor.CYAN):
+            x_pad = jax.device_put(pad_host)
+        owned = True
+
+    use_donate = (_donation_enabled() if donate is None else donate) and owned
+    spec = jax.ShapeDtypeStruct((bucket, d), dtype)
+    exe = _get_program(fn, spec, args, static, donate=use_donate)
+    with TraceRange(f"serve {name}", TraceColor.GREEN):
+        outs = exe(x_pad, *args)
+    return _slice_outputs(outs, bucket, n, to_host)
+
+
+# ---------------------------------------------------------------------------
+# serve_stream — double-buffered host->device streaming
+# ---------------------------------------------------------------------------
+
+
+def serve_stream(
+    fn: Callable,
+    blocks: Iterable[Any],
+    args: tuple = (),
+    *,
+    name: str,
+    static: Optional[dict] = None,
+    dtype: Any = None,
+) -> Iterator[Any]:
+    """Stream host blocks through the bucketed program cache, yielding one
+    HOST result per non-empty block.
+
+    Double-buffering: block k's program is dispatched (async), block k+1
+    is padded and ``device_put`` while it runs, and only THEN is block
+    k's result pulled — the H2D copy of the next block overlaps the
+    compute of the current one, the streaming discipline arxiv 2112.09017
+    uses to keep the MXU fed from host-resident operands.
+
+    ``dtype`` pins the compute dtype across blocks (pass the model's
+    weight dtype) so a mixed-dtype source cannot fan out into one program
+    per block dtype.
+    """
+    import jax
+
+    static = dict(static or {})
+    configure_compile_cache()
+    fallback = _jit_fallback(fn, static) if _any_multi_device(args) else None
+    pending: Optional[tuple] = None  # (outs, bucket, n)
+
+    for blk in blocks:
+        x_host = np.asarray(blk)
+        if x_host.ndim == 1:
+            x_host = x_host[None, :]
+        if x_host.size == 0:
+            continue
+        n, d = x_host.shape
+        bucket = bucket_rows(n)
+        blk_dtype = np.dtype(dtype) if dtype is not None else _compute_dtype(x_host.dtype)
+        pad_host = np.zeros((bucket, d), dtype=blk_dtype)
+        pad_host[:n] = x_host
+        with TraceRange(f"serve {name} H2D", TraceColor.CYAN):
+            x_pad = jax.device_put(pad_host)
+        with TraceRange(f"serve {name}", TraceColor.GREEN):
+            if fallback is not None:  # mesh-sharded weights (see serve_rows)
+                bump_counter("serving.fallback")
+                outs = fallback(x_pad, *args, **static)
+            else:
+                exe = _get_program(
+                    fn,
+                    jax.ShapeDtypeStruct((bucket, d), blk_dtype),
+                    args,
+                    static,
+                    donate=_donation_enabled(),
+                )
+                outs = exe(x_pad, *args)  # async dispatch
+        bump_counter("serving.stream.blocks")
+        if pending is not None:
+            # Sync the PREVIOUS block only after this block's transfer
+            # and dispatch are in flight.
+            yield _slice_outputs(pending[0], pending[1], pending[2], True)
+        pending = (outs, bucket, n)
+
+    if pending is not None:
+        yield _slice_outputs(pending[0], pending[1], pending[2], True)

@@ -46,8 +46,22 @@ from spark_rapids_ml_tpu.ops.kmeans import (
     normalize_rows,
     random_init,
 )
+from spark_rapids_ml_tpu.core.serving import serve_rows
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def _assign_kernel(x, centers, *, cosine: bool):
+    """Serving kernel: nearest-center labels. Centers follow the batch
+    dtype (the model-side cast fuses into the distance GEMM); zero padding
+    rows normalize to NaN under cosine but assignments are row-wise, so
+    they never reach a real row's label."""
+    centers = centers.astype(x.dtype)
+    if cosine:
+        x = normalize_rows(x)
+        centers = normalize_rows(centers)
+    labels, _ = assign_clusters(x, centers)
+    return labels
 
 
 class _KMeansParams(Params):
@@ -130,6 +144,10 @@ class _KMeansParams(Params):
 
 class KMeans(_KMeansParams, Estimator, MLReadable):
     """``KMeans().setK(8).fit(x)`` — Lloyd on the MXU."""
+
+    # Consumes device arrays in place (prepare_rows), so tuning loops may
+    # feed device-resident fold slices (tuning._device_fold_prep).
+    _device_foldable = True
 
     def __init__(self, uid: Optional[str] = None, mesh=None):
         super().__init__(uid)
@@ -455,6 +473,7 @@ class KMeansModel(_KMeansParams, Model, LazyHostState):
     materializes host state (core/lazy_state.LazyHostState)."""
 
     _lazy_host_fields = {"_centers_raw": ("_centers_np", np.float64)}
+    _pickle_clear = ("_centers_dev",)
 
     def __init__(
         self,
@@ -466,6 +485,7 @@ class KMeansModel(_KMeansParams, Model, LazyHostState):
         super().__init__(uid)
         self._centers_raw = clusterCenters
         self._centers_np: Optional[np.ndarray] = None
+        self._centers_dev = None
         self._cost_raw = trainingCost
         self._iter_raw = numIter
 
@@ -515,17 +535,28 @@ class KMeansModel(_KMeansParams, Model, LazyHostState):
     def predict(self, x) -> np.ndarray:
         if self._centers_raw is None:
             raise RuntimeError("model has no cluster centers")
-        device_in = is_device_array(x)
         x = matrix_like(x)
-        xj = x if device_in else jnp.asarray(x)
-        centers = self._centers_device(xj.dtype)
-        if self.getDistanceMeasure() == "cosine":
-            xj = normalize_rows(xj)
-            centers = normalize_rows(centers)
-        labels, _ = assign_clusters(xj, centers)
         # Device queries get device labels (no host pull the caller didn't
-        # ask for); host queries keep the numpy contract.
-        return labels if device_in else np.asarray(labels)
+        # ask for); host queries keep the numpy contract. Both run through
+        # the shape-bucketed serving program cache.
+        return serve_rows(
+            _assign_kernel,
+            x,
+            (self._centers_serving(),),
+            static={"cosine": self.getDistanceMeasure() == "cosine"},
+            name="kmeans.predict",
+        )
+
+    def _centers_serving(self):
+        """Centers as ONE device-resident array reused by every predict —
+        the kernel's in-program cast to the batch dtype makes a single
+        copy serve all batch dtypes."""
+        raw = self._centers_raw
+        if is_device_array(raw):
+            return raw
+        if self._centers_dev is None:
+            self._centers_dev = jnp.asarray(self._centers)
+        return self._centers_dev
 
     def transform(self, dataset: Any) -> Any:
         rows = _extract_features(dataset, self.getFeaturesCol())

@@ -44,7 +44,16 @@ from spark_rapids_ml_tpu.ops.linear import (
     solve_normal,
     solve_normal_host,
 )
+from spark_rapids_ml_tpu.core.serving import serve_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def _predict_kernel(x, coef, intercept):
+    """Serving kernel: X·coef + b. Coefficients follow the batch dtype
+    (the model-side convention; the cast fuses into the GEMM)."""
+    return predict_linear(
+        x, coef.astype(x.dtype), intercept.astype(x.dtype)
+    )
 
 
 class _LinearRegressionParams(Params):
@@ -121,6 +130,10 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
     ``LinearRegression().setRegParam(0.1).fit((X, y))`` — input is
     ``(X, y)``, a DataFrame shim / pandas frame with features+label columns.
     """
+
+    # Consumes device (X, y) pairs in place, so tuning loops may feed
+    # device-resident fold slices (tuning._device_fold_prep).
+    _device_foldable = True
 
     def __init__(self, uid: Optional[str] = None, mesh=None):
         super().__init__(uid)
@@ -464,6 +477,7 @@ class LinearRegressionModel(_LinearRegressionParams, Model, LazyHostState):
     materializes host state (core/lazy_state.LazyHostState)."""
 
     _lazy_host_fields = {"_coef_raw": ("_coef_np", np.float64)}
+    _pickle_clear = ("_coef_dev",)
 
     def __init__(
         self,
@@ -474,6 +488,7 @@ class LinearRegressionModel(_LinearRegressionParams, Model, LazyHostState):
         super().__init__(uid)
         self._coef_raw = coefficients
         self._coef_np: Optional[np.ndarray] = None
+        self._coef_dev = None
         self._intercept_raw = intercept
 
     def __getstate__(self):
@@ -499,14 +514,26 @@ class LinearRegressionModel(_LinearRegressionParams, Model, LazyHostState):
     def predict(self, x) -> np.ndarray:
         if self._coef_raw is None:
             raise RuntimeError("model has no coefficients")
-        device_in = is_device_array(x)
-        xj = matrix_like(x)
-        if not device_in:
-            xj = jnp.asarray(xj)
-        coef = self._coef_raw if is_device_array(self._coef_raw) else jnp.asarray(self.coefficients)
-        out = predict_linear(xj, coef.astype(xj.dtype), jnp.asarray(self._intercept_raw, dtype=xj.dtype))
         # Device queries get device predictions; host queries keep numpy.
-        return out if device_in else np.asarray(out)
+        # Both run through the shape-bucketed serving program cache.
+        return serve_rows(
+            _predict_kernel,
+            matrix_like(x),
+            self._coef_serving(),
+            name="linreg.predict",
+        )
+
+    def _coef_serving(self):
+        """(coefficients, intercept) as ONE device-resident pair reused by
+        every predict call."""
+        if self._coef_dev is None:
+            coef = (
+                self._coef_raw
+                if is_device_array(self._coef_raw)
+                else jnp.asarray(self.coefficients)
+            )
+            self._coef_dev = (coef, jnp.asarray(self._intercept_raw))
+        return self._coef_dev
 
     def transform(self, dataset: Any) -> Any:
         if isinstance(dataset, tuple):

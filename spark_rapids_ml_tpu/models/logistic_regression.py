@@ -53,7 +53,20 @@ from spark_rapids_ml_tpu.ops.logistic import (
     fit_logistic_elastic_net,
     predict_logistic,
 )
+from spark_rapids_ml_tpu.core.serving import serve_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def _forward_kernel(x, w, b, *, n_classes: int, threshold: float):
+    """Serving kernel: one forward pass -> (labels, probs, raw logits).
+    The batch follows the weights' dtype (the fitted precision is the
+    numerics contract; the cast fuses into the logits GEMM)."""
+    labels, probs, raw = predict_logistic(
+        x.astype(w.dtype), w, b, n_classes=n_classes
+    )
+    if w.shape[1] == 1 and threshold != 0.5:
+        labels = (probs[:, 1] > threshold).astype(jnp.int32)
+    return labels, probs, raw
 
 
 class _LogisticRegressionParams(Params):
@@ -141,6 +154,10 @@ class _LogisticRegressionParams(Params):
 
 class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
     """``LogisticRegression().setRegParam(0.1).fit((X, y))``."""
+
+    # Consumes device (X, y) pairs in place, so tuning loops may feed
+    # device-resident fold slices (tuning._device_fold_prep).
+    _device_foldable = True
 
     def __init__(self, uid: Optional[str] = None, mesh=None):
         super().__init__(uid)
@@ -413,6 +430,7 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
         "_w_raw": ("_w_np", np.float64),
         "_b_raw": ("_b_np", np.float64),
     }
+    _pickle_clear = ("_wb_dev",)
 
     def __init__(
         self,
@@ -427,6 +445,7 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
         self._b_raw = intercepts
         self._w_np: Optional[np.ndarray] = None
         self._b_np: Optional[np.ndarray] = None
+        self._wb_dev = None
         self.numClasses = numClasses
         self._iter_raw = numIter
 
@@ -516,24 +535,31 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
         return raw
 
     def _predict_all(self, x):
-        """One forward pass; binomial labels honor the threshold param.
-        Device queries keep everything on device; host queries keep the
-        numpy contract."""
-        device_in = is_device_array(x)
-        xj = matrix_like(x)
-        w = self._w_raw if is_device_array(self._w_raw) else jnp.asarray(self.weights)
-        b = self._b_raw if is_device_array(self._b_raw) else jnp.asarray(self.intercepts)
-        labels, probs, raw = predict_logistic(
-            jnp.asarray(xj, dtype=w.dtype) if not device_in else xj.astype(w.dtype),
-            w,
-            b.astype(w.dtype),
-            n_classes=self.numClasses,
+        """One forward pass through the shape-bucketed serving program
+        cache; binomial labels honor the threshold param (applied INSIDE
+        the program so a threshold change is a new program, not a per-call
+        epilogue). Device queries keep everything on device; host queries
+        keep the numpy contract."""
+        w, b = self._wb_serving()
+        return serve_rows(
+            _forward_kernel,
+            matrix_like(x),
+            (w, b),
+            static={
+                "n_classes": self.numClasses,
+                "threshold": float(self.getThreshold()),
+            },
+            name="logreg.predict",
         )
-        if w.shape[1] == 1 and self.getThreshold() != 0.5:
-            labels = (probs[:, 1] > self.getThreshold()).astype(jnp.int32)
-        if device_in:
-            return labels, probs, raw
-        return np.asarray(labels), np.asarray(probs), np.asarray(raw)
+
+    def _wb_serving(self):
+        """Weights/intercepts as ONE device-resident pair reused across
+        predict calls (device-resident fits already hold them there)."""
+        if self._wb_dev is None:
+            w = self._w_raw if is_device_array(self._w_raw) else jnp.asarray(self.weights)
+            b = self._b_raw if is_device_array(self._b_raw) else jnp.asarray(self.intercepts)
+            self._wb_dev = (w, b.astype(w.dtype))
+        return self._wb_dev
 
     def transform(self, dataset: Any) -> Any:
         if isinstance(dataset, DataFrame):

@@ -39,9 +39,17 @@ from spark_rapids_ml_tpu.core.persistence import (
     save_data,
     save_metadata,
 )
+from spark_rapids_ml_tpu.core.serving import serve_rows, serve_stream
 from spark_rapids_ml_tpu.linalg.row_matrix import RowMatrix
-from spark_rapids_ml_tpu.ops.linalg import gemm_project
+from spark_rapids_ml_tpu.ops.linalg import project_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def _project_kernel(x, pc, *, precision: str = "highest"):
+    """Serving kernel: rows onto the principal subspace. The cast follows
+    the device-transform convention (components follow the batch dtype)
+    and fuses into the projection GEMM."""
+    return project_rows(x, pc.astype(x.dtype), precision=precision)
 
 
 class _PCAParams(HasInputCol, HasOutputCol):
@@ -125,6 +133,10 @@ class _PCAParams(HasInputCol, HasOutputCol):
 
 class PCA(_PCAParams, Estimator, MLReadable):
     """PCA estimator. ``PCA().setK(3).setInputCol("features").fit(df)``."""
+
+    # Consumes device arrays in place, so tuning loops may feed
+    # device-resident fold slices (tuning._device_fold_prep).
+    _device_foldable = True
 
     def __init__(self, uid: Optional[str] = None, mesh=None):
         super().__init__(uid)
@@ -530,45 +542,58 @@ class PCAModel(_PCAParams, Model, LazyHostState):
         )
 
         if is_device_array(rows):
-            # Device-resident projection: X·pc as one jitted MXU matmul,
-            # result stays on device (the symmetric counterpart of the
-            # device-resident fit; the batched path the reference disabled,
-            # RapidsPCA.scala:172-185).
-            from spark_rapids_ml_tpu.ops.linalg import project_rows
-
+            # Device-resident projection through the serving program cache:
+            # one AOT MXU matmul per (bucket, dtype), result stays on device
+            # (the symmetric counterpart of the device-resident fit; the
+            # batched path the reference disabled, RapidsPCA.scala:172-185).
             with TraceRange("device transform", TraceColor.GREEN):
-                return project_rows(rows, self._pc_device(rows.dtype))
+                return serve_rows(
+                    _project_kernel,
+                    rows,
+                    (self._pc_device(rows.dtype),),
+                    name="pca.transform",
+                )
 
+        pc_dev = self._pc_device(self._serving_dtype())
         if is_streaming_source(rows):
             # Streaming in, streaming out: project block by block at
-            # constant memory (the symmetric counterpart of streaming fit).
-            pc = self.pc
+            # constant memory (the symmetric counterpart of streaming fit),
+            # double-buffered — block k+1's H2D overlaps block k's GEMM.
+            from spark_rapids_ml_tpu.core.data import _block_to_dense
 
-            def projected_blocks():
-                from spark_rapids_ml_tpu.core.data import _block_to_dense
+            def dense_blocks():
+                for blk in iter_stream_blocks(rows):
+                    part = _block_to_dense(blk)
+                    if part.shape[0] == 0:
+                        # Empty partitions densify to (0, 0) — skip
+                        # rather than matmul a widthless block.
+                        continue
+                    yield part
 
-                with TraceRange("stream transform", TraceColor.GREEN):
-                    for blk in iter_stream_blocks(rows):
-                        part = _block_to_dense(blk)
-                        if part.shape[0] == 0:
-                            # Empty partitions densify to (0, 0) — skip
-                            # rather than matmul a widthless block.
-                            continue
-                        out = gemm_project(
-                            part.T.astype(pc.dtype, copy=False), pc
-                        )
-                        yield np.asarray(out)
-
-            return projected_blocks()
+            with TraceRange("stream transform", TraceColor.GREEN):
+                return serve_stream(
+                    _project_kernel,
+                    dense_blocks(),
+                    (pc_dev,),
+                    name="pca.transform",
+                    dtype=pc_dev.dtype,
+                )
         parts = as_partitions(rows)
-        dtype = self.pc.dtype
-        outs = []
         with TraceRange("batch transform", TraceColor.GREEN):
-            for part in parts:
-                # gemm_project computes AᵀB; A = partᵀ gives X·pc = (rows, k).
-                out = gemm_project(part.T.astype(dtype, copy=False), self.pc)
-                outs.append(np.asarray(out))
-        projected = np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+            outs = list(
+                serve_stream(
+                    _project_kernel,
+                    parts,
+                    (pc_dev,),
+                    name="pca.transform",
+                    dtype=pc_dev.dtype,
+                )
+            )
+        if not outs:
+            # All partitions empty: keep the (0, k) ndarray contract.
+            projected = np.zeros((0, self.pc.shape[1]), dtype=self.pc.dtype)
+        else:
+            projected = np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
         if isinstance(dataset, DataFrame):
             return dataset.withColumn(self.getOutputCol(), list(projected))
         try:
@@ -591,6 +616,14 @@ class PCAModel(_PCAParams, Model, LazyHostState):
         if key not in self._pc_dev_cache:
             self._pc_dev_cache[key] = jnp.asarray(self._pc_raw).astype(dtype)
         return self._pc_dev_cache[key]
+
+    def _serving_dtype(self):
+        """Compute dtype for host-batch serving: the components' own dtype,
+        canonicalized (f64 under x64, f32 otherwise) — one program set per
+        model, however the batch dtypes wander."""
+        import jax
+
+        return jax.dtypes.canonicalize_dtype(self.pc.dtype)
 
     # --- persistence (RapidsPCA.scala:207-255) ---
 

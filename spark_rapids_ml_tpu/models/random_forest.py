@@ -50,7 +50,27 @@ from spark_rapids_ml_tpu.ops.trees import (
     quantize_features,
     sample_weights,
 )
+from spark_rapids_ml_tpu.core.serving import serve_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def _proba_kernel(x, forest, *, depth: int):
+    """Serving kernel: (n, C) mean leaf class distributions. Trees route
+    in float32 (the forests' training dtype)."""
+    return forest_predict_proba(x.astype(jnp.float32), forest, depth)
+
+
+def _reg_kernel(x, forest, *, depth: int):
+    """Serving kernel: (n,) mean leaf values."""
+    return forest_predict_reg(x.astype(jnp.float32), forest, depth)
+
+
+def _forest_device(model):
+    """The model's forest as ONE device-resident pytree reused by every
+    predict call (host pickles drop it; it rebuilds lazily)."""
+    if model._forest_dev is None:
+        model._forest_dev = jax.tree_util.tree_map(jnp.asarray, model._forest)
+    return model._forest_dev
 
 
 def resolve_feature_subset(strategy: str, d: int, n_trees: int, classification: bool) -> int:
@@ -333,6 +353,10 @@ def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarra
 class RandomForestClassifier(_RandomForestParams, Estimator, MLReadable):
     """``RandomForestClassifier().setNumTrees(20).fit((X, y))``."""
 
+    # Consumes device (X, y) pairs in place, so tuning loops may feed
+    # device-resident fold slices (tuning._device_fold_prep).
+    _device_foldable = True
+
     probabilityCol = Param("_", "probabilityCol", "probability column name", toString)
     rawPredictionCol = Param(
         "_", "rawPredictionCol", "raw prediction column name", toString
@@ -447,8 +471,16 @@ class RandomForestClassificationModel(_RandomForestParams, Model):
             rawPredictionCol="rawPrediction",
         )
         self._forest = forest
+        self._forest_dev = None
         self.numFeatures = numFeatures
         self.numClasses = numClasses
+
+    def __getstate__(self):
+        # Broadcast/pickle ships host forest arrays, never live device
+        # buffers; the serving copy rebuilds lazily after load.
+        state = dict(self.__dict__)
+        state["_forest_dev"] = None
+        return state
 
     def getProbabilityCol(self) -> str:
         return self.getOrDefault(self.probabilityCol)
@@ -466,14 +498,15 @@ class RandomForestClassificationModel(_RandomForestParams, Model):
         return int(np.sum((feat >= 0) | (leaf & (w > 0))))
 
     def predictProbability(self, x) -> np.ndarray:
-        device_in = is_device_array(x)
-        x = matrix_like(x)
-        probs = forest_predict_proba(
-            jnp.asarray(x, dtype=jnp.float32) if not device_in else x.astype(jnp.float32),
-            self._forest,
-            _forest_depth(self._forest),
+        # Shape-bucketed serving path: one AOT tree-routing program per
+        # row bucket, forest resident on device across calls.
+        return serve_rows(
+            _proba_kernel,
+            matrix_like(x),
+            (_forest_device(self),),
+            static={"depth": _forest_depth(self._forest)},
+            name="rf.predictProbability",
         )
-        return probs if device_in else np.asarray(probs)
 
     def predict(self, x) -> np.ndarray:
         probs = self.predictProbability(x)
@@ -533,6 +566,10 @@ class RandomForestClassificationModel(_RandomForestParams, Model):
 
 class RandomForestRegressor(_RandomForestParams, Estimator, MLReadable):
     """``RandomForestRegressor().setNumTrees(20).fit((X, y))``."""
+
+    # Consumes device (X, y) pairs in place, so tuning loops may feed
+    # device-resident fold slices (tuning._device_fold_prep).
+    _device_foldable = True
 
     def __init__(self, uid: Optional[str] = None, mesh=None):
         super().__init__(uid)
@@ -595,21 +632,26 @@ class RandomForestRegressionModel(_RandomForestParams, Model):
         super().__init__(uid)
         self._setDefault(impurity="variance")
         self._forest = forest
+        self._forest_dev = None
         self.numFeatures = numFeatures
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_forest_dev"] = None
+        return state
 
     @property
     def featureImportances(self) -> np.ndarray:
         return feature_importances(self._forest, self.numFeatures)
 
     def predict(self, x) -> np.ndarray:
-        device_in = is_device_array(x)
-        x = matrix_like(x)
-        out = forest_predict_reg(
-            jnp.asarray(x, dtype=jnp.float32) if not device_in else x.astype(jnp.float32),
-            self._forest,
-            _forest_depth(self._forest),
+        return serve_rows(
+            _reg_kernel,
+            matrix_like(x),
+            (_forest_device(self),),
+            static={"depth": _forest_depth(self._forest)},
+            name="rf.predict",
         )
-        return out if device_in else np.asarray(out)
 
     def transform(self, dataset: Any) -> Any:
         rows = extract_features(dataset, self.getFeaturesCol(), drop=self.getLabelCol())

@@ -67,6 +67,77 @@ class ParamGridBuilder:
         return maps
 
 
+class _DeviceFolds:
+    """Tuning data placed on device ONCE for the whole grid search.
+
+    The host loop used to re-slice the host dataset per fold and let every
+    ``estimator.copy(pm).fit(train)`` re-ingest (``device_put``) its own
+    copy — param grid × folds H2D transfers of the same rows. Here the
+    full dataset is placed once, each fold's device-resident train/val
+    slices are built once (a device gather), and every param-map fit
+    consumes them in place through the families' device-input funnel
+    (``core.ingest.prepare_rows``), which also derives the row-validity
+    mask on device. Same values, same fold assignment — only the copies
+    are gone.
+    """
+
+    def __init__(self, x, y=None):
+        self.x = x
+        self.y = y
+
+    def slice(self, idx: np.ndarray):
+        import jax.numpy as jnp
+
+        ii = jnp.asarray(np.asarray(idx, dtype=np.int64))
+        xs = jnp.take(self.x, ii, axis=0)
+        if self.y is None:
+            return xs
+        return (xs, jnp.take(self.y, ii, axis=0))
+
+    def full(self):
+        return self.x if self.y is None else (self.x, self.y)
+
+
+def _device_fold_prep(dataset: Any, estimator) -> Optional[_DeviceFolds]:
+    """Device-resident fold preparation, when the estimator's fit consumes
+    device arrays in place (the ``_device_foldable`` families) and the
+    dataset is a plain numeric array or an ``(X, y)`` pair of them.
+    Anything else — DataFrames, pandas, pipelines, custom estimators —
+    keeps the host slicing path."""
+    if not getattr(estimator, "_device_foldable", False):
+        return None
+
+    from spark_rapids_ml_tpu.core.data import is_device_array
+
+    def _place(a, ndim):
+        """One device placement (device inputs stay put); None if the
+        value isn't a plain numeric array of the expected rank."""
+        import jax.numpy as jnp
+
+        if is_device_array(a):
+            a = a.ravel() if ndim == 1 and a.ndim != 1 else a
+            return a if a.ndim == ndim else None
+        try:
+            host = np.asarray(a)
+        except Exception:  # ragged / object containers
+            return None
+        if ndim == 1:
+            host = host.ravel()
+        if host.ndim != ndim or not np.issubdtype(host.dtype, np.number):
+            return None
+        return jnp.asarray(host)
+
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        x, y = _place(dataset[0], 2), _place(dataset[1], 1)
+        if x is not None and y is not None and x.shape[0] == y.shape[0]:
+            return _DeviceFolds(x, y)
+        return None
+    if isinstance(dataset, np.ndarray):
+        x = _place(dataset, 2)
+        return _DeviceFolds(x) if x is not None else None
+    return None
+
+
 def _slice_dataset(dataset: Any, idx: np.ndarray) -> Any:
     """Row-subset any supported dataset container by integer indices."""
     if isinstance(dataset, tuple) and len(dataset) == 2:
@@ -197,12 +268,21 @@ class CrossValidator(_ValidatorParams, Estimator):
 
         maps = self.getEstimatorParamMaps()
         metrics = np.zeros((len(maps), k))
+        prep = _device_fold_prep(dataset, self.estimator)
         for fold_i, val_idx in enumerate(folds):
             train_idx = np.concatenate(
                 [f for j, f in enumerate(folds) if j != fold_i]
             )
-            train = _slice_dataset(dataset, np.sort(train_idx))
-            val = _slice_dataset(dataset, np.sort(val_idx))
+            # Each fold's (train, val) is prepared ONCE — device-resident
+            # when the family supports it — and reused by every param-map
+            # fit below, instead of re-slicing/re-placing host data per
+            # grid cell.
+            if prep is not None:
+                train = prep.slice(np.sort(train_idx))
+                val = prep.slice(np.sort(val_idx))
+            else:
+                train = _slice_dataset(dataset, np.sort(train_idx))
+                val = _slice_dataset(dataset, np.sort(val_idx))
             for map_i, pm in enumerate(maps):
                 model = self.estimator.copy(pm).fit(train)
                 metrics[map_i, fold_i] = self.evaluator.evaluate(
@@ -211,7 +291,9 @@ class CrossValidator(_ValidatorParams, Estimator):
 
         avg = metrics.mean(axis=1)
         best_i = int(np.argmax(avg) if self.evaluator.isLargerBetter() else np.argmin(avg))
-        best_model = self.estimator.copy(maps[best_i]).fit(dataset)
+        best_model = self.estimator.copy(maps[best_i]).fit(
+            prep.full() if prep is not None else dataset
+        )
         cv_model = CrossValidatorModel(
             self.uid, best_model, avgMetrics=avg.tolist(), bestIndex=best_i
         )
@@ -291,8 +373,15 @@ class TrainValidationSplit(_ValidatorParams, Estimator):
             )
         rng = np.random.default_rng(self.getSeed())
         perm = rng.permutation(n)
-        train = _slice_dataset(dataset, np.sort(perm[:n_train]))
-        val = _slice_dataset(dataset, np.sort(perm[n_train:]))
+        # The single split is prepared ONCE — device-resident when the
+        # family supports it — and reused by every param-map fit.
+        prep = _device_fold_prep(dataset, self.estimator)
+        if prep is not None:
+            train = prep.slice(np.sort(perm[:n_train]))
+            val = prep.slice(np.sort(perm[n_train:]))
+        else:
+            train = _slice_dataset(dataset, np.sort(perm[:n_train]))
+            val = _slice_dataset(dataset, np.sort(perm[n_train:]))
 
         maps = self.getEstimatorParamMaps()
         metrics = []
@@ -303,7 +392,9 @@ class TrainValidationSplit(_ValidatorParams, Estimator):
             )
         arr = np.asarray(metrics)
         best_i = int(np.argmax(arr) if self.evaluator.isLargerBetter() else np.argmin(arr))
-        best_model = self.estimator.copy(maps[best_i]).fit(dataset)
+        best_model = self.estimator.copy(maps[best_i]).fit(
+            prep.full() if prep is not None else dataset
+        )
         tvs_model = TrainValidationSplitModel(
             self.uid, best_model, validationMetrics=metrics, bestIndex=best_i
         )

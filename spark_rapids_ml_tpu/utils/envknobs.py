@@ -63,6 +63,16 @@ def env_float(
     return value
 
 
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """A free-form string knob (paths, addresses); empty strings read as
+    unset so ``TPUML_X= cmd`` shell idioms disable rather than misconfigure."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    value = raw.strip()
+    return value if value else default
+
+
 def env_choice(name: str, choices: Sequence[str], default: str) -> str:
     """A string knob restricted to an explicit vocabulary."""
     raw = os.environ.get(name)

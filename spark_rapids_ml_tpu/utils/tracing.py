@@ -44,6 +44,37 @@ NvtxColor = TraceColor
 _events_lock = threading.Lock()
 _events: Deque[Tuple[str, float, float]] = deque(maxlen=4096)
 
+# Named monotonic counters — the quantitative sibling of the range ring
+# buffer. The serving layer (core/serving.py) publishes its program-cache
+# hit/miss/evict/compile totals here so tests and the bench can assert
+# "zero compiles on the warm path" without a profiler session, the same
+# way the ring buffer lets them assert a range fired.
+_counters_lock = threading.Lock()
+_counters: dict = {}
+
+
+def bump_counter(name: str, amount: int = 1) -> None:
+    """Increment a named counter (created at zero on first bump)."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + amount
+
+
+def counter_value(name: str) -> int:
+    with _counters_lock:
+        return _counters.get(name, 0)
+
+
+def counters(prefix: str = "") -> dict:
+    """Snapshot of all counters whose name starts with ``prefix``."""
+    with _counters_lock:
+        return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+
+
+def clear_counters(prefix: str = "") -> None:
+    with _counters_lock:
+        for k in [k for k in _counters if k.startswith(prefix)]:
+            del _counters[k]
+
 
 def recent_events() -> list:
     with _events_lock:
