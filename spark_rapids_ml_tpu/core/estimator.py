@@ -45,6 +45,24 @@ class Estimator(Params):
     def fit(self, dataset: Any):
         raise NotImplementedError
 
+    def _fit_checkpointer(self, solver: str, data=()):
+        """Checkpoint/restore handle for this fit (preemption tolerance,
+        robustness/checkpoint.py), or None when the ``TPUML_CHECKPOINT_*``
+        knobs leave checkpointing disabled — the default, in which case
+        this touches no device state and the fit keeps the monolithic
+        single-program solver path exactly.
+
+        Identity is (estimator uid, param hash, data fingerprint): the
+        checkpointer discovers the latest valid snapshot under
+        ``TPUML_CHECKPOINT_DIR`` at fit time, the segmented solver
+        resumes mid-solve bit-identically, and a completed fit retires
+        its own snapshots. Resuming across processes (a relaunched gang,
+        a resubmitted job) needs a stable uid — pass one to the
+        estimator constructor."""
+        from spark_rapids_ml_tpu.robustness.checkpoint import FitCheckpointer
+
+        return FitCheckpointer.for_fit(self, solver=solver, data=data)
+
 
 class Model(Transformer, MLReadable):
     """A fitted transformer; carries a parent uid via copyValues like Spark."""

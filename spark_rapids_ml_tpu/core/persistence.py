@@ -95,6 +95,34 @@ if _HAS_ARROW:
     )
 
 
+def atomic_file_write(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` atomically: hidden temp sibling on the
+    same filesystem, fsync, then ``os.replace`` — the single-FILE twin of
+    :class:`MLWriter`'s directory-level commit. A writer killed at any
+    point leaves either the previous file or a temp sibling a reader
+    never looks at, never a truncated ``path``. Used for checkpoint
+    snapshots (robustness/checkpoint.py), where a torn file would poison
+    every later resume."""
+    import uuid
+
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(
+        parent, f".{os.path.basename(path)}.tmp-write-{uuid.uuid4().hex[:12]}"
+    )
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:  # pragma: no cover - best-effort cleanup
+                pass
+
+
 def save_metadata(
     instance,
     path: str,

@@ -43,6 +43,7 @@ from spark_rapids_ml_tpu.ops.kmeans import (
     assign_clusters,
     kmeans_plusplus_init,
     lloyd,
+    lloyd_resumable,
     normalize_rows,
     random_init,
 )
@@ -280,6 +281,28 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                 )
             else:
                 init = kmeans_plusplus_init(xs, mask, key, k)
+            # Preemption tolerance (robustness/checkpoint.py): with the
+            # TPUML_CHECKPOINT_* knobs set, Lloyd runs segmented with
+            # async snapshots and resumes mid-solve from the latest valid
+            # checkpoint — except under an EXPLICIT backend='fused'
+            # request, whose pallas kernel has no externalized state.
+            ckpt = (
+                self._fit_checkpointer("kmeans.lloyd", data=(xs, mask, init))
+                if self.getBackend() != "fused"
+                else None
+            )
+            if ckpt is not None:
+                shards = self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
+                centers, cost, n_iter = lloyd_resumable(
+                    xs, mask, init, ckpt,
+                    max_iter=self.getMaxIter(), tol=self.getTol(),
+                    cosine=cosine, data_shards=shards,
+                    precision=self.getPrecision(), mesh=self.mesh,
+                )
+                model = KMeansModel(
+                    self.uid, centers[:, :d], trainingCost=cost, numIter=n_iter
+                )
+                return self._copyValues(model)
             backend = self._resolve_backend(
                 w_host, int(xs.shape[0]) * k, d=int(xs.shape[1]), k=k,
                 dtype=xs.dtype,

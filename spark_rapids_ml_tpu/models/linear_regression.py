@@ -41,6 +41,7 @@ from spark_rapids_ml_tpu.ops.linear import (
     predict_linear,
     regression_metrics,
     solve_elastic_net,
+    solve_elastic_net_resumable,
     solve_normal,
     solve_normal_host,
 )
@@ -364,7 +365,28 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
             )
         # L1/elastic net: FISTA on the same sufficient statistics — one
         # data GEMM pass, then O(d^2) proximal iterations (Spark reaches
-        # this case via OWL-QN over the data).
+        # this case via OWL-QN over the data). With the TPUML_CHECKPOINT_*
+        # knobs set the proximal loop runs segmented with async snapshots
+        # and resumes mid-solve (robustness/checkpoint.py); the iterative
+        # loop — not the one-GEMM stats pass — is what preemption loses.
+        ckpt = self._fit_checkpointer(
+            "linreg.fista", data=(xtx[:d, :d], xty[:d], x_sum[:d], y_sum, count)
+        )
+        if ckpt is not None:
+            coef, intercept, _ = solve_elastic_net_resumable(
+                xtx[:d, :d],
+                xty[:d],
+                x_sum[:d],
+                y_sum,
+                count,
+                reg_param=self.getRegParam(),
+                elastic_net_param=self.getElasticNetParam(),
+                checkpointer=ckpt,
+                fit_intercept=self.getFitIntercept(),
+                standardization=self.getStandardization(),
+                mesh=self.mesh,
+            )
+            return coef, intercept
         coef, intercept, _ = solve_elastic_net(
             xtx[:d, :d],
             xty[:d],

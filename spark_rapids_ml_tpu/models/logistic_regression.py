@@ -51,6 +51,7 @@ from spark_rapids_ml_tpu.ops.logistic import (
     classification_metrics,
     fit_logistic,
     fit_logistic_elastic_net,
+    fit_logistic_resumable,
     predict_logistic,
 )
 from spark_rapids_ml_tpu.core.serving import serve_rows
@@ -293,7 +294,16 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                 )
                 init_b = jnp.asarray(b0, dtype=dtype)
             if enet == 0.0 or self.getRegParam() == 0.0:
-                result = fit_logistic(
+                # Preemption tolerance: the TPUML_CHECKPOINT_* knobs route
+                # the L-BFGS solve through the segmented driver (async
+                # snapshots, mid-solve resume, bit-identical results).
+                ckpt = self._fit_checkpointer("logistic.lbfgs", data=(xs, ys, mask))
+                fit_fn = fit_logistic
+                extra = {}
+                if ckpt is not None:
+                    fit_fn = fit_logistic_resumable
+                    extra = {"checkpointer": ckpt, "mesh": self.mesh}
+                result = fit_fn(
                     xs,
                     ys,
                     mask,
@@ -306,6 +316,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                     multinomial=use_multinomial,
                     init_w=init_w,
                     init_b=init_b,
+                    **extra,
                 )
             else:
                 if self._initial_weights is not None:

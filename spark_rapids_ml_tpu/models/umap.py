@@ -345,18 +345,46 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
                 optimizer = functools.partial(optimize_layout_sharded, self.mesh)
             else:
                 optimizer = optimize_layout
-            emb = optimizer(
-                emb0.astype(jnp.float32),
-                graph,
-                k_opt,
-                n_epochs=self._auto_epochs(n),
-                neg_rate=self.getNegativeSampleRate(),
-                neg_pool=self.getNegativePoolSize(),
-                learning_rate=self.getLearningRate(),
-                repulsion=self.getRepulsionStrength(),
-                a=a,
-                b=b,
-            )
+            # Preemption tolerance is OPT-IN for UMAP (TPUML_CHECKPOINT_UMAP=1
+            # on top of the global knobs): only the epoch SGD checkpoints —
+            # the kNN graph and the init recompute deterministically on
+            # resume. Single-device fits only (the sharded epoch program
+            # keeps its state inside shard_map).
+            ckpt = None
+            if self.mesh is None:
+                from spark_rapids_ml_tpu.robustness.checkpoint import umap_opt_in
+
+                if umap_opt_in():
+                    ckpt = self._fit_checkpointer("umap.layout", data=(x, emb0))
+            if ckpt is not None:
+                from spark_rapids_ml_tpu.ops.umap import optimize_layout_resumable
+
+                emb = optimize_layout_resumable(
+                    emb0.astype(jnp.float32),
+                    graph,
+                    k_opt,
+                    ckpt,
+                    n_epochs=self._auto_epochs(n),
+                    neg_rate=self.getNegativeSampleRate(),
+                    neg_pool=self.getNegativePoolSize(),
+                    learning_rate=self.getLearningRate(),
+                    repulsion=self.getRepulsionStrength(),
+                    a=a,
+                    b=b,
+                )
+            else:
+                emb = optimizer(
+                    emb0.astype(jnp.float32),
+                    graph,
+                    k_opt,
+                    n_epochs=self._auto_epochs(n),
+                    neg_rate=self.getNegativeSampleRate(),
+                    neg_pool=self.getNegativePoolSize(),
+                    learning_rate=self.getLearningRate(),
+                    repulsion=self.getRepulsionStrength(),
+                    a=a,
+                    b=b,
+                )
 
         # Device fits keep embedding + train rows resident; the model's
         # host float64 views convert lazily (the PCAModel contract).

@@ -114,6 +114,20 @@ def lloyd_step(x, mask, centers, x2, prec, cosine: bool = False,
     return new_centers, cost
 
 
+def _auto_block_rows(n: int, k: int, data_shards: int, block_rows):
+    """Resolve ``block_rows=None`` — shared by the monolithic
+    :func:`lloyd` and the segmented :func:`lloyd_resumable` so both
+    pick the identical blocking (a prerequisite for bit-identity)."""
+    if block_rows is not None:
+        return block_rows
+    # Per-device (n, k) fp32 temporary vs the HBM budget.
+    if 4 * n * k // max(data_shards, 1) > 9_000_000_000:
+        # Block sized so block*k*4B stays ~1 GB (no larger floor: a
+        # floor above this budget would reintroduce the OOM for big k).
+        return max(8, (250_000_000 // max(k, 1) // 8) * 8)
+    return n + 1  # unblocked
+
+
 @partial(
     jax.jit,
     static_argnames=("max_iter", "precision", "cosine", "block_rows", "data_shards"),
@@ -151,14 +165,7 @@ def lloyd(
     prec = _dot_precision(precision)
     n = x.shape[0]
     k = init_centers.shape[0]
-    if block_rows is None:
-        # Per-device (n, k) fp32 temporary vs the HBM budget.
-        if 4 * n * k // max(data_shards, 1) > 9_000_000_000:
-            # Block sized so block*k*4B stays ~1 GB (no larger floor: a
-            # floor above this budget would reintroduce the OOM for big k).
-            block_rows = max(8, (250_000_000 // max(k, 1) // 8) * 8)
-        else:
-            block_rows = n + 1  # unblocked
+    block_rows = _auto_block_rows(n, k, data_shards, block_rows)
     blocked = n > block_rows
     if blocked:
         pad = (-n) % block_rows
@@ -185,6 +192,125 @@ def lloyd(
     # One final cost evaluation against the converged centers.
     _, final_cost = lloyd_step(x, mask, centers, x2, prec, cosine=cosine, block_rows=br)
     return centers, final_cost, n_iter
+
+
+@partial(
+    jax.jit, static_argnames=("max_iter", "every", "precision", "cosine", "block_rows")
+)
+def _lloyd_segment(
+    x, mask, centers, moved, it, cost, tol,
+    max_iter: int, every: int,
+    precision: str, cosine: bool, block_rows,
+):
+    """Up to ``every`` Lloyd iterations from an explicit solver state.
+
+    Exactly :func:`lloyd`'s loop body and stopping rule, plus a segment
+    budget in the cond — so a sequence of segments executes the SAME
+    iteration sequence as the monolithic while_loop, with the full state
+    (centers, movement, iteration counter, cost) visible as a pytree
+    between segments (the checkpointable form). ``x`` must already be
+    padded to the block multiple (the driver owns the padding, once)."""
+    prec = _dot_precision(precision)
+    x2 = jnp.sum(x * x, axis=1)
+    br = block_rows if (block_rows is not None and x.shape[0] > block_rows) else None
+
+    def cond(state):
+        _, moved, it, _, seg = state
+        return jnp.logical_and(
+            jnp.logical_and(moved > tol * tol, it < max_iter), seg < every
+        )
+
+    def body(state):
+        centers, _, it, _, seg = state
+        new_centers, cost = lloyd_step(
+            x, mask, centers, x2, prec, cosine=cosine, block_rows=br
+        )
+        moved = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
+        return new_centers, moved, it + 1, cost, seg + 1
+
+    centers, moved, it, cost, _ = jax.lax.while_loop(
+        cond, body, (centers, moved, it, cost, 0)
+    )
+    return centers, moved, it, cost
+
+
+@partial(jax.jit, static_argnames=("precision", "cosine", "block_rows"))
+def _lloyd_final_cost(x, mask, centers, precision: str, cosine: bool, block_rows):
+    """The converged-centers cost evaluation :func:`lloyd` ends with,
+    as its own program for the segmented driver."""
+    prec = _dot_precision(precision)
+    x2 = jnp.sum(x * x, axis=1)
+    br = block_rows if (block_rows is not None and x.shape[0] > block_rows) else None
+    _, cost = lloyd_step(x, mask, centers, x2, prec, cosine=cosine, block_rows=br)
+    return cost
+
+
+def lloyd_resumable(
+    x: jax.Array,
+    mask: jax.Array,
+    init_centers: jax.Array,
+    checkpointer,
+    max_iter: int = 20,
+    tol: float = 1e-4,
+    precision: str = "highest",
+    cosine: bool = False,
+    block_rows: Optional[int] = None,
+    data_shards: int = 1,
+    mesh=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Preemption-tolerant :func:`lloyd`: a host-side outer loop running
+    ``checkpointer.every`` iterations per jitted segment, the solver
+    state snapshotted asynchronously after each segment, and the fit
+    resumed mid-solve from the latest valid checkpoint. Same returns,
+    bit-identical centers/cost/iterations (tests/test_checkpoint.py)."""
+    from spark_rapids_ml_tpu.robustness.checkpoint import (
+        replicate_state_onto_mesh,
+        segment_boundary,
+    )
+    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+    n = x.shape[0]
+    k = init_centers.shape[0]
+    block_rows = _auto_block_rows(n, k, data_shards, block_rows)
+    if n > block_rows:
+        pad = (-n) % block_rows
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)])
+            mask = jnp.concatenate([mask, jnp.zeros((pad,), mask.dtype)])
+
+    state = (
+        init_centers,
+        jnp.asarray(jnp.inf, x.dtype),
+        jnp.asarray(0),
+        jnp.asarray(0.0, x.dtype),
+    )
+    restored = checkpointer.restore_latest(template=state)
+    if restored is not None:
+        _, state = restored
+        if mesh is not None:
+            state = replicate_state_onto_mesh(state, mesh)
+
+    tol_sq = float(tol) * float(tol)
+    while True:
+        moved, it = float(state[1]), int(state[2])
+        if not (moved > tol_sq and it < max_iter):
+            break
+        state = _lloyd_segment(
+            x, mask, *state, tol,
+            max_iter=max_iter, every=checkpointer.every,
+            precision=precision, cosine=cosine, block_rows=block_rows,
+        )
+        bump_counter("checkpoint.segments")
+        bump_counter("checkpoint.solver_iters", int(state[2]) - it)
+        checkpointer.save_async(int(state[2]), state)
+        segment_boundary(checkpointer)
+
+    centers, _, n_iter, _ = state
+    cost = _lloyd_final_cost(
+        x, mask, centers, precision=precision, cosine=cosine, block_rows=block_rows
+    )
+    checkpointer.finalize_success()
+    return centers, cost, n_iter
 
 
 @partial(jax.jit, static_argnames=("block_rows", "precision"))

@@ -207,6 +207,114 @@ def solve_elastic_net(
     return coef, intercept, n_iter
 
 
+@partial(jax.jit, static_argnames=("fit_intercept", "standardization"))
+def _enet_prep(
+    xtx, xty, x_sum, y_sum, count, reg_param, elastic_net_param,
+    fit_intercept: bool, standardization: bool,
+):
+    """:func:`solve_elastic_net`'s pre-loop reduction (quadratic form,
+    soft-threshold levels, Lipschitz constant, means) as one small
+    program, shared by every segment of a resumable solve."""
+    n = count
+    a, b, x_mean, y_mean, w2 = _centered_moments(
+        xtx, xty, x_sum, y_sum, count, fit_intercept, standardization
+    )
+    d = a.shape[0]
+    w1 = jnp.sqrt(w2) if standardization else jnp.ones(d, dtype=a.dtype)
+    alpha = elastic_net_param
+    a_quad = a / n + reg_param * (1.0 - alpha) * jnp.diag(w2)
+    b_lin = b / n
+    l1 = reg_param * alpha * w1
+    lip = jnp.maximum(jnp.max(jnp.linalg.eigvalsh(a_quad)), 1e-12)
+    return a_quad, b_lin, l1, lip, x_mean, y_mean
+
+
+@partial(jax.jit, static_argnames=("max_iter", "every"))
+def _enet_segment(
+    a_quad, b_lin, l1, lip, tol, coef, z, t, it, delta,
+    max_iter: int, every: int,
+):
+    """Up to ``every`` FISTA iterations from an explicit carry — exactly
+    :func:`solve_elastic_net`'s loop body and stopping rule plus a
+    segment budget, the (coef, momentum, t, iteration, delta) state a
+    pytree between segments."""
+
+    def cond(carry):
+        _, _, _, it, delta, seg = carry
+        return jnp.logical_and(
+            jnp.logical_and(it < max_iter, delta > tol), seg < every
+        )
+
+    def body(carry):
+        c, z, t, it, _, seg = carry
+        grad = a_quad @ z - b_lin
+        c_new = soft_threshold(z - grad / lip, l1 / lip)
+        t_new = (1.0 + jnp.sqrt(1.0 + 4.0 * t * t)) / 2.0
+        z_new = c_new + ((t - 1.0) / t_new) * (c_new - c)
+        delta = jnp.max(jnp.abs(c_new - c))
+        return c_new, z_new, t_new, it + 1, delta, seg + 1
+
+    coef, z, t, it, delta, _ = jax.lax.while_loop(
+        cond, body, (coef, z, t, it, delta, 0)
+    )
+    return coef, z, t, it, delta
+
+
+def solve_elastic_net_resumable(
+    xtx, xty, x_sum, y_sum, count,
+    reg_param: float,
+    elastic_net_param: float,
+    checkpointer,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+    max_iter: int = 2000,
+    tol: float = 1e-7,
+    mesh=None,
+):
+    """Preemption-tolerant :func:`solve_elastic_net`: host outer loop
+    over jitted FISTA segments with async checkpoint snapshots between
+    them. Same returns (coefficients, intercept, n_iter), bit-identical."""
+    from spark_rapids_ml_tpu.robustness.checkpoint import (
+        replicate_state_onto_mesh,
+        segment_boundary,
+    )
+    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+    a_quad, b_lin, l1, lip, x_mean, y_mean = _enet_prep(
+        xtx, xty, x_sum, y_sum, count, reg_param, elastic_net_param,
+        fit_intercept=fit_intercept, standardization=standardization,
+    )
+    d = a_quad.shape[0]
+    dt = a_quad.dtype
+    c0 = jnp.zeros(d, dtype=dt)
+    carry = (
+        c0, c0, jnp.asarray(1.0, dt), jnp.asarray(0), jnp.asarray(jnp.inf, dt)
+    )
+    restored = checkpointer.restore_latest(template=carry)
+    if restored is not None:
+        _, carry = restored
+        if mesh is not None:
+            carry = replicate_state_onto_mesh(carry, mesh)
+
+    while True:
+        it, delta = int(carry[3]), float(carry[4])
+        if not (it < max_iter and delta > tol):
+            break
+        carry = _enet_segment(
+            a_quad, b_lin, l1, lip, tol, *carry,
+            max_iter=max_iter, every=checkpointer.every,
+        )
+        bump_counter("checkpoint.segments")
+        bump_counter("checkpoint.solver_iters", int(carry[3]) - it)
+        checkpointer.save_async(int(carry[3]), carry)
+        segment_boundary(checkpointer)
+
+    coef, _, _, n_iter, _ = carry
+    intercept = jnp.where(fit_intercept, y_mean - jnp.dot(x_mean, coef), 0.0)
+    checkpointer.finalize_success()
+    return coef, intercept, n_iter
+
+
 def solve_normal_host(
     xtx,
     xty,

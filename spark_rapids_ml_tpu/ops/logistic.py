@@ -45,6 +45,31 @@ class LogisticFit(NamedTuple):
     loss: jax.Array  # final objective value (standardized space)
 
 
+def _make_logistic_loss(x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec):
+    """The ONE home of the (standardized-space) logistic objective —
+    closed over by the monolithic :func:`fit_logistic` program, the
+    segmented :func:`_lbfgs_segment` program, and the finalizer, so all
+    three optimize/evaluate literally the same expression (the
+    bit-identity bar of the checkpoint subsystem)."""
+
+    def loss_fn(params):
+        w, b = params
+        xs = (x - offset) / scale
+        logits = jnp.matmul(xs, w, precision=prec)
+        if fit_intercept:
+            logits = logits + b
+        if c == 1:
+            z = logits[:, 0]
+            # log(1+e^z) - y z, numerically stable via softplus
+            per_row = jax.nn.softplus(z) - y_target * z
+        else:
+            per_row = -jnp.sum(y_target * jax.nn.log_softmax(logits, axis=1), axis=1)
+        data_loss = jnp.sum(per_row * mask) / n
+        return data_loss + 0.5 * reg_param * jnp.sum(w * w)
+
+    return loss_fn
+
+
 def _masked_feature_moments(x: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Weighted per-feature mean and stddev (population, like Spark's scaler).
 
@@ -141,20 +166,9 @@ def fit_logistic(
     else:
         y_target = jax.nn.one_hot(y, c, dtype=dtype)
 
-    def loss_fn(params):
-        w, b = params
-        xs = (x - offset) / scale
-        logits = jnp.matmul(xs, w, precision=prec)
-        if fit_intercept:
-            logits = logits + b
-        if c == 1:
-            z = logits[:, 0]
-            # log(1+e^z) - y z, numerically stable via softplus
-            per_row = jax.nn.softplus(z) - y_target * z
-        else:
-            per_row = -jnp.sum(y_target * jax.nn.log_softmax(logits, axis=1), axis=1)
-        data_loss = jnp.sum(per_row * mask) / n
-        return data_loss + 0.5 * reg_param * jnp.sum(w * w)
+    loss_fn = _make_logistic_loss(
+        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec
+    )
 
     if init_w is None:
         w0 = jnp.zeros((d, c), dtype=dtype)
@@ -218,6 +232,194 @@ def fit_logistic(
         w_orig = w_orig.astype(out_dtype)
         b_orig = b_orig.astype(out_dtype)
         final_loss = final_loss.astype(out_dtype)
+    return LogisticFit(w_orig, b_orig, n_iter, final_loss)
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "standardization"))
+def _logistic_prep(x, mask, fit_intercept: bool, standardization: bool):
+    """The standardizer inputs of :func:`fit_logistic` — (offset, scale,
+    n) as one small program, shared by every segment of a resumable fit
+    instead of being refolded into each one."""
+    n = jnp.sum(mask)
+    mean, sigma = _masked_feature_moments(x, mask)
+    safe_sigma = jnp.where(sigma > 0, sigma, 1.0)
+    if standardization:
+        offset = mean if fit_intercept else jnp.zeros_like(mean)
+        scale = safe_sigma
+    else:
+        offset = jnp.zeros_like(mean)
+        scale = jnp.ones_like(safe_sigma)
+    return offset, scale, n
+
+
+@partial(
+    jax.jit,
+    static_argnames=("c", "fit_intercept", "max_iter", "every", "precision"),
+)
+def _lbfgs_segment(
+    x, y_target, mask, offset, scale, n, reg_param, tol,
+    params, opt_state, it, gnorm,
+    c: int, fit_intercept: bool, max_iter: int, every: int, precision: str,
+):
+    """Up to ``every`` L-BFGS iterations from an explicit optimizer
+    state — exactly :func:`fit_logistic`'s loop body and stopping rule
+    plus a segment budget, with the full (params, optax state, iteration,
+    gradient norm) carry visible as a pytree between segments."""
+    prec = _dot_precision(precision)
+    loss_fn = _make_logistic_loss(
+        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec
+    )
+    solver = optax.lbfgs()
+    from spark_rapids_ml_tpu.utils.compat import value_and_grad_from_state
+
+    value_and_grad = value_and_grad_from_state(loss_fn)
+
+    def cond(carry):
+        _params, _state, it, gnorm, seg = carry
+        return jnp.logical_and(
+            jnp.logical_and(it < max_iter, gnorm > tol), seg < every
+        )
+
+    def body(carry):
+        params, state, it, _, seg = carry
+        value, grad = value_and_grad(params, state=state)
+        updates, state = solver.update(
+            grad, state, params, value=value, grad=grad, value_fn=loss_fn
+        )
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grad)
+        return params, state, it + 1, gnorm, seg + 1
+
+    params, opt_state, it, gnorm, _ = jax.lax.while_loop(
+        cond, body, (params, opt_state, it, gnorm, 0)
+    )
+    return params, opt_state, it, gnorm
+
+
+@partial(jax.jit, static_argnames=("c", "fit_intercept", "precision"))
+def _logistic_finalize(
+    x, y_target, mask, offset, scale, n, reg_param, w, b,
+    c: int, fit_intercept: bool, precision: str,
+):
+    """:func:`fit_logistic`'s post-solve tail (identifiability pivot,
+    back-map to original feature space, final objective) as its own
+    program for the segmented driver."""
+    prec = _dot_precision(precision)
+    loss_fn = _make_logistic_loss(
+        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec
+    )
+    if c > 1:
+        do_center = reg_param == 0.0
+        w = jnp.where(do_center, w - jnp.mean(w, axis=1, keepdims=True), w)
+        b = jnp.where(do_center, b - jnp.mean(b), b)
+    w_orig = w / scale[:, None]
+    b_orig = b - jnp.matmul(offset, w_orig, precision=prec) if fit_intercept else b
+    return w_orig, b_orig, loss_fn((w, b))
+
+
+def fit_logistic_resumable(
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    checkpointer,
+    n_classes: int,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    standardization: bool = True,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    precision: str = "highest",
+    multinomial: bool = False,
+    init_w: jax.Array | None = None,
+    init_b: jax.Array | None = None,
+    mesh=None,
+) -> LogisticFit:
+    """Preemption-tolerant :func:`fit_logistic` (the L-BFGS / L2 path):
+    a host outer loop over jitted L-BFGS segments, the (params, optimizer
+    state, iteration counter, gradient norm) pytree snapshotted
+    asynchronously between segments, the fit resumed mid-solve from the
+    latest valid checkpoint. Same returns, bit-identical solution."""
+    from spark_rapids_ml_tpu.robustness.checkpoint import (
+        replicate_state_onto_mesh,
+        segment_boundary,
+    )
+    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+    if n_classes < 2:
+        raise ValueError(f"need at least 2 classes, got {n_classes}")
+    c = n_classes if (multinomial or n_classes > 2) else 1
+    d = x.shape[1]
+    dtype = x.dtype
+    out_dtype = None
+    if dtype == jnp.float32 and jax.config.jax_enable_x64:
+        from spark_rapids_ml_tpu.utils.compat import optax_lbfgs_f32_works
+
+        if not optax_lbfgs_f32_works():
+            out_dtype = dtype
+            dtype = jnp.float64
+            x = x.astype(dtype)
+            mask = mask.astype(dtype)
+    prec = _dot_precision(precision)
+    offset, scale, n = _logistic_prep(
+        x, mask, fit_intercept=fit_intercept, standardization=standardization
+    )
+
+    if c == 1:
+        y_target = (y == 1).astype(dtype)
+    else:
+        y_target = jax.nn.one_hot(y, c, dtype=dtype)
+
+    if init_w is None:
+        w0 = jnp.zeros((d, c), dtype=dtype)
+        b0 = jnp.zeros((c,), dtype=dtype)
+    else:
+        w_orig0 = jnp.asarray(init_w, dtype=dtype)
+        w0 = w_orig0 * scale[:, None]
+        if fit_intercept:
+            b_orig0 = (
+                jnp.asarray(init_b, dtype=dtype)
+                if init_b is not None
+                else jnp.zeros((c,), dtype=dtype)
+            )
+            b0 = b_orig0 + jnp.matmul(offset, w_orig0, precision=prec)
+        else:
+            b0 = jnp.zeros((c,), dtype=dtype)
+
+    params0 = (w0, b0)
+    state0 = optax.lbfgs().init(params0)
+    carry = (params0, state0, jnp.asarray(0), jnp.asarray(jnp.inf, dtype=dtype))
+    restored = checkpointer.restore_latest(template=carry)
+    if restored is not None:
+        _, carry = restored
+        if mesh is not None:
+            carry = replicate_state_onto_mesh(carry, mesh)
+
+    while True:
+        it, gn = int(carry[2]), float(carry[3])
+        if not (it < max_iter and gn > tol):
+            break
+        params, opt_state, it_a, gn_a = _lbfgs_segment(
+            x, y_target, mask, offset, scale, n,
+            reg_param, tol, carry[0], carry[1], carry[2], carry[3],
+            c=c, fit_intercept=fit_intercept, max_iter=max_iter,
+            every=checkpointer.every, precision=precision,
+        )
+        carry = (params, opt_state, it_a, gn_a)
+        bump_counter("checkpoint.segments")
+        bump_counter("checkpoint.solver_iters", int(it_a) - it)
+        checkpointer.save_async(int(it_a), carry)
+        segment_boundary(checkpointer)
+
+    (w, b), _, n_iter, _ = carry
+    w_orig, b_orig, final_loss = _logistic_finalize(
+        x, y_target, mask, offset, scale, n, reg_param, w, b,
+        c=c, fit_intercept=fit_intercept, precision=precision,
+    )
+    if out_dtype is not None:  # f64 fallback solve: hand back f32
+        w_orig = w_orig.astype(out_dtype)
+        b_orig = b_orig.astype(out_dtype)
+        final_loss = final_loss.astype(out_dtype)
+    checkpointer.finalize_success()
     return LogisticFit(w_orig, b_orig, n_iter, final_loss)
 
 

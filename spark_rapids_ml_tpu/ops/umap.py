@@ -189,11 +189,29 @@ def optimize_layout(
     ``neg_pool=0`` keeps the legacy per-edge path.
     """
     n, dim = embedding.shape
+    epoch = _make_epoch_fn(
+        embedding.shape, graph, target,
+        n_epochs=n_epochs, neg_rate=neg_rate, neg_pool=neg_pool,
+        learning_rate=learning_rate, repulsion=repulsion, a=a, b=b,
+        move_other=move_other,
+    )
+    y, _ = lax.fori_loop(0, n_epochs, epoch, (embedding, key))
+    return y
+
+
+def _make_epoch_fn(
+    shape, graph: FuzzyGraph, target,
+    *, n_epochs, neg_rate, neg_pool, learning_rate, repulsion, a, b, move_other,
+):
+    """Build ONE epoch of the synchronous layout SGD — the single home of
+    the epoch body, closed over by the monolithic :func:`optimize_layout`
+    program and the segmented :func:`_layout_segment` program so both run
+    literally the same per-epoch math (checkpoint bit-identity)."""
+    n, dim = shape
     k = graph.indices.shape[1]
     dst = graph.indices  # (n, k)
     w = graph.weight  # (n, k)
-    ref = embedding if target is None else target
-    n_ref = ref.shape[0]
+    n_ref = n if target is None else target.shape[0]
     w_sum = jnp.sum(w, axis=1)  # (n,) total edge weight per head
 
     def epoch(ep, carry):
@@ -271,7 +289,75 @@ def optimize_layout(
             )
         return y + delta, key
 
-    y, _ = lax.fori_loop(0, n_epochs, epoch, (embedding, key))
+    return epoch
+
+
+@partial(
+    jax.jit, static_argnames=("n_epochs", "neg_rate", "neg_pool", "move_other")
+)
+def _layout_segment(
+    y, key_data, ep_start, ep_stop, graph: FuzzyGraph,
+    learning_rate, repulsion, a, b, target,
+    *, n_epochs: int, neg_rate: int, neg_pool: int, move_other: bool,
+):
+    """Epochs [ep_start, ep_stop) of :func:`optimize_layout` from an
+    explicit (layout, RNG) state — the checkpointable form. The RNG key
+    travels as raw ``key_data`` (uint32) so the state pytree serializes;
+    traced bounds keep ONE compiled program across all segments."""
+    key = jax.random.wrap_key_data(key_data)
+    epoch = _make_epoch_fn(
+        y.shape, graph, target,
+        n_epochs=n_epochs, neg_rate=neg_rate, neg_pool=neg_pool,
+        learning_rate=learning_rate, repulsion=repulsion, a=a, b=b,
+        move_other=move_other,
+    )
+    y, key = lax.fori_loop(ep_start, ep_stop, epoch, (y, key))
+    return y, jax.random.key_data(key)
+
+
+def optimize_layout_resumable(
+    embedding: jax.Array,
+    graph: FuzzyGraph,
+    key: jax.Array,
+    checkpointer,
+    *,
+    n_epochs: int,
+    neg_rate: int = 5,
+    neg_pool: int = 256,
+    learning_rate: float = 1.0,
+    repulsion: float = 1.0,
+    a: float = 1.577,
+    b: float = 0.895,
+    move_other: bool = True,
+    target: jax.Array | None = None,
+) -> jax.Array:
+    """Preemption-tolerant :func:`optimize_layout`: ``checkpointer.every``
+    epochs per jitted segment, the (layout, RNG key data, epoch) state
+    snapshotted asynchronously between segments, resumed mid-schedule
+    from the latest valid checkpoint. Bit-identical final layout."""
+    from spark_rapids_ml_tpu.robustness.checkpoint import segment_boundary
+    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+    state = (embedding, jax.random.key_data(key), jnp.asarray(0))
+    restored = checkpointer.restore_latest(template=state)
+    if restored is not None:
+        _, state = restored
+    y, kd, ep = state
+    while int(ep) < n_epochs:
+        start = int(ep)
+        stop = min(start + checkpointer.every, n_epochs)
+        y, kd = _layout_segment(
+            y, kd, jnp.asarray(start), jnp.asarray(stop), graph,
+            learning_rate, repulsion, a, b, target,
+            n_epochs=n_epochs, neg_rate=neg_rate, neg_pool=neg_pool,
+            move_other=move_other,
+        )
+        ep = jnp.asarray(stop)
+        bump_counter("checkpoint.segments")
+        bump_counter("checkpoint.solver_iters", stop - start)
+        checkpointer.save_async(stop, (y, kd, ep))
+        segment_boundary(checkpointer)
+    checkpointer.finalize_success()
     return y
 
 

@@ -41,9 +41,65 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 from spark_rapids_ml_tpu.robustness.faults import fault_point
 from spark_rapids_ml_tpu.robustness.retry import default_policy
-from spark_rapids_ml_tpu.utils.envknobs import env_int
+from spark_rapids_ml_tpu.utils.envknobs import EnvKnobError, env_int
 
 _initialized = False
+# The coordinates the active runtime was actually brought up with —
+# compared against any LATER initialize() call so a conflicting request
+# is named instead of silently ignored.
+_init_record: Optional[dict] = None
+
+
+class GangReinitWarning(UserWarning):
+    """A second ``initialize`` asked for a DIFFERENT gang than the one
+    this process already joined. jax.distributed cannot re-form a cohort
+    in-process, so the request is ignored — but silently honoring the
+    old coordinates while the caller believes it changed them is exactly
+    how a relaunched gang rejoins a dead cohort. Carries the field name
+    and both values."""
+
+    def __init__(self, field: str, active, requested):
+        self.field = field
+        self.active = active
+        self.requested = requested
+        super().__init__(
+            f"jax.distributed is already initialized with {field}="
+            f"{active!r}; ignoring a later initialize() requesting "
+            f"{field}={requested!r} — a genuinely new gang needs a fresh "
+            "process (or jax.distributed.shutdown() first)"
+        )
+
+
+def _check_reinit_request(
+    coordinator_address, num_processes, process_id
+) -> None:
+    """The already-initialized path: resolve what THIS call asked for
+    (explicit args > env, malformed env treated as unknown rather than
+    raising on a previously-silent no-op) and warn, field by field, where
+    it conflicts with the active runtime."""
+    import warnings
+
+    if _init_record is None:
+        return
+    requested = {"coordinator_address": coordinator_address or os.environ.get("TPUML_COORDINATOR")}
+    try:
+        requested["num_processes"] = (
+            num_processes if num_processes is not None
+            else env_int("TPUML_NUM_PROCESSES", minimum=1)
+        )
+        requested["process_id"] = (
+            process_id if process_id is not None
+            else env_int("TPUML_PROCESS_ID", minimum=0)
+        )
+    except EnvKnobError:
+        requested.setdefault("num_processes", None)
+        requested.setdefault("process_id", None)
+    for field, asked in requested.items():
+        active = _init_record.get(field)
+        if asked is not None and active is not None and asked != active:
+            warnings.warn(
+                GangReinitWarning(field, active, asked), stacklevel=3
+            )
 
 
 def initialize(
@@ -68,8 +124,13 @@ def initialize(
     recovery recipe is relaunch-and-refit — see docs/PARITY.md §5 (the
     Spark barrier-task retry analogue).
     """
-    global _initialized
+    global _initialized, _init_record
     if _initialized:
+        # Not silent anymore: a second call asking for a DIFFERENT
+        # coordinator or process id gets a structured GangReinitWarning
+        # naming both values (the silent path hid exactly the relaunch
+        # bug the barrier launcher exists to prevent).
+        _check_reinit_request(coordinator_address, num_processes, process_id)
         return
     # env_int (utils/envknobs.py) names the variable, the bad value, and
     # the expected form — a launcher typo used to surface as an anonymous
@@ -101,6 +162,11 @@ def initialize(
 
     default_policy().run(_bring_up, name="distributed.initialize")
     _initialized = True
+    _init_record = {
+        "coordinator_address": coordinator_address,
+        "num_processes": num_processes,
+        "process_id": process_id,
+    }
 
 
 def bringup_executor(
@@ -464,10 +530,19 @@ def _replicated_sum_jit(mesh: Mesh):
     )
 
 
+# Elastic gang resume: a relaunched gang restores host checkpoint state
+# on every process and replicates it onto the NEW mesh through this
+# helper (one home, robustness/checkpoint.py) before resuming mid-solve.
+from spark_rapids_ml_tpu.robustness.checkpoint import (  # noqa: E402
+    replicate_state_onto_mesh,
+)
+
 __all__ = [
+    "GangReinitWarning",
     "initialize",
     "bringup_executor",
     "global_mesh",
+    "replicate_state_onto_mesh",
     "shard_rows_process_local",
     "streaming_covariance_process_local",
 ]

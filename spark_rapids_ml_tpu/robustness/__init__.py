@@ -1,8 +1,9 @@
 """Robustness subsystem: deterministic fault injection, the unified
-retry/degradation policy, and the structured warnings they emit.
+retry/degradation policy, checkpoint/resume for iterative fits, and the
+structured warnings they emit.
 
-Three modules, one story (the executable half of docs/PARITY.md "Failure
-injection & retry knobs"):
+Four modules, one story (the executable half of docs/PARITY.md "Failure
+injection & retry knobs" / "Checkpoint & resume knobs"):
 
   - :mod:`~spark_rapids_ml_tpu.robustness.faults` — named injection
     sites (``TPUML_FAULTS`` / ``inject(...)``) threaded through every
@@ -11,9 +12,19 @@ injection & retry knobs"):
     :class:`RetryPolicy` (attempts, backoff + deterministic jitter,
     deadline, retryable-vs-fatal classification) those layers share;
   - :mod:`~spark_rapids_ml_tpu.robustness.degrade` — the
-    ``TPUML_DEGRADE``-gated CPU fallback for single-process fits.
+    ``TPUML_DEGRADE``-gated CPU fallback for single-process fits;
+  - :mod:`~spark_rapids_ml_tpu.robustness.checkpoint` — segmented-fit
+    checkpoint/restore (``TPUML_CHECKPOINT_*``): async atomic solver
+    snapshots, validated mid-solve resume, elastic gang restart.
 """
 
+from spark_rapids_ml_tpu.robustness.checkpoint import (
+    CheckpointWriteWarning,
+    FitCheckpointer,
+    data_fingerprint,
+    params_hash,
+    replicate_state_onto_mesh,
+)
 from spark_rapids_ml_tpu.robustness.degrade import (
     DegradationWarning,
     degrade_mode,
@@ -34,16 +45,21 @@ from spark_rapids_ml_tpu.robustness.retry import (
 )
 
 __all__ = [
+    "CheckpointWriteWarning",
     "DegradationWarning",
+    "FitCheckpointer",
     "InjectedFault",
     "RetryExhaustedError",
     "RetryPolicy",
     "arm",
     "classify",
+    "data_fingerprint",
     "default_policy",
     "degrade_mode",
     "disarm",
     "fault_point",
     "inject",
+    "params_hash",
+    "replicate_state_onto_mesh",
     "run_degradable",
 ]

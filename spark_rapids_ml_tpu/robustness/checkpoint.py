@@ -1,0 +1,384 @@
+"""Checkpoint/restore for iterative fits — preemption-tolerant solvers.
+
+The reference delegates ALL fault handling to Spark's task retry, which
+restarts a failed fit from iteration 0; on preemptible pods that makes a
+long Lloyd/L-BFGS/FISTA/UMAP fit effectively un-runnable, because every
+solver executes as one jitted ``lax.while_loop`` with no externally
+visible intermediate state. This module is the restartable-state half of
+the fix (the segmented solvers in ``ops/`` are the other half):
+
+  - **Segmentation** — the ops layer exposes each solver's full state
+    (centers/weights, optimizer state, iteration counter, RNG key data,
+    convergence scalars) as a pytree between jitted segments of
+    ``TPUML_CHECKPOINT_EVERY`` inner iterations. ``0`` (the default)
+    keeps the seed's single-program path: same compiles, same perf,
+    byte-identical results.
+  - **Async atomic snapshots** — :meth:`FitCheckpointer.save_async`
+    hands the state pytree to a background thread; the device→host copy
+    and the file write happen there, never stalling the next segment's
+    dispatch. Files land through the temp-sibling + ``os.replace``
+    writer (``core/persistence.py::atomic_file_write``) under
+    ``TPUML_CHECKPOINT_DIR``, keyed by estimator uid + param hash.
+  - **Validated restore** — :meth:`FitCheckpointer.restore_latest` walks
+    checkpoints newest-first, rejecting wrong schema versions, foreign
+    param hashes, mismatched data fingerprints, and truncated/corrupt
+    files (each rejection falls back to the previous snapshot), and
+    resumes mid-solve with bit-identical results.
+  - **Counters** — ``checkpoint.write`` / ``checkpoint.restore`` /
+    ``checkpoint.skipped_stale`` / ``checkpoint.corrupt`` plus the
+    driver-side ``checkpoint.segments`` / ``checkpoint.solver_iters``
+    totals ride the ``utils/tracing.py`` registry, so chaos tests assert
+    "the resumed fit executed strictly fewer iterations" on counters,
+    not log scrapes.
+
+Identity: a checkpoint belongs to (estimator uid, param hash, data
+fingerprint). Resuming across processes therefore needs a STABLE uid —
+pass one to the estimator constructor (``KMeans(uid="job-42")``), the
+way a launcher that resubmits a preempted gang already names its job.
+
+Fault sites: ``checkpoint.write`` (honors ``:torn`` — a kill mid-file
+that leaves a truncated artifact at the final path), ``checkpoint.restore``
+(one read attempt), and ``checkpoint.segment`` (the preemption point
+between segments, where chaos tests kill a fit mid-solve).
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import io
+import json
+import os
+import shutil
+import threading
+import warnings
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.robustness.faults import InjectedFault, fault_point
+from spark_rapids_ml_tpu.utils.envknobs import env_int, env_str
+from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+SCHEMA_VERSION = 1
+
+# Env knobs (docs/PARITY.md "Checkpoint & resume knobs").
+EVERY_ENV = "TPUML_CHECKPOINT_EVERY"
+DIR_ENV = "TPUML_CHECKPOINT_DIR"
+KEEP_ENV = "TPUML_CHECKPOINT_KEEP"
+UMAP_ENV = "TPUML_CHECKPOINT_UMAP"
+
+
+def checkpoint_every() -> int:
+    """Inner iterations per jitted segment; 0 (default) disables
+    checkpointing and keeps the monolithic single-program solvers."""
+    return env_int(EVERY_ENV, 0, minimum=0)
+
+
+def checkpoint_dir() -> Optional[str]:
+    return env_str(DIR_ENV)
+
+
+def umap_opt_in() -> bool:
+    """UMAP layout checkpointing is opt-in on top of the global knobs:
+    its kNN/spectral stages are recomputed (deterministically) on every
+    resume, so segmentation only pays off for long epoch schedules."""
+    return bool(env_int(UMAP_ENV, 0, minimum=0))
+
+
+class CheckpointWriteWarning(UserWarning):
+    """A snapshot write failed. Checkpointing is best-effort: the fit
+    continues (losing at most the failed snapshot's progress window)."""
+
+
+def params_hash(instance) -> str:
+    """Stable hash of an estimator's resolved param map (defaults +
+    explicit sets) and class — the "same fit?" half of checkpoint
+    identity. maxIter/tol/seed/regParam/... all enter, so a changed
+    param can never resume from a foreign solve."""
+    merged = {p.name: v for p, v in instance._defaultParamMap.items()}
+    merged.update({p.name: v for p, v in instance._paramMap.items()})
+    payload = json.dumps(
+        {"class": type(instance).__name__, "params": merged},
+        sort_keys=True,
+        default=repr,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def data_fingerprint(*arrays) -> str:
+    """Cheap deterministic fingerprint of the fit inputs: shape, dtype,
+    per-column sums, and (when the array is fully addressable) sampled
+    rows — O(n·d) reduction work on device, O(d) bytes pulled to host.
+    A checkpoint from different data must never be resumed: the solver
+    state would be valid algebra over the wrong dataset."""
+    import jax.numpy as jnp
+
+    h = hashlib.sha256()
+    for a in arrays:
+        if a is None:
+            h.update(b"<none>")
+            continue
+        a_shape = tuple(getattr(a, "shape", ()))
+        h.update(repr((a_shape, str(getattr(a, "dtype", "?")))).encode())
+        if not a_shape:
+            h.update(np.asarray(a, dtype=np.float64).tobytes())
+            continue
+        # Column sums survive sharding (a global-array reduction works on
+        # every process); row samples need addressable rows.
+        sums = np.asarray(jnp.sum(jnp.asarray(a), axis=0), dtype=np.float64)
+        h.update(sums.tobytes())
+        if getattr(a, "is_fully_addressable", True):
+            n = a_shape[0]
+            for i in {0, n // 2, n - 1}:
+                h.update(np.asarray(a[i], dtype=np.float64).tobytes())
+    return h.hexdigest()
+
+
+def _tree_flatten(state) -> Tuple[list, Any]:
+    from jax import tree_util
+
+    return tree_util.tree_flatten(state)
+
+
+def _leaf_compatible(leaf: np.ndarray, template) -> bool:
+    """Shape must match exactly; float/bool dtypes must match exactly
+    (width IS the numerics contract); integer leaves may differ in WIDTH
+    only — an eagerly-built template can carry an int64 counter where
+    the jitted segment canonicalized the same weak-typed literal to
+    int32 (optax linesearch iteration counts do this), and any-width
+    integers restore exactly."""
+    if leaf.shape != tuple(np.shape(template)):
+        return False
+    td = np.dtype(getattr(template, "dtype", type(template)))
+    if leaf.dtype == td:
+        return True
+    return leaf.dtype.kind in "iu" and td.kind in "iu"
+
+
+class FitCheckpointer:
+    """One fit's checkpoint stream: async atomic writes, validated
+    newest-first restore, bounded retention.
+
+    Duck-typed surface the segmented solver drivers use:
+    ``every`` (segment length), ``restore_latest(template)``,
+    ``save_async(step, state)``, ``wait()``, ``finalize_success()``.
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        uid: str,
+        param_hash: str,
+        data_fp: str,
+        every: int,
+        keep: int = 2,
+        solver: str = "",
+    ):
+        self.run_dir = run_dir
+        self.uid = uid
+        self.param_hash = param_hash
+        self.data_fp = data_fp
+        self.every = every
+        self.keep = keep
+        self.solver = solver
+        self._pending: Optional[threading.Thread] = None
+
+    @classmethod
+    def for_fit(cls, instance, solver: str, data: Sequence = ()) -> Optional["FitCheckpointer"]:
+        """The estimator entry (core/estimator.py): None unless BOTH
+        ``TPUML_CHECKPOINT_DIR`` and a positive ``TPUML_CHECKPOINT_EVERY``
+        are set — the disabled path must not even compute a fingerprint
+        (zero device work, zero extra compiles)."""
+        every = checkpoint_every()
+        base = checkpoint_dir()
+        if every <= 0 or not base:
+            return None
+        ph = params_hash(instance)
+        run_dir = os.path.join(base, f"{instance.uid}-{ph[:12]}")
+        return cls(
+            run_dir,
+            uid=instance.uid,
+            param_hash=ph,
+            data_fp=data_fingerprint(*data),
+            every=every,
+            keep=env_int(KEEP_ENV, 2, minimum=1),
+            solver=solver,
+        )
+
+    # --- restore ---
+
+    def restore_latest(self, template) -> Optional[Tuple[int, Any]]:
+        """Newest valid checkpoint as ``(step, state)`` restored into
+        ``template``'s pytree structure, or None to start from scratch.
+
+        Validation, newest-first with fallback: schema version, uid,
+        param hash, solver, data fingerprint (mismatch → stale, skipped),
+        then leaf count/shape/dtype against the template (corrupt or
+        foreign state → skipped). A file that cannot even be read —
+        truncated by a torn write, or a ``checkpoint.restore`` fault —
+        counts as corrupt and falls back to the previous snapshot.
+        """
+        from jax import tree_util
+
+        t_leaves, treedef = _tree_flatten(template)
+        for path in sorted(
+            glob.glob(os.path.join(self.run_dir, "ckpt-*.npz")), reverse=True
+        ):
+            try:
+                fault_point("checkpoint.restore")
+                with np.load(path, allow_pickle=False) as z:
+                    meta = json.loads(str(z["__meta__"][()]))
+                    leaves = [z[f"leaf{i}"] for i in range(int(meta["n_leaves"]))]
+            except InjectedFault as exc:
+                if exc.fatal:
+                    raise
+                bump_counter("checkpoint.corrupt")
+                continue
+            except Exception:
+                # Truncated zip, missing keys, unreadable JSON — all the
+                # shapes a kill mid-write (or bit rot) leaves behind.
+                bump_counter("checkpoint.corrupt")
+                continue
+            if (
+                meta.get("schema") != SCHEMA_VERSION
+                or meta.get("uid") != self.uid
+                or meta.get("param_hash") != self.param_hash
+                or meta.get("solver") != self.solver
+                or meta.get("data_fingerprint") != self.data_fp
+            ):
+                bump_counter("checkpoint.skipped_stale")
+                continue
+            if len(leaves) != len(t_leaves) or not all(
+                _leaf_compatible(l, t) for l, t in zip(leaves, t_leaves)
+            ):
+                bump_counter("checkpoint.skipped_stale")
+                continue
+            step = int(meta["step"])
+            bump_counter("checkpoint.restore")
+            bump_counter("checkpoint.restore.steps", step)
+            return step, tree_util.tree_unflatten(treedef, leaves)
+        return None
+
+    # --- save ---
+
+    def save_async(self, step: int, state) -> None:
+        """Snapshot ``state`` at ``step`` on a background thread.
+
+        The pytree is flattened on the caller's thread (cheap, no sync);
+        the blocking device→host copies, the serialization, and the
+        atomic write all happen off-thread, so the solver dispatches its
+        next segment immediately. At most one write is in flight —
+        ordering is preserved by joining the previous one first (a join
+        that only waits when writes are slower than whole segments)."""
+        leaves, _ = _tree_flatten(state)
+        self.wait()
+        t = threading.Thread(
+            target=self._write, args=(step, leaves), daemon=True
+        )
+        t.start()
+        self._pending = t
+
+    def _write(self, step: int, leaves: list) -> None:
+        from spark_rapids_ml_tpu.core.persistence import atomic_file_write
+
+        final = os.path.join(self.run_dir, f"ckpt-{step:08d}.npz")
+        try:
+            host = [np.asarray(l) for l in leaves]  # device→host blocks HERE
+            meta = {
+                "schema": SCHEMA_VERSION,
+                "uid": self.uid,
+                "param_hash": self.param_hash,
+                "data_fingerprint": self.data_fp,
+                "solver": self.solver,
+                "step": step,
+                "n_leaves": len(host),
+            }
+            buf = io.BytesIO()
+            np.savez(
+                buf,
+                __meta__=np.asarray(json.dumps(meta)),
+                **{f"leaf{i}": a for i, a in enumerate(host)},
+            )
+            data = buf.getvalue()
+            os.makedirs(self.run_dir, exist_ok=True)
+            try:
+                fault_point("checkpoint.write")
+            except InjectedFault as exc:
+                if exc.torn:
+                    # A kill mid-file: a truncated artifact lands at the
+                    # FINAL path (as on a filesystem without atomic
+                    # rename) — restore_latest must reject it.
+                    with open(final, "wb") as f:
+                        f.write(data[: max(1, len(data) // 3)])
+                raise
+            atomic_file_write(final, data)
+            bump_counter("checkpoint.write")
+            self._prune()
+        except BaseException as exc:
+            bump_counter("checkpoint.write_failed")
+            warnings.warn(
+                CheckpointWriteWarning(
+                    f"checkpoint write for step {step} of {self.uid} failed "
+                    f"({type(exc).__name__}: {exc}); the fit continues and "
+                    "at most this snapshot's progress window is lost"
+                ),
+                stacklevel=2,
+            )
+
+    def _prune(self) -> None:
+        files = sorted(glob.glob(os.path.join(self.run_dir, "ckpt-*.npz")))
+        for stale in files[: max(len(files) - self.keep, 0)]:
+            try:
+                os.remove(stale)
+            except OSError:  # pragma: no cover - best-effort retention
+                pass
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) has committed."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+
+    def finalize_success(self) -> None:
+        """The fit completed: its checkpoints are spent. Flush the last
+        write, then drop the run directory so a LATER fit with the same
+        identity starts fresh instead of short-circuiting to the old
+        converged state."""
+        self.wait()
+        shutil.rmtree(self.run_dir, ignore_errors=True)
+        bump_counter("checkpoint.completed")
+
+
+def segment_boundary(checkpointer: Optional["FitCheckpointer"] = None) -> None:
+    """The preemption point between solver segments — one named fault
+    site shared by every segmented driver, so chaos tests kill a fit
+    mid-solve at a deterministic iteration. With a fault plan armed the
+    in-flight snapshot is flushed FIRST, so an injected kill lands after
+    a known checkpoint committed (deterministic chaos); with no plan —
+    production — this is one None check and the write stays async."""
+    from spark_rapids_ml_tpu.robustness.faults import active_plan
+
+    if active_plan() is None:
+        return
+    if checkpointer is not None:
+        checkpointer.wait()
+    fault_point("checkpoint.segment")
+
+
+def replicate_state_onto_mesh(state, mesh):
+    """Reshard a host (or single-device) solver-state pytree onto a mesh
+    as fully REPLICATED arrays — the elastic-gang-resume placement: a
+    relaunched gang restores host state from disk on every process and
+    rebuilds the same global arrays its segment programs expect.
+    Process-safe: every process contributes its identical host copy."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P())
+
+    def place(leaf):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_process_local_data(sharding, arr, arr.shape)
+
+    return jax.tree_util.tree_map(place, state)

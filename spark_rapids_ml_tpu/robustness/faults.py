@@ -19,6 +19,12 @@ Sites (the complete vocabulary — a spec naming anything else is an error):
   - ``collective.psum``         the cross-process moment merge
                                 (parallel/distributed.py)
   - ``persistence.write``       model data write (core/persistence.py)
+  - ``checkpoint.write``        one solver-state snapshot write
+                                (robustness/checkpoint.py)
+  - ``checkpoint.restore``      one checkpoint-file read attempt
+                                (robustness/checkpoint.py)
+  - ``checkpoint.segment``      the preemption point between solver
+                                segments (the segmented-fit drivers)
 
 Schedules are counters, not random draws — the same spec always fails the
 same invocations, so a chaos test is exactly reproducible:
@@ -26,6 +32,10 @@ same invocations, so a chaos test is exactly reproducible:
   - ``site=N``           fail the first N invocations, then succeed
   - ``site=always``      fail every invocation
   - append ``:fatal``    raise a fault classified FATAL (never retried)
+  - append ``:torn``     a TORN write: the site is killed mid-file, so a
+                         truncated artifact lands at the FINAL path (only
+                         ``checkpoint.write`` honors it — the chaos proof
+                         that restore rejects corrupt checkpoints)
 
 Specs come from the ``TPUML_FAULTS`` env var (semicolon- or
 comma-separated entries, e.g. ``persistence.write=1;barrier.attempt=2``)
@@ -46,6 +56,9 @@ KNOWN_SITES = frozenset(
         "barrier.attempt",
         "collective.psum",
         "persistence.write",
+        "checkpoint.write",
+        "checkpoint.restore",
+        "checkpoint.segment",
     }
 )
 
@@ -57,13 +70,20 @@ FAULTS_ENV = "TPUML_FAULTS"
 class InjectedFault(RuntimeError):
     """The error an armed fault site raises. Transient by default (the
     retry layer classifies it retryable); ``fatal=True`` models a
-    non-recoverable failure (classified fatal, never retried)."""
+    non-recoverable failure (classified fatal, never retried);
+    ``torn=True`` models a kill mid-file — the site that catches it
+    leaves a truncated artifact at the final path before re-raising."""
 
-    def __init__(self, site: str, invocation: int, fatal: bool = False):
+    def __init__(
+        self, site: str, invocation: int, fatal: bool = False, torn: bool = False
+    ):
         self.site = site
         self.invocation = invocation
         self.fatal = fatal
+        self.torn = torn
         kind = "fatal" if fatal else "transient"
+        if torn:
+            kind += " torn-write"
         super().__init__(
             f"injected {kind} fault at site {site!r} (invocation {invocation})"
         )
@@ -71,20 +91,23 @@ class InjectedFault(RuntimeError):
 
 class Schedule:
     """One site's failure schedule: fail invocations [0, count) — or all
-    of them for ``count=ALWAYS`` — raising fatal or transient faults."""
+    of them for ``count=ALWAYS`` — raising fatal, transient, or torn
+    faults."""
 
-    def __init__(self, count: int, fatal: bool = False):
+    def __init__(self, count: int, fatal: bool = False, torn: bool = False):
         if count != ALWAYS and count < 0:
             raise ValueError(f"schedule count must be >= 0 or ALWAYS, got {count}")
         self.count = count
         self.fatal = fatal
+        self.torn = torn
 
     def should_fail(self, invocation: int) -> bool:
         return self.count == ALWAYS or invocation < self.count
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         n = "always" if self.count == ALWAYS else str(self.count)
-        return f"Schedule({n}{', fatal' if self.fatal else ''})"
+        flags = (", fatal" if self.fatal else "") + (", torn" if self.torn else "")
+        return f"Schedule({n}{flags})"
 
 
 def parse_spec(spec: str) -> Dict[str, Schedule]:
@@ -107,10 +130,16 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
                 f"{sorted(KNOWN_SITES)}"
             )
         sched = sched.strip()
-        fatal = False
-        if sched.endswith(":fatal"):
-            fatal = True
-            sched = sched[: -len(":fatal")]
+        fatal = torn = False
+        while True:
+            if sched.endswith(":fatal"):
+                fatal = True
+                sched = sched[: -len(":fatal")]
+            elif sched.endswith(":torn"):
+                torn = True
+                sched = sched[: -len(":torn")]
+            else:
+                break
         if sched == "always":
             count = ALWAYS
         else:
@@ -125,7 +154,7 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
                 raise ValueError(
                     f"schedule count for site {site!r} must be >= 0, got {count}"
                 )
-        plan[site] = Schedule(count, fatal=fatal)
+        plan[site] = Schedule(count, fatal=fatal, torn=torn)
     return plan
 
 
@@ -155,7 +184,9 @@ class FaultPlan:
             self._counts[site] = invocation + 1
             if sched.should_fail(invocation):
                 self.fired.append((site, invocation))
-                raise InjectedFault(site, invocation, fatal=sched.fatal)
+                raise InjectedFault(
+                    site, invocation, fatal=sched.fatal, torn=sched.torn
+                )
 
 
 # The active plan. None (the production state) makes fault_point a single

@@ -22,7 +22,10 @@ from deep inside an attempt.
 
 Every attempt runs inside a ``utils/tracing.py`` range
 (``retry:<name>#<attempt>``) so recovery is visible in profiles exactly
-like the compute it protects.
+like the compute it protects — and every attempt/exhaustion bumps the
+counter registry (``retry.<name>.attempts`` / ``retry.<name>.exhausted``),
+so chaos runs and benchmarks assert on retry counts instead of parsing
+logs.
 """
 
 from __future__ import annotations
@@ -138,7 +141,11 @@ class RetryPolicy:
         name: str,
         on_retry: Optional[Callable[[int, BaseException], None]] = None,
     ) -> T:
-        from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+        from spark_rapids_ml_tpu.utils.tracing import (
+            TraceColor,
+            TraceRange,
+            bump_counter,
+        )
 
         start = time.monotonic()
         last: Optional[BaseException] = None
@@ -149,10 +156,12 @@ class RetryPolicy:
             ):
                 # last is non-None here: attempt 0 starts before any
                 # deadline check can trip (time 0 <= deadline).
+                bump_counter(f"retry.{name}.exhausted")
                 raise RetryExhaustedError(
                     name, attempt, last, f"deadline of {self.deadline}s exceeded"
                 ) from last
             try:
+                bump_counter(f"retry.{name}.attempts")
                 with TraceRange(f"retry:{name}#{attempt}", TraceColor.YELLOW):
                     return fn()
             except BaseException as exc:
@@ -164,6 +173,7 @@ class RetryPolicy:
             delay = self.backoff(name, attempt + 1)
             if delay > 0 and attempt + 1 < self.max_attempts:
                 time.sleep(delay)
+        bump_counter(f"retry.{name}.exhausted")
         raise RetryExhaustedError(
             name, self.max_attempts, last, "retry budget exhausted"
         ) from last

@@ -29,6 +29,7 @@ under both (tests/spark_contract_suite.py::TestBarrierGangRecovery).
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Iterable, Iterator, Optional
 
 from spark_rapids_ml_tpu.robustness.degrade import run_degradable
@@ -48,6 +49,7 @@ def barrier_gang_run(
     rdd,
     task_fn: Callable[[Optional[object], Iterator], Iterable],
     policy: Optional[RetryPolicy] = None,
+    checkpoint_dir: Optional[str] = None,
 ) -> list:
     """Run ``task_fn(barrier_ctx, partition_iterator)`` over every
     partition as ONE barrier stage and return the collected outputs.
@@ -71,11 +73,18 @@ def barrier_gang_run(
     non-gang) stage with ``ctx=None`` — there is no cohort left to
     strand — under a structured :class:`DegradationWarning`.
 
-    Fits are stateless one-pass reductions in this framework, so the
-    relaunched gang simply refits from the same lineage — no partial
-    state to reconcile (iterative fits resume from their last persisted
-    model via the warm starts: ``KMeans.setInitialModel``,
-    ``UMAP.setInitEmbedding``).
+    One-pass reductions simply refit from the same lineage on relaunch.
+    ITERATIVE fits do better: pass ``checkpoint_dir`` (a path every
+    executor can reach — the elastic-resume handoff) and each gang
+    member exports it as ``TPUML_CHECKPOINT_DIR`` before running
+    ``task_fn``, so a fit inside the task checkpoints its solver state
+    (robustness/checkpoint.py) and a gang resubmitted after a dead
+    worker — detected via the heartbeat timeout — resumes mid-solve
+    from the last snapshot instead of iteration 0, resharding the
+    restored state onto the fresh mesh
+    (``parallel.distributed.replicate_state_onto_mesh``). Give the
+    estimators STABLE uids: checkpoint identity is uid + param hash.
+    Every driver-side resubmission bumps the ``gang.resubmit`` counter.
 
     Each gang member declares the ``barrier.attempt`` fault site
     (robustness.faults) right after the launch barrier, so chaos tests
@@ -87,6 +96,10 @@ def barrier_gang_run(
 
         from spark_rapids_ml_tpu.robustness.faults import fault_point
 
+        if checkpoint_dir is not None:
+            from spark_rapids_ml_tpu.robustness.checkpoint import DIR_ENV
+
+            os.environ[DIR_ENV] = checkpoint_dir
         ctx = BarrierTaskContext.get()
         if ctx is not None:
             ctx.barrier()
@@ -106,10 +119,13 @@ def barrier_gang_run(
             max_attempts=env_int(BARRIER_RESUBMITS_ENV, 1, minimum=1)
         )
 
+    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
     return run_degradable(
         lambda: policy.run(
             lambda: rdd.barrier().mapPartitions(wrapped).collect(),
             name="barrier.stage",
+            on_retry=lambda attempt, exc: bump_counter("gang.resubmit"),
         ),
         lambda: rdd.mapPartitions(fallback).collect(),
         what="barrier gang fit",

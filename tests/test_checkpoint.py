@@ -1,0 +1,544 @@
+"""Checkpoint/resume suite — preemption-tolerant segmented fits.
+
+The robustness/checkpoint.py contract, counter-asserted end to end:
+
+  (a) segmented solvers with the ``TPUML_CHECKPOINT_*`` knobs OFF are
+      bit-identical to seed behavior and add ZERO compiles (the disabled
+      path never leaves the monolithic single-program solvers);
+  (b) a fit killed mid-solve — injected fault in-process, or a worker
+      process dying on a fatal fault — then refit RESUMES from the last
+      checkpoint, matches the uninterrupted model bit-for-bit, and
+      executes strictly fewer solver iterations than an iteration-0
+      restart (asserted via the counter registry, not logs);
+  (c) stale checkpoints (foreign params, foreign data) are ignored, and
+      truncated/torn/corrupt files fall back to the previous snapshot;
+  (d) the elastic gang path: a barrier gang killed mid-fit relaunches
+      and resumes from the shared checkpoint dir instead of iteration 0.
+"""
+
+import glob
+import logging
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.robustness import (
+    FitCheckpointer,
+    InjectedFault,
+    RetryExhaustedError,
+    RetryPolicy,
+    inject,
+)
+from spark_rapids_ml_tpu.robustness.checkpoint import DIR_ENV, EVERY_ENV, UMAP_ENV
+from spark_rapids_ml_tpu.robustness.faults import disarm, parse_spec
+from spark_rapids_ml_tpu.utils.tracing import (
+    clear_counters,
+    counter_value,
+    counters,
+)
+
+_STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)), "pyspark_stub")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    disarm()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    clear_counters("checkpoint")
+    clear_counters("gang")
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clean_ckpt_env(monkeypatch):
+    """Each test starts from the disabled default; ``ckpt_dir`` arms the
+    knobs on top (autouse fixtures instantiate first)."""
+    for var in (DIR_ENV, EVERY_ENV, UMAP_ENV):
+        monkeypatch.delenv(var, raising=False)
+
+
+@pytest.fixture
+def ckpt_dir(tmp_path, monkeypatch):
+    """A per-test checkpoint base dir with the knobs armed. CI points
+    ``TPUML_TEST_CHECKPOINT_DIR`` at an artifact path so a failing run
+    uploads the actual checkpoint files."""
+    base = os.environ.get("TPUML_TEST_CHECKPOINT_DIR")
+    if base:
+        root = os.path.join(base, tmp_path.name)
+        os.makedirs(root, exist_ok=True)
+    else:
+        root = str(tmp_path / "ckpts")
+    monkeypatch.setenv(DIR_ENV, root)
+    monkeypatch.setenv(EVERY_ENV, "2")
+    return root
+
+
+@pytest.fixture
+def data(rng):
+    return rng.normal(size=(200, 5))
+
+
+def _kmeans_fit(x, uid="ck-kmeans", max_iter=16, tol=0.0):
+    from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+    m = (
+        KMeans(uid=uid).setK(6).setMaxIter(max_iter).setTol(tol).setSeed(3).fit(x)
+    )
+    return m, (np.asarray(m.clusterCenters()).tobytes(),
+               np.float64(m.trainingCost).tobytes(), m.numIter)
+
+
+def _logistic_fit(x, uid="ck-logreg"):
+    from spark_rapids_ml_tpu.models.logistic_regression import LogisticRegression
+
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+    m = LogisticRegression(uid=uid).setMaxIter(40).fit((x, y))
+    return m, (np.asarray(m.coefficients).tobytes(),
+               np.float64(m.intercept).tobytes(), m.numIter)
+
+
+def _linreg_enet_fit(x, uid="ck-linreg"):
+    from spark_rapids_ml_tpu.models.linear_regression import LinearRegression
+
+    y = x @ np.arange(1.0, 6.0) + 0.5
+    m = (
+        LinearRegression(uid=uid)
+        .setRegParam(0.1)
+        .setElasticNetParam(0.5)
+        .fit((x, y))
+    )
+    return m, (np.asarray(m.coefficients).tobytes(),
+               np.float64(m.intercept).tobytes())
+
+
+_FITS = {
+    "kmeans": _kmeans_fit,
+    "logistic": _logistic_fit,
+    "linreg_enet": _linreg_enet_fit,
+}
+
+
+class TestDisabledIsSeedBehavior:
+    """(a) knobs off → the monolithic path, bit-identical, zero extra
+    compiles, zero checkpoint activity."""
+
+    @pytest.mark.parametrize("family", sorted(_FITS))
+    def test_partial_knobs_stay_disabled(self, family, data, tmp_path, monkeypatch):
+        _, want = _FITS[family](data)  # both knobs unset
+        monkeypatch.setenv(DIR_ENV, str(tmp_path / "c"))  # dir without EVERY
+        _, got_dir_only = _FITS[family](data)
+        monkeypatch.delenv(DIR_ENV)
+        monkeypatch.setenv(EVERY_ENV, "2")  # EVERY without dir
+        _, got_every_only = _FITS[family](data)
+        assert got_dir_only == want and got_every_only == want
+        assert counters("checkpoint") == {}
+        assert not os.path.exists(str(tmp_path / "c"))
+
+    def test_disabled_warm_fit_zero_compiles(self, data, caplog):
+        """The acceptance bar: with checkpointing disabled (default) the
+        warm fit path compiles NOTHING new — asserted against jax's own
+        compile log, the serving-suite discipline."""
+        _FITS["kmeans"](data)  # cold: populate the jit caches
+        jax.config.update("jax_log_compiles", True)
+        try:
+            with caplog.at_level(logging.WARNING, logger="jax._src.dispatch"):
+                _FITS["kmeans"](data)
+        finally:
+            jax.config.update("jax_log_compiles", False)
+        compile_lines = [
+            r for r in caplog.records if "compil" in r.getMessage().lower()
+        ]
+        assert compile_lines == []
+        assert counter_value("checkpoint.segments") == 0
+
+
+class TestSegmentedParity:
+    """(a) continued: knobs ON, uninterrupted — segmented solvers are
+    bit-identical to the monolithic programs they replace."""
+
+    @pytest.mark.parametrize("family", sorted(_FITS))
+    def test_segmented_equals_monolithic(self, family, data, ckpt_dir, monkeypatch):
+        monkeypatch.setenv(EVERY_ENV, "0")
+        _, want = _FITS[family](data)
+        monkeypatch.setenv(EVERY_ENV, "3")
+        _, got = _FITS[family](data)
+        assert got == want
+        assert counter_value("checkpoint.segments") >= 1
+        assert counter_value("checkpoint.write") >= 1
+        # A completed fit retires its own snapshots.
+        assert counter_value("checkpoint.completed") == 1
+        assert glob.glob(os.path.join(ckpt_dir, "*", "ckpt-*.npz")) == []
+
+    def test_umap_is_opt_in(self, rng, ckpt_dir, monkeypatch):
+        from spark_rapids_ml_tpu.models.umap import UMAP
+
+        x = rng.normal(size=(50, 4)).astype(np.float32)
+
+        def fit():
+            return np.asarray(
+                UMAP(uid="ck-umap").setNComponents(2).setSeed(1).fit(x).embedding
+            )
+
+        monkeypatch.setenv(EVERY_ENV, "0")
+        want = fit()
+        # Global knobs alone do NOT checkpoint UMAP …
+        monkeypatch.setenv(EVERY_ENV, "64")
+        assert fit().tobytes() == want.tobytes()
+        assert counter_value("checkpoint.segments") == 0
+        # … the opt-in env does, bit-identically.
+        monkeypatch.setenv(UMAP_ENV, "1")
+        assert fit().tobytes() == want.tobytes()
+        assert counter_value("checkpoint.segments") >= 1
+
+
+class TestCrashResume:
+    """(b) kill mid-solve, refit, resume: bit-identical and strictly
+    fewer solver iterations than an iteration-0 restart — on counters."""
+
+    @pytest.mark.parametrize("family", ["kmeans", "logistic"])
+    def test_fatal_fault_mid_fit_then_resume(self, family, data, ckpt_dir):
+        _, want = _FITS[family](data)  # uninterrupted, checkpointing ON
+        full_iters = counter_value("checkpoint.solver_iters")
+        assert full_iters > 0
+
+        clear_counters("checkpoint")
+        with inject("checkpoint.segment=always:fatal"):
+            with pytest.raises(InjectedFault):
+                _FITS[family](data)
+        # The kill left committed snapshot(s) behind …
+        assert counter_value("checkpoint.write") >= 1
+        assert glob.glob(os.path.join(ckpt_dir, "*", "ckpt-*.npz"))
+
+        clear_counters("checkpoint")
+        _, got = _FITS[family](data)
+        assert got == want  # bit-identical to the uninterrupted fit
+        assert counter_value("checkpoint.restore") == 1
+        assert counter_value("checkpoint.restore.steps") > 0
+        resumed_iters = counter_value("checkpoint.solver_iters")
+        assert resumed_iters < full_iters  # strictly fewer than restart-at-0
+        assert resumed_iters + counter_value("checkpoint.restore.steps") == full_iters
+
+    def test_resumed_matches_checkpointing_off(self, data, ckpt_dir, monkeypatch):
+        """The resumed model also matches the plain (knobs-off) fit —
+        resume parity is against SEED behavior, not merely against the
+        segmented driver."""
+        monkeypatch.setenv(EVERY_ENV, "0")
+        _, want = _FITS["kmeans"](data)
+        monkeypatch.setenv(EVERY_ENV, "2")
+        with inject("checkpoint.segment=1:fatal"):
+            with pytest.raises(InjectedFault):
+                _FITS["kmeans"](data)
+        _, got = _FITS["kmeans"](data)
+        assert got == want
+
+
+@pytest.mark.slow
+class TestWorkerKillResume:
+    """(b) the multiproc form: a WORKER PROCESS dies mid-fit (fatal
+    injected fault via TPUML_FAULTS — the launcher-style, code-free
+    injection), the driver refits in a fresh interpreter state and
+    resumes from the dead worker's checkpoints."""
+
+    _SCRIPT = """
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+x = np.random.default_rng(7).normal(size=(200, 5))
+m = KMeans(uid="ck-worker").setK(6).setMaxIter(16).setTol(0.0).setSeed(3).fit(x)
+print("UNEXPECTED-COMPLETION")
+"""
+
+    def test_killed_worker_then_resume(self, ckpt_dir, tmp_path, monkeypatch):
+        script = tmp_path / "worker.py"
+        script.write_text(self._SCRIPT)
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+                DIR_ENV: ckpt_dir,
+                EVERY_ENV: "2",
+                # the worker dies at the first segment boundary, mid-solve
+                "TPUML_FAULTS": "checkpoint.segment=always:fatal",
+            }
+        )
+        proc = subprocess.run(
+            [sys.executable, str(script)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode != 0, proc.stdout + proc.stderr
+        assert "UNEXPECTED-COMPLETION" not in proc.stdout
+        assert "checkpoint.segment" in proc.stderr
+        assert glob.glob(os.path.join(ckpt_dir, "*", "ckpt-*.npz"))
+
+        # The driver-side refit: same uid/params/data → resumes.
+        x = np.random.default_rng(7).normal(size=(200, 5))
+        monkeypatch.setenv(EVERY_ENV, "0")
+        _, want = _kmeans_fit(x, uid="ck-worker")
+        monkeypatch.setenv(EVERY_ENV, "2")
+        clear_counters("checkpoint")
+        _, got = _kmeans_fit(x, uid="ck-worker")
+        assert got == want
+        assert counter_value("checkpoint.restore") == 1
+        assert counter_value("checkpoint.restore.steps") > 0
+
+
+class TestStaleAndCorrupt:
+    """(c) restore validation: stale identities are ignored; torn and
+    truncated files fall back to the previous snapshot."""
+
+    def _crash_kmeans(self, x):
+        with inject("checkpoint.segment=always:fatal"):
+            with pytest.raises(InjectedFault):
+                _kmeans_fit(x)
+
+    def test_changed_params_never_resume(self, data, ckpt_dir):
+        self._crash_kmeans(data)
+        clear_counters("checkpoint")
+        # Different tol → different param hash → fresh solve, and the
+        # result matches a from-scratch fit of those params.
+        m, got = _kmeans_fit(data, tol=1e-3)
+        assert counter_value("checkpoint.restore") == 0
+        for f in glob.glob(os.path.join(ckpt_dir, "*", "ckpt-*.npz")):
+            os.remove(f)
+        _, want = _kmeans_fit(data, tol=1e-3)
+        assert got == want
+
+    def test_changed_data_is_stale(self, data, rng, ckpt_dir):
+        self._crash_kmeans(data)
+        clear_counters("checkpoint")
+        other = rng.normal(size=(200, 5))
+        _, got = _kmeans_fit(other)
+        assert counter_value("checkpoint.restore") == 0
+        assert counter_value("checkpoint.skipped_stale") >= 1
+
+    def test_torn_write_lands_truncated_and_is_rejected(self, tmp_path):
+        ck = FitCheckpointer(
+            str(tmp_path / "run"), uid="u", param_hash="p", data_fp="d", every=1
+        )
+        s1 = (jnp.arange(4.0), jnp.asarray(1))
+        s2 = (jnp.arange(4.0) * 2, jnp.asarray(2))
+        ck.save_async(1, s1)
+        ck.wait()
+        with inject("checkpoint.write=1:torn") as plan:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                ck.save_async(2, s2)
+                ck.wait()
+        assert plan.fired == [("checkpoint.write", 0)]
+        assert any("checkpoint write" in str(w.message) for w in caught)
+        # The torn file IS on disk at the final path …
+        files = sorted(os.listdir(tmp_path / "run"))
+        assert files == ["ckpt-00000001.npz", "ckpt-00000002.npz"]
+        # … and restore rejects it, falling back to the previous one.
+        clear_counters("checkpoint")
+        step, state = ck.restore_latest(template=s1)
+        assert step == 1
+        assert counter_value("checkpoint.corrupt") == 1
+        assert counter_value("checkpoint.restore") == 1
+        np.testing.assert_array_equal(np.asarray(state[0]), np.arange(4.0))
+
+    def test_manually_truncated_file_falls_back(self, tmp_path):
+        ck = FitCheckpointer(
+            str(tmp_path / "run"), uid="u", param_hash="p", data_fp="d", every=1
+        )
+        s = (jnp.arange(3.0),)
+        ck.save_async(1, s)
+        ck.wait()
+        ck.save_async(2, (jnp.arange(3.0) * 5,))
+        ck.wait()
+        newest = str(tmp_path / "run" / "ckpt-00000002.npz")
+        raw = open(newest, "rb").read()
+        with open(newest, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        step, state = ck.restore_latest(template=s)
+        assert step == 1
+        assert counter_value("checkpoint.corrupt") == 1
+
+    def test_restore_fault_site_skips_newest(self, tmp_path):
+        ck = FitCheckpointer(
+            str(tmp_path / "run"), uid="u", param_hash="p", data_fp="d", every=1
+        )
+        for i in (1, 2):
+            ck.save_async(i, (jnp.arange(3.0) * i,))
+            ck.wait()
+        with inject("checkpoint.restore=1"):
+            step, _ = ck.restore_latest(template=(jnp.arange(3.0),))
+        assert step == 1
+
+    def test_retention_keeps_last_k(self, tmp_path):
+        ck = FitCheckpointer(
+            str(tmp_path / "run"), uid="u", param_hash="p", data_fp="d",
+            every=1, keep=2,
+        )
+        for i in range(1, 6):
+            ck.save_async(i, (jnp.arange(2.0) * i,))
+            ck.wait()
+        assert sorted(os.listdir(tmp_path / "run")) == [
+            "ckpt-00000004.npz", "ckpt-00000005.npz",
+        ]
+
+    def test_torn_spec_parses(self):
+        plan = parse_spec("checkpoint.write=1:torn; checkpoint.restore=2")
+        assert plan["checkpoint.write"].torn
+        assert not plan["checkpoint.write"].fatal
+        assert not plan["checkpoint.restore"].torn
+
+
+class TestRetryCounters:
+    """Satellite: per-site retry attempts/exhaustions ride the counter
+    registry, not the logs."""
+
+    def test_attempts_counted_per_site(self):
+        clear_counters("retry.ckunit")
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        RetryPolicy(max_attempts=5, base_delay=0).run(fn, "ckunit")
+        assert counter_value("retry.ckunit.attempts") == 3
+        assert counter_value("retry.ckunit.exhausted") == 0
+
+    def test_exhaustion_counted(self):
+        clear_counters("retry.ckunit2")
+
+        def fn():
+            raise OSError("forever")
+
+        with pytest.raises(RetryExhaustedError):
+            RetryPolicy(max_attempts=2, base_delay=0).run(fn, "ckunit2")
+        assert counter_value("retry.ckunit2.attempts") == 2
+        assert counter_value("retry.ckunit2.exhausted") == 1
+
+
+class TestReinitWarning:
+    """Satellite: a second initialize() with different coordinates is no
+    longer silent — a structured warning names both values."""
+
+    @pytest.fixture
+    def initialized(self, monkeypatch):
+        from spark_rapids_ml_tpu.parallel import distributed as dist
+
+        monkeypatch.setattr(dist, "_initialized", True)
+        monkeypatch.setattr(
+            dist,
+            "_init_record",
+            {
+                "coordinator_address": "10.0.0.1:8476",
+                "num_processes": 4,
+                "process_id": 0,
+            },
+        )
+        return dist
+
+    def test_mismatch_warns_naming_both_values(self, initialized):
+        dist = initialized
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            dist.initialize(
+                coordinator_address="10.0.0.2:8476", num_processes=4, process_id=1
+            )
+        got = [w.message for w in caught if isinstance(w.message, dist.GangReinitWarning)]
+        fields = {w.field for w in got}
+        assert fields == {"coordinator_address", "process_id"}
+        addr = next(w for w in got if w.field == "coordinator_address")
+        assert "10.0.0.1:8476" in str(addr) and "10.0.0.2:8476" in str(addr)
+
+    def test_same_coordinates_stay_silent(self, initialized):
+        dist = initialized
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            dist.initialize(
+                coordinator_address="10.0.0.1:8476", num_processes=4, process_id=0
+            )
+        assert [w for w in caught if isinstance(w.message, dist.GangReinitWarning)] == []
+
+
+@pytest.fixture
+def stub_spark():
+    saved = {n: m for n, m in sys.modules.items() if n.startswith("pyspark")}
+    for n in list(saved):
+        del sys.modules[n]
+    sys.path.insert(0, _STUB)
+    try:
+        from pyspark.sql import SparkSession
+
+        yield SparkSession.builder.master("local[2]").getOrCreate()
+    finally:
+        sys.path.remove(_STUB)
+        for n in [n for n in sys.modules if n.startswith("pyspark")]:
+            del sys.modules[n]
+        sys.modules.update(saved)
+
+
+class TestElasticGangResume:
+    """(d) a barrier gang killed mid-fit relaunches (the stub's stage
+    retry) and the relaunched tasks RESUME from the shared checkpoint
+    dir instead of refitting from iteration 0."""
+
+    def _gang_fit(self, spark, x, ckdir):
+        import spark_contract_suite as suite
+
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+        from spark_rapids_ml_tpu.spark.barrier import barrier_gang_run
+
+        df = suite._vector_df(spark, x, n_parts=2)
+
+        def task(ctx, it):
+            rows = np.asarray(
+                [np.asarray(r.features.toArray(), dtype=float) for r in it]
+            )
+            m = (
+                KMeans(uid="ck-gang")
+                .setK(5)
+                .setMaxIter(12)
+                .setTol(0.0)
+                .setSeed(1)
+                .fit(rows)
+            )
+            yield np.asarray(m.clusterCenters())
+
+        return barrier_gang_run(
+            df.select("features").rdd, task, checkpoint_dir=ckdir
+        )
+
+    def test_gang_kill_resumes_from_checkpoint(
+        self, stub_spark, rng, ckpt_dir, monkeypatch
+    ):
+        monkeypatch.setenv("TPUML_RETRY_BASE_DELAY", "0")
+        x = rng.normal(size=(160, 5))
+        want = [p.tobytes() for p in self._gang_fit(stub_spark, x, ckpt_dir)]
+        clear_counters("checkpoint")
+        # Transient faults kill BOTH tasks of attempt 0 mid-solve; the
+        # stub's stage retry relaunches the whole gang.
+        with inject("checkpoint.segment=3") as plan:
+            got = [p.tobytes() for p in self._gang_fit(stub_spark, x, ckpt_dir)]
+        assert len(plan.fired) == 3
+        assert got == want
+        # The relaunched tasks restored mid-solve state instead of
+        # starting at iteration 0.
+        assert counter_value("checkpoint.restore") >= 1
+        assert counter_value("checkpoint.restore.steps") >= 1
