@@ -43,6 +43,25 @@ class Transformer(Params):
 
 class Estimator(Params):
     def fit(self, dataset: Any):
+        """Fit, instrumented: the whole call runs under a ``fit`` run
+        scope (observability/) — a fresh ``run_id`` standalone, the
+        ambient one when a caller's job scope is open — optionally inside
+        a ``TPUML_PROFILE_DIR`` profiler session, and the finished
+        :class:`~spark_rapids_ml_tpu.observability.report.RunReport`
+        (stage-timing tree, counter deltas, compile counts, checkpoint
+        activity, device memory) hangs off the model as
+        ``model.fit_report()``.
+
+        Families implement :meth:`_fit`; estimators that override
+        ``fit`` directly opt out of the instrumentation."""
+        from spark_rapids_ml_tpu.observability.report import RunRecorder
+
+        with RunRecorder("fit", type(self).__name__) as rec:
+            model = self._fit(dataset)
+        rec.attach(model)
+        return model
+
+    def _fit(self, dataset: Any):
         raise NotImplementedError
 
     def _fit_checkpointer(self, solver: str, data=()):
@@ -66,3 +85,13 @@ class Estimator(Params):
 
 class Model(Transformer, MLReadable):
     """A fitted transformer; carries a parent uid via copyValues like Spark."""
+
+    _fit_report = None
+
+    def fit_report(self):
+        """The :class:`~spark_rapids_ml_tpu.observability.report.RunReport`
+        of the fit that produced this model (stage-timing tree, counter
+        deltas, compile counts, checkpoint activity, device memory), or
+        None for models built outside an instrumented fit (loaded from
+        disk, unpickled, hand-constructed)."""
+        return self._fit_report

@@ -41,6 +41,7 @@ from spark_rapids_ml_tpu.core.data import as_partitions, is_device_array
 from spark_rapids_ml_tpu.robustness.degrade import cpu_device, run_degradable
 from spark_rapids_ml_tpu.robustness.faults import fault_point
 from spark_rapids_ml_tpu.robustness.retry import default_policy
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
 def default_dtype():
@@ -74,7 +75,22 @@ def prepare_rows(
     device_id: int = -1,
     weights: Optional[np.ndarray] = None,
 ) -> PreparedRows:
-    """Normalize any supported input into device-resident rows + mask."""
+    """Normalize any supported input into device-resident rows + mask.
+
+    Runs inside an ``ingest`` trace range (with nested ``ingest H2D``
+    ranges around each device placement) so fit reports attribute ingest
+    vs H2D vs solve time per stage."""
+    with TraceRange("ingest", TraceColor.BLUE):
+        return _prepare_rows_impl(rows, mesh, dtype, device_id, weights)
+
+
+def _prepare_rows_impl(
+    rows: Any,
+    mesh=None,
+    dtype=None,
+    device_id: int = -1,
+    weights: Optional[np.ndarray] = None,
+) -> PreparedRows:
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -109,7 +125,8 @@ def prepare_rows(
                 # quietly moving to one CPU device would change the
                 # collective topology under the caller.
                 fault_point("ingest.device_put")
-                return jax.device_put(arr, row_sharding(mesh))
+                with TraceRange("ingest H2D", TraceColor.CYAN):
+                    return jax.device_put(arr, row_sharding(mesh))
 
             x = default_policy().run(_reshard, name="ingest.device_put")
             mask = (jnp.arange(n + pad_n) < n).astype(m_dtype)
@@ -135,7 +152,8 @@ def prepare_rows(
 
         def _place():
             fault_point("ingest.device_put")
-            return jax.device_put(jnp.asarray(x_host), device)
+            with TraceRange("ingest H2D", TraceColor.CYAN):
+                return jax.device_put(jnp.asarray(x_host), device)
 
         # Single-process placement is the degradable rung: if the
         # accelerator is unavailable (or placement exhausts its retry

@@ -390,11 +390,22 @@ class MLWriter:
                 shutil.rmtree(tmp)
             self._instance._save_impl(tmp)
 
+        from spark_rapids_ml_tpu.observability.events import emit
+        from spark_rapids_ml_tpu.utils.tracing import (
+            TraceColor,
+            TraceRange,
+            bump_counter,
+        )
+
         try:
-            default_policy().run(_write_complete, name="persistence.write")
-            if os.path.exists(path):  # _overwrite, checked above
-                shutil.rmtree(path)
-            os.replace(tmp, path)
+            with TraceRange("persistence save", TraceColor.WHITE):
+                default_policy().run(_write_complete, name="persistence.write")
+                if os.path.exists(path):  # _overwrite, checked above
+                    shutil.rmtree(path)
+                os.replace(tmp, path)
+            bump_counter("persistence.write")
+            emit("persistence", action="write", path=path,
+                 model=type(self._instance).__name__)
         finally:
             if os.path.exists(tmp):
                 shutil.rmtree(tmp, ignore_errors=True)

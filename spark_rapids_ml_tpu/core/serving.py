@@ -64,8 +64,26 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.observability.events import emit, run_scope
+from spark_rapids_ml_tpu.observability.metrics import ROW_BUCKETS, histogram
+from spark_rapids_ml_tpu.observability.metrics import gauge as _gauge
 from spark_rapids_ml_tpu.utils.envknobs import env_choice, env_int, env_str
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
+
+
+def _observe_batch(n: int) -> None:
+    """Publish the serving batch-size histogram (pow-2 buckets, so the
+    exposition reads directly as traffic-per-program-bucket)."""
+    histogram(
+        "serving.batch_rows", "rows per serving call", buckets=ROW_BUCKETS
+    ).observe(n)
+
+
+def _publish_cache_size() -> None:
+    """``serving.cache.size`` gauge, updated UNDER the cache lock at
+    every mutation — the thread-safe size truth (tests used to derive it
+    from hit/miss arithmetic, which races concurrent servers)."""
+    _gauge("serving.cache.size", "AOT program cache entries").set(len(_PROGRAMS))
 
 #: Smallest row bucket — tiny interactive batches (a single scored row, a
 #: 3-row unit test) all share one program instead of one each.
@@ -168,6 +186,7 @@ def clear_program_cache() -> None:
         _JIT_FALLBACKS.clear()
         for k in _STATS:
             _STATS[k] = 0
+        _publish_cache_size()
 
 
 def _spec_key(spec) -> tuple:
@@ -203,9 +222,11 @@ def _get_program(fn: Callable, x_spec, args: tuple, static: dict, donate: bool):
             _PROGRAMS.move_to_end(key)
             _STATS["hits"] += 1
             bump_counter("serving.cache.hit")
+            emit("serving", action="hit", kernel=getattr(fn, "__name__", str(fn)))
             return exe
         _STATS["misses"] += 1
         bump_counter("serving.cache.miss")
+        emit("serving", action="miss", kernel=getattr(fn, "__name__", str(fn)))
 
     jitted = jax.jit(
         fn,
@@ -228,12 +249,15 @@ def _get_program(fn: Callable, x_spec, args: tuple, static: dict, donate: bool):
     with _LOCK:
         _STATS["compiles"] += 1
         bump_counter("serving.compile")
+        emit("serving", action="compile", kernel=getattr(fn, "__name__", str(fn)))
         if key not in _PROGRAMS:
             _PROGRAMS[key] = exe
             while len(_PROGRAMS) > _capacity():
                 _PROGRAMS.popitem(last=False)
                 _STATS["evictions"] += 1
                 bump_counter("serving.cache.evict")
+                emit("serving", action="evict")
+            _publish_cache_size()
         return _PROGRAMS[key]
 
 
@@ -322,6 +346,11 @@ def serve_rows(
     """Run the row-wise kernel ``fn(x, *args, **static)`` through the
     shape-bucketed AOT program cache.
 
+    Each call runs under a ``serve`` run scope (observability/events.py):
+    standalone predicts get their own ``run_id``; a call nested inside a
+    fit or a caller's job scope joins the ambient one, so the serving
+    cache traffic lands in the same event-log stream as the fit's spans.
+
     ``x`` may be a host array (padded into a fresh host scratch, placed
     once, result pulled back) or a ``jax.Array`` (padded on device when
     the bucket requires it; result stays on device). ``args`` are the
@@ -330,6 +359,22 @@ def serve_rows(
     ``static_argnames`` and part of the program key. Outputs whose
     leading axis is the bucket are sliced back to the true row count.
     """
+    with run_scope("serve", name):
+        return _serve_rows_impl(
+            fn, x, args, name=name, static=static, donate=donate, to_host=to_host
+        )
+
+
+def _serve_rows_impl(
+    fn: Callable,
+    x: Any,
+    args: tuple,
+    *,
+    name: str,
+    static: Optional[dict],
+    donate: Optional[bool],
+    to_host: Optional[bool],
+):
     import jax
     import jax.numpy as jnp
 
@@ -350,12 +395,14 @@ def serve_rows(
         with TraceRange(f"serve {name}", TraceColor.GREEN):
             outs = _jit_fallback(fn, static)(x, *args, **static)
         n = int(np.shape(x)[0])
+        _observe_batch(n)
         return _slice_outputs(outs, n, n, to_host)
 
     if device_in:
         if x.ndim == 1:
             x = x[None, :]
         n, d = int(x.shape[0]), int(x.shape[1])
+        _observe_batch(n)
         bucket = bucket_rows(n)
         if bucket == n:
             x_pad, owned = x, False
@@ -372,6 +419,7 @@ def serve_rows(
         if x_host.ndim != 2:
             raise ValueError(f"serving input must be 2-D, got {x_host.ndim}-D")
         n, d = x_host.shape
+        _observe_batch(n)
         bucket = bucket_rows(n)
         dtype = _compute_dtype(x_host.dtype)
         # A FRESH padded scratch per call: jax may alias (zero-copy) a
@@ -426,6 +474,10 @@ def serve_stream(
     fallback = _jit_fallback(fn, static) if _any_multi_device(args) else None
     pending: Optional[tuple] = None  # (outs, bucket, n)
 
+    # NOTE: no run_scope here — a generator's contextvar writes leak into
+    # whichever context consumes it, and an abandoned generator would
+    # reset the scope token from a foreign context. Stream events carry
+    # the AMBIENT run_id (the consuming fit/transform/job scope) instead.
     for blk in blocks:
         x_host = np.asarray(blk)
         if x_host.ndim == 1:
@@ -433,6 +485,7 @@ def serve_stream(
         if x_host.size == 0:
             continue
         n, d = x_host.shape
+        _observe_batch(n)
         bucket = bucket_rows(n)
         blk_dtype = np.dtype(dtype) if dtype is not None else _compute_dtype(x_host.dtype)
         pad_host = np.zeros((bucket, d), dtype=blk_dtype)

@@ -232,7 +232,7 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
     _initial_centers = None
     _copy_attrs = ("_initial_centers",)  # survives Params.copy (tuning grids)
 
-    def fit(self, dataset: Any) -> "KMeansModel":
+    def _fit(self, dataset: Any) -> "KMeansModel":
         rows = _extract_features(dataset, self.getFeaturesCol())
         w_host = extract_weights(dataset, self.getWeightCol())
         if is_streaming_source(rows):

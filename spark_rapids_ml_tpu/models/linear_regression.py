@@ -281,7 +281,7 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         )
         return self._copyValues(model)
 
-    def fit(self, dataset: Any) -> "LinearRegressionModel":
+    def _fit(self, dataset: Any) -> "LinearRegressionModel":
         if self.getElasticNetParam() > 0.0 and self.getSolver() == "normal":
             # Spark's normal solver rejects L1 the same way; validate before
             # any data movement or GEMM work.

@@ -245,7 +245,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
         self._initial_weights = (w, b)
         return self
 
-    def fit(self, dataset: Any) -> "LogisticRegressionModel":
+    def _fit(self, dataset: Any) -> "LogisticRegressionModel":
         if (
             isinstance(dataset, tuple)
             and len(dataset) == 2

@@ -231,7 +231,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
     # stays O(n d l) with l = k + oversample.
     _RANDOMIZED_AUTO_DIM = 4096
 
-    def fit(self, dataset: Any) -> "PCAModel":
+    def _fit(self, dataset: Any) -> "PCAModel":
         """RapidsPCA.fit (RapidsPCA.scala:111-125)."""
         from spark_rapids_ml_tpu.core.data import infer_input_dtype, is_streaming_source
 

@@ -414,7 +414,7 @@ class RandomForestClassifier(_RandomForestParams, Estimator, MLReadable):
             raise ValueError(f"impurity must be gini or entropy, got {v!r}")
         return self._chain(self.impurity, v)
 
-    def fit(self, dataset: Any) -> "RandomForestClassificationModel":
+    def _fit(self, dataset: Any) -> "RandomForestClassificationModel":
         x, y = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         declared = self.getNumClasses()
         if declared:
@@ -585,7 +585,7 @@ class RandomForestRegressor(_RandomForestParams, Estimator, MLReadable):
             raise ValueError(f"regression impurity must be variance, got {v!r}")
         return self._chain(self.impurity, v)
 
-    def fit(self, dataset: Any) -> "RandomForestRegressionModel":
+    def _fit(self, dataset: Any) -> "RandomForestRegressionModel":
         x, y = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         # Stats channels [1, y, y^2] -> weighted variance impurity. Labels
         # are centered first: the E[y^2] - mean^2 form in float32 would lose

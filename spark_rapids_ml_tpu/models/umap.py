@@ -293,7 +293,7 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
         self._init_embedding = arr
         return self
 
-    def fit(self, dataset: Any) -> "UMAPModel":
+    def _fit(self, dataset: Any) -> "UMAPModel":
         rows = extract_features(dataset, self.getFeaturesCol())
         # Device arrays are consumed in place — no host round trip
         # (VERDICT r3 #1); the mesh index upload still wants a host copy,

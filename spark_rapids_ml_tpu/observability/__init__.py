@@ -1,0 +1,61 @@
+"""Structured run telemetry — the operable face of the whole system.
+
+The reference's only observability was NVTX push/pop ranges viewed in
+nsys (SURVEY §5); our port grew a ring buffer of ranges and an ad-hoc
+counter dict in ``utils/tracing.py``. This package is the growth of that
+seed into a subsystem every layer reports into:
+
+  - :mod:`metrics`  — typed registry: counters (absorbing the old
+    ``bump_counter`` registry, aliases kept), gauges, and fixed-bucket
+    histograms, with label support, a Prometheus-style text exposition,
+    and a JSON snapshot (``TPUML_METRICS_DUMP`` writes one at exit).
+  - :mod:`events`   — structured JSONL event log
+    (``TPUML_EVENT_LOG=<path|stderr>``): per-fit/per-transform
+    ``run_id``, process index, monotonic+wall timestamps; spans, retries,
+    fault injections, degradations, checkpoint writes/restores, serving
+    cache hits/misses, and barrier resubmits all land in one greppable
+    stream. Zero overhead when the knob is unset.
+  - :mod:`report`   — end-of-call reports (``model.fit_report()``,
+    :func:`report.serving_report`): stage timings, compile counts,
+    checkpoint activity, device memory stats.
+  - :mod:`heartbeat` — gang heartbeats: barrier workers periodically
+    write per-process heartbeat records so a STUCK member is
+    distinguishable from a slow one before the stage deadline fires.
+  - :mod:`profiling` — ``TPUML_PROFILE_DIR`` wraps a fit/transform in a
+    ``jax.profiler`` trace session.
+
+``utils/tracing.py`` remains the compatibility surface (TraceRange,
+bump_counter, ...) and forwards here.
+"""
+
+from spark_rapids_ml_tpu.observability.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricError,
+    Registry,
+    default_registry,
+)
+from spark_rapids_ml_tpu.observability.events import (  # noqa: F401
+    EVENT_LOG_ENV,
+    configure,
+    current_run,
+    current_run_id,
+    emit,
+    enabled,
+    run_scope,
+    validate_record,
+)
+from spark_rapids_ml_tpu.observability.report import (  # noqa: F401
+    RunRecorder,
+    RunReport,
+    serving_report,
+)
+from spark_rapids_ml_tpu.observability.heartbeat import (  # noqa: F401
+    GangHeartbeat,
+    heartbeat_scope,
+)
+from spark_rapids_ml_tpu.observability.profiling import (  # noqa: F401
+    PROFILE_DIR_ENV,
+    maybe_profile,
+)

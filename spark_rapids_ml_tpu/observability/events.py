@@ -1,0 +1,274 @@
+"""Structured JSONL event log — one greppable stream for everything.
+
+``TPUML_EVENT_LOG=<path|stderr>`` turns it on; unset (the default) it is
+OFF and :func:`emit` is one module-global ``None`` check — the serving
+hot path and the range path pay nothing (the budget test in
+tests/test_observability.py holds this to an allocation bound).
+
+Every record is one JSON object per line with a common envelope::
+
+    {"event": "<type>", "ts": <wall epoch>, "mono": <monotonic>,
+     "pid": <os pid>, "process": <jax process index>,
+     "run_id": "<fit-...|serve-...|null>", ...type fields...}
+
+``run_id`` comes from the ambient :func:`run_scope` (a contextvar): the
+estimator base class opens one per fit, the serving entries open one per
+transform/predict call, and an outer scope (a job harness wrapping fit +
+transform) is REUSED by everything nested inside it — so one fit's
+spans, retry attempts, fault firings, checkpoint writes (including those
+from the async writer thread, which receives a copied context), serving
+cache hits and barrier resubmits all join on one id.
+
+:data:`SCHEMA` names every record type and its required fields;
+:func:`validate_record` is the one validator the tests AND the
+``tools/tpuml_metrics.py`` CLI share.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import contextvars
+
+from spark_rapids_ml_tpu.utils.envknobs import env_str
+
+EVENT_LOG_ENV = "TPUML_EVENT_LOG"
+
+#: Spans kept per run context for report building (reports read a window
+#: of this deque; an unbounded long-lived scope must not grow forever).
+MAX_RUN_SPANS = 16384
+
+# --- record schema -----------------------------------------------------
+
+#: Fields every record carries.
+BASE_FIELDS = frozenset({"event", "ts", "mono", "pid", "process", "run_id"})
+
+#: Required extra fields per record type — the single source of truth
+#: for schema validation (tests + CLI).
+SCHEMA: Dict[str, frozenset] = {
+    "run": frozenset({"action", "kind", "label"}),
+    "span": frozenset(
+        {"name", "start", "end", "dur", "ok", "exc", "depth", "parent",
+         "span", "thread"}
+    ),
+    "counters": frozenset({"counters"}),
+    "retry": frozenset({"site", "attempt", "outcome"}),
+    "fault": frozenset({"action"}),
+    "degrade": frozenset({"what", "why", "fallback"}),
+    "checkpoint": frozenset({"action", "step"}),
+    "heartbeat": frozenset({"seq", "interval"}),
+    "barrier": frozenset({"action", "attempt"}),
+    "serving": frozenset({"action"}),
+    "report": frozenset({"kind", "summary"}),
+    "profile": frozenset({"action", "dir"}),
+    "distributed": frozenset({"action"}),
+    "persistence": frozenset({"action", "path"}),
+}
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Problems with one decoded record (empty list = valid)."""
+    problems: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    etype = rec.get("event")
+    if etype not in SCHEMA:
+        problems.append(f"unknown event type {etype!r}")
+        return problems
+    for f in BASE_FIELDS:
+        if f not in rec:
+            problems.append(f"{etype}: missing base field {f!r}")
+    for f in SCHEMA[etype]:
+        if f not in rec:
+            problems.append(f"{etype}: missing field {f!r}")
+    for f in ("ts", "mono"):
+        if f in rec and not isinstance(rec[f], (int, float)):
+            problems.append(f"{etype}: {f} must be a number")
+    return problems
+
+
+# --- run scopes --------------------------------------------------------
+
+_run_seq = itertools.count(1)
+
+
+class RunContext:
+    """One run's identity + in-memory span collector (for reports)."""
+
+    __slots__ = ("run_id", "kind", "label", "spans", "t0_wall", "t0_mono", "_lock")
+
+    def __init__(self, run_id: str, kind: str, label: str):
+        self.run_id = run_id
+        self.kind = kind
+        self.label = label
+        self.spans: deque = deque(maxlen=MAX_RUN_SPANS)
+        self.t0_wall = time.time()
+        self.t0_mono = time.monotonic()
+        self._lock = threading.Lock()
+
+    def add_span(self, record: dict) -> None:
+        with self._lock:
+            self.spans.append(record)
+
+    def span_window(self, start: int) -> List[dict]:
+        """Spans recorded since index ``start`` (report windows)."""
+        with self._lock:
+            return list(self.spans)[start:]
+
+    def span_count(self) -> int:
+        with self._lock:
+            return len(self.spans)
+
+
+_CTX: "contextvars.ContextVar[Optional[RunContext]]" = contextvars.ContextVar(
+    "tpuml_run_ctx", default=None
+)
+
+
+def new_run_id(kind: str) -> str:
+    return f"{kind}-{os.getpid():x}-{next(_run_seq):04x}-{os.urandom(3).hex()}"
+
+
+def current_run() -> Optional[RunContext]:
+    return _CTX.get()
+
+
+def current_run_id() -> Optional[str]:
+    ctx = _CTX.get()
+    return ctx.run_id if ctx is not None else None
+
+
+@contextlib.contextmanager
+def run_scope(kind: str, label: str = ""):
+    """Enter (or join) a run: a fresh ``run_id`` when none is active, the
+    AMBIENT one otherwise — a transform inside a fit, or a fit+transform
+    pair inside a caller's job scope, shares the outer id so the whole
+    episode joins in the event log."""
+    cur = _CTX.get()
+    if cur is not None:
+        yield cur
+        return
+    ctx = RunContext(new_run_id(kind), kind, label)
+    token = _CTX.set(ctx)
+    emit("run", action="start", kind=kind, label=label)
+    try:
+        yield ctx
+    finally:
+        _CTX.reset(token)
+        emit("run", action="end", kind=kind, label=label,
+             run_id=ctx.run_id)
+
+
+# --- the sink ----------------------------------------------------------
+
+_sink = None  # None = disabled: emit() is a single attribute check
+_sink_owned = False  # did we open the file (close it on reconfigure)?
+_sink_lock = threading.Lock()
+_n_emitted = 0
+_process_index: Optional[int] = None
+
+
+def set_process_index(idx: int) -> None:
+    """Called by ``parallel.distributed.initialize`` once the gang is up;
+    before that the envelope falls back to ``TPUML_PROCESS_ID`` or 0."""
+    global _process_index
+    _process_index = int(idx)
+
+
+def _resolve_process_index() -> int:
+    if _process_index is not None:
+        return _process_index
+    raw = os.environ.get("TPUML_PROCESS_ID", "").strip()
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return 0
+
+
+def configure(path: Optional[str] = None) -> Optional[str]:
+    """(Re)wire the sink: explicit ``path``, else ``TPUML_EVENT_LOG``,
+    else disabled. ``"stderr"`` streams to stderr; anything else appends
+    to that file. Returns the active destination (None = disabled)."""
+    global _sink, _sink_owned
+    with _sink_lock:
+        if _sink is not None and _sink_owned:
+            try:
+                _sink.close()
+            except OSError:  # pragma: no cover - best-effort close
+                pass
+        _sink, _sink_owned = None, False
+        dest = path if path is not None else env_str(EVENT_LOG_ENV)
+        if not dest:
+            return None
+        if dest == "stderr":
+            _sink = sys.stderr
+        else:
+            parent = os.path.dirname(os.path.abspath(dest))
+            os.makedirs(parent, exist_ok=True)
+            _sink = open(dest, "a", buffering=1)
+            _sink_owned = True
+        return dest
+
+
+def enabled() -> bool:
+    return _sink is not None
+
+
+def emitted_count() -> int:
+    """Total records written since import — the zero-events assertion."""
+    return _n_emitted
+
+
+def emit(etype: str, **fields) -> None:
+    """Write one record. With no sink configured this returns after ONE
+    module-global check — the disabled path allocates nothing."""
+    sink = _sink
+    if sink is None:
+        return
+    global _n_emitted
+    ctx = _CTX.get()
+    rec = {
+        "event": etype,
+        "ts": time.time(),
+        "mono": time.monotonic(),
+        "pid": os.getpid(),
+        "process": _resolve_process_index(),
+        "run_id": ctx.run_id if ctx is not None else None,
+    }
+    rec.update(fields)
+    line = json.dumps(rec, default=str)
+    with _sink_lock:
+        if _sink is None:  # reconfigured under us
+            return
+        try:
+            _sink.write(line + "\n")
+            _sink.flush()
+        except (OSError, ValueError):  # closed stream: drop, never raise
+            return
+        _n_emitted += 1
+
+
+def _close_at_exit() -> None:  # pragma: no cover - interpreter teardown
+    global _sink, _sink_owned
+    with _sink_lock:
+        if _sink is not None and _sink_owned:
+            try:
+                _sink.close()
+            except OSError:
+                pass
+        _sink, _sink_owned = None, False
+
+
+atexit.register(_close_at_exit)
+configure()

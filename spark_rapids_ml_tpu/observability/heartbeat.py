@@ -1,0 +1,109 @@
+"""Gang heartbeats — distinguishing a STUCK member from a slow one.
+
+The failure detector the gang already has (jax's distributed-runtime
+heartbeat, ``TPUML_HEARTBEAT_TIMEOUT``) only fires when a process is
+DEAD; a member that is alive but wedged — stuck in a collective its
+peers never entered, spinning in host code — looks identical to a slow
+one until the barrier-stage deadline fires. A heartbeat record per
+process per interval makes the difference observable BEFORE then:
+
+  - each barrier gang member (``spark/barrier.py``) runs one daemon
+    thread writing a ``heartbeat`` event (sequence number, interval,
+    process id) to the event log every ``TPUML_GANG_HEARTBEAT_EVERY``
+    seconds (default 5; ``0`` disables);
+  - the ``gang.heartbeat.age_seconds`` gauge (labeled by process) reads
+    the age of the LAST beat at scrape time — a wedged worker's age
+    grows while its peers' stay near zero, so ``grep heartbeat`` on the
+    merged event stream or one Prometheus scrape names the stuck rank.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+from spark_rapids_ml_tpu.observability.events import emit
+from spark_rapids_ml_tpu.observability.metrics import gauge
+from spark_rapids_ml_tpu.utils.envknobs import env_float
+
+HEARTBEAT_EVERY_ENV = "TPUML_GANG_HEARTBEAT_EVERY"
+DEFAULT_INTERVAL = 5.0
+
+AGE_GAUGE = "gang.heartbeat.age_seconds"
+
+
+def heartbeat_interval() -> float:
+    """Seconds between beats; 0 disables the thread."""
+    return env_float(HEARTBEAT_EVERY_ENV, DEFAULT_INTERVAL, minimum=0.0)
+
+
+class GangHeartbeat:
+    """One process's heartbeat stream: a daemon thread beating every
+    ``interval`` seconds until :meth:`stop`.
+
+    Each beat emits a ``heartbeat`` event and refreshes the last-beat
+    timestamp behind the ``gang.heartbeat.age_seconds`` gauge (a
+    callable gauge, so scrapes read the CURRENT age, not a stale one).
+    """
+
+    def __init__(self, process_id: int = 0, interval: Optional[float] = None,
+                 what: str = "gang"):
+        self.process_id = int(process_id)
+        self.interval = heartbeat_interval() if interval is None else float(interval)
+        self.what = what
+        self.seq = 0
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def age_seconds(self) -> float:
+        return time.monotonic() - self._last
+
+    def beat(self) -> None:
+        self.seq += 1
+        self._last = time.monotonic()
+        emit(
+            "heartbeat",
+            seq=self.seq,
+            interval=self.interval,
+            what=self.what,
+            process=self.process_id,
+        )
+
+    def start(self) -> "GangHeartbeat":
+        if self.interval <= 0 or self._thread is not None:
+            return self
+        gauge(
+            AGE_GAUGE, "seconds since this process's last gang heartbeat"
+        ).set_function(self.age_seconds, process=str(self.process_id))
+        self.beat()  # beat 1 lands immediately: liveness from t=0
+
+        def _loop():
+            while not self._stop.wait(self.interval):
+                self.beat()
+
+        self._thread = threading.Thread(
+            target=_loop, name=f"tpuml-heartbeat-{self.process_id}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 1.0)
+            self._thread = None
+
+
+@contextlib.contextmanager
+def heartbeat_scope(process_id: int = 0, interval: Optional[float] = None,
+                    what: str = "gang"):
+    """Heartbeats for the duration of a block (the barrier task body)."""
+    hb = GangHeartbeat(process_id, interval, what=what)
+    hb.start()
+    try:
+        yield hb
+    finally:
+        hb.stop()

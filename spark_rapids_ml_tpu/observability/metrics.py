@@ -1,0 +1,376 @@
+"""Typed metrics registry — counters, gauges, fixed-bucket histograms.
+
+Grown from the flat counter dict in ``utils/tracing.py`` (PR 2), which
+the serving/checkpoint/retry layers already publish into; that surface
+(``bump_counter`` / ``counter_value`` / ``counters`` / ``clear_counters``)
+remains intact as aliases over THIS registry, so every existing counter
+name and every test asserting on one keeps working unchanged.
+
+What the registry adds:
+
+  - **Types.** A name is registered once with one kind; re-registering
+    it as a different kind raises :class:`MetricError` instead of
+    silently aliasing a gauge over a counter.
+  - **Labels.** Every metric holds one time series per label set
+    (``counter("retry.attempts").inc(site="ingest")``); the unlabeled
+    series is the ``()`` key, which is what the legacy flat-dict view
+    exposes.
+  - **Gauges** may carry a callable (``set_function``) evaluated at
+    snapshot time — how ``gang.heartbeat.age_seconds`` reads as an age
+    rather than a stale timestamp.
+  - **Histograms** are fixed-bucket (Prometheus semantics: cumulative
+    ``le`` buckets, ``sum``, ``count``) so ``serving.batch_rows``,
+    ``retry.backoff_seconds`` and per-segment solve latency cost O(1)
+    memory however long the process lives.
+  - **Exposition.** :func:`render_prometheus` emits the text format
+    (``tpuml_`` prefix, dots to underscores); :func:`snapshot` returns a
+    JSON-ready dict. ``TPUML_METRICS_DUMP=<path>`` writes a snapshot at
+    interpreter exit (``.prom`` suffix selects the text format).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional, Tuple, Union
+
+METRICS_DUMP_ENV = "TPUML_METRICS_DUMP"
+
+#: Buckets for duration-valued histograms (seconds): 1 ms .. 60 s.
+TIME_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+#: Buckets for row-count histograms: the serving layer's pow-2 shape
+#: buckets, so the histogram reads directly as "programs by bucket".
+ROW_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 4096, 16384, 65536)
+
+DEFAULT_BUCKETS = TIME_BUCKETS
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+class MetricError(ValueError):
+    """A metric was used inconsistently (kind clash, bad labels)."""
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _series_name(name: str, key: LabelKey) -> str:
+    """Flat display name: ``name`` or ``name{a="x",b="y"}``."""
+    if not key:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return f"{name}{{{inner}}}"
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return f"tpuml_{out}"
+
+
+class _Metric:
+    kind = "abstract"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self._series: Dict[LabelKey, Union[int, float]] = {}
+
+    def _snapshot_series(self) -> Dict[LabelKey, Union[int, float]]:
+        with self._lock:
+            return dict(self._series)
+
+
+class Counter(_Metric):
+    """Monotonically increasing named count, one series per label set."""
+
+    kind = "counter"
+
+    def inc(self, amount: Union[int, float] = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def value(self, **labels) -> Union[int, float]:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+
+class Gauge(_Metric):
+    """A value that can go up and down — or a callable evaluated at
+    snapshot time (``set_function``), for ages and sizes derived from
+    live state."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock):
+        super().__init__(name, help, lock)
+        self._functions: Dict[LabelKey, Callable[[], float]] = {}
+
+    def set(self, value: Union[int, float], **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._functions.pop(key, None)
+            self._series[key] = value
+
+    def inc(self, amount: Union[int, float] = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def set_function(self, fn: Callable[[], float], **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series.pop(key, None)
+            self._functions[key] = fn
+
+    def value(self, **labels) -> Union[int, float]:
+        key = _label_key(labels)
+        with self._lock:
+            fn = self._functions.get(key)
+            if fn is None:
+                return self._series.get(key, 0)
+        return fn()  # outside the lock: user code must not deadlock us
+
+    def _snapshot_series(self) -> Dict[LabelKey, Union[int, float]]:
+        with self._lock:
+            out = dict(self._series)
+            fns = list(self._functions.items())
+        for key, fn in fns:
+            try:
+                out[key] = fn()
+            except Exception:  # a dead callback must not kill a scrape
+                out[key] = float("nan")
+        return out
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (Prometheus semantics): per label set, a
+    cumulative count per ``le`` bucket plus ``sum`` and ``count``."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        lock: threading.Lock,
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help, lock)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise MetricError(f"histogram {name!r} needs at least one bucket")
+        # _series maps label key -> [counts per bucket + inf, sum, count]
+        self._series: Dict[LabelKey, list] = {}
+
+    def _blank(self) -> list:
+        return [[0] * (len(self.buckets) + 1), 0.0, 0]
+
+    def observe(self, value: Union[int, float], **labels) -> None:
+        key = _label_key(labels)
+        v = float(value)
+        with self._lock:
+            cell = self._series.get(key)
+            if cell is None:
+                cell = self._series[key] = self._blank()
+            counts, _, _ = cell
+            idx = len(self.buckets)
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    idx = i
+                    break
+            counts[idx] += 1
+            cell[1] += v
+            cell[2] += 1
+
+    def value(self, **labels) -> dict:
+        """``{"buckets": {le: cumulative_count}, "sum": s, "count": n}``."""
+        with self._lock:
+            cell = self._series.get(_label_key(labels))
+            if cell is None:
+                cell = self._blank()
+            counts, total, n = cell[0][:], cell[1], cell[2]
+        cum, out = 0, {}
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out[b] = cum
+        out[float("inf")] = cum + counts[-1]
+        return {"buckets": out, "sum": total, "count": n}
+
+    def _snapshot_series(self):
+        with self._lock:
+            keys = list(self._series)
+        return {k: self.value(**dict(k)) for k in keys}
+
+
+class Registry:
+    """Get-or-create home for every metric; one instance
+    (:data:`default_registry`) backs the whole process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get(self, name: str, kind: type, help: str, **kwargs) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = kind(name, help, threading.Lock(), **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, kind):
+                raise MetricError(
+                    f"metric {name!r} is a {m.kind}, not a {kind.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, help)
+
+    def histogram(
+        self, name: str, help: str = "", buckets: Iterable[float] = DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._get(name, Histogram, help, buckets=buckets)
+
+    def metrics(self) -> Dict[str, _Metric]:
+        with self._lock:
+            return dict(self._metrics)
+
+    # --- legacy flat-dict views (the utils/tracing counter surface) ---
+
+    def counters_snapshot(self, prefix: str = "") -> Dict[str, Union[int, float]]:
+        """Flat ``{display_name: value}`` of every counter series whose
+        metric name starts with ``prefix`` — the shape the old
+        ``tracing.counters()`` returned (unlabeled series keep their
+        plain name, so every pre-registry assertion still holds)."""
+        out: Dict[str, Union[int, float]] = {}
+        for name, m in self.metrics().items():
+            if not isinstance(m, Counter) or not name.startswith(prefix):
+                continue
+            for key, v in m._snapshot_series().items():
+                out[_series_name(name, key)] = v
+        return out
+
+    def clear(self, prefix: str = "", kinds: Optional[Tuple[str, ...]] = None) -> None:
+        """Drop every metric whose name starts with ``prefix`` (optionally
+        restricted to ``kinds``) — test isolation, reconfigs."""
+        with self._lock:
+            for name in [
+                n
+                for n, m in self._metrics.items()
+                if n.startswith(prefix) and (kinds is None or m.kind in kinds)
+            ]:
+                del self._metrics[name]
+
+    # --- exposition ---
+
+    def snapshot(self) -> dict:
+        """JSON-ready snapshot of every metric, grouped by kind."""
+        out = {"ts": time.time(), "counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in sorted(self.metrics().items()):
+            series = m._snapshot_series()
+            if isinstance(m, Histogram):
+                out["histograms"][name] = {
+                    _series_name(name, k): {
+                        "buckets": {str(le): c for le, c in v["buckets"].items()},
+                        "sum": v["sum"],
+                        "count": v["count"],
+                    }
+                    for k, v in series.items()
+                }
+            else:
+                group = "counters" if isinstance(m, Counter) else "gauges"
+                for k, v in series.items():
+                    out[group][_series_name(name, k)] = v
+        return out
+
+    def render_prometheus(self) -> str:
+        """The Prometheus text exposition format (metric names prefixed
+        ``tpuml_``, dots to underscores)."""
+        lines = []
+        for name, m in sorted(self.metrics().items()):
+            pname = _prom_name(name)
+            if m.help:
+                lines.append(f"# HELP {pname} {m.help}")
+            lines.append(f"# TYPE {pname} {m.kind}")
+            series = m._snapshot_series()
+            if isinstance(m, Histogram):
+                for key, v in sorted(series.items()):
+                    base = dict(key)
+                    for le, c in v["buckets"].items():
+                        le_s = "+Inf" if le == float("inf") else repr(le)
+                        labels = _label_key({**base, "le": le_s})
+                        inner = ",".join(f'{k}="{val}"' for k, val in labels)
+                        lines.append(f"{pname}_bucket{{{inner}}} {c}")
+                    suffix = _series_name("", key)
+                    lines.append(f"{pname}_sum{suffix} {v['sum']}")
+                    lines.append(f"{pname}_count{suffix} {v['count']}")
+            else:
+                for key, v in sorted(series.items()):
+                    lines.append(f"{pname}{_series_name('', key)} {float(v)}")
+        return "\n".join(lines) + "\n"
+
+
+default_registry = Registry()
+
+
+# --- module-level conveniences (the names the call sites use) ---
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return default_registry.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return default_registry.gauge(name, help)
+
+
+def histogram(
+    name: str, help: str = "", buckets: Iterable[float] = DEFAULT_BUCKETS
+) -> Histogram:
+    return default_registry.histogram(name, help, buckets=buckets)
+
+
+def observe_segment_seconds(solver: str, seconds: float) -> None:
+    """Per-segment solve latency — the Alchemist-style per-stage timing
+    attribution (PAPERS.md) for the segmented preemption-tolerant
+    drivers in ``ops/``."""
+    histogram(
+        "solver.segment_seconds",
+        "wall seconds per jitted solver segment",
+        buckets=TIME_BUCKETS,
+    ).observe(seconds, solver=solver)
+
+
+def dump_snapshot(path: str, registry: Optional[Registry] = None) -> None:
+    """Write a snapshot to ``path`` — Prometheus text if it ends in
+    ``.prom``, JSON otherwise."""
+    registry = registry or default_registry
+    with open(path, "w") as f:
+        if path.endswith(".prom"):
+            f.write(registry.render_prometheus())
+        else:
+            json.dump(registry.snapshot(), f, indent=2, default=str)
+            f.write("\n")
+
+
+def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess
+    path = os.environ.get(METRICS_DUMP_ENV, "").strip()
+    if path:
+        try:
+            dump_snapshot(path)
+        except OSError:
+            pass
+
+
+atexit.register(_dump_at_exit)

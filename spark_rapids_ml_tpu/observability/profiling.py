@@ -1,0 +1,68 @@
+"""``TPUML_PROFILE_DIR`` — wrap a fit/transform in a jax.profiler session.
+
+The reference's ranges were only visible inside an externally-launched
+nsys session; here the profile session itself is a knob: point
+``TPUML_PROFILE_DIR`` at a directory and every top-level fit/transform
+(the :class:`~spark_rapids_ml_tpu.observability.report.RunRecorder`
+entry) runs inside ``jax.profiler.start_trace``/``stop_trace``, so the
+TraceAnnotation ranges the instrumentation already emits land in an
+xprof/TensorBoard trace with zero code changes at the call site.
+
+jax supports one trace session per process, so nested recorders (a
+transform inside a fit, a CV loop's inner fits) no-op: the OUTERMOST
+call owns the session.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Optional
+
+from spark_rapids_ml_tpu.utils.envknobs import env_str
+
+PROFILE_DIR_ENV = "TPUML_PROFILE_DIR"
+
+_lock = threading.Lock()
+_active = False
+
+
+def profile_dir() -> Optional[str]:
+    return env_str(PROFILE_DIR_ENV)
+
+
+@contextlib.contextmanager
+def maybe_profile(label: str = ""):
+    """Run the body inside a jax profiler trace session when
+    ``TPUML_PROFILE_DIR`` is set (and no session is already active);
+    otherwise a no-op. Yields the trace directory or None."""
+    global _active
+    d = profile_dir()
+    if not d:
+        yield None
+        return
+    with _lock:
+        if _active:
+            d = None
+        else:
+            _active = True
+    if d is None:  # an outer session owns the profiler
+        yield None
+        return
+    import jax
+
+    from spark_rapids_ml_tpu.observability.events import emit
+
+    os.makedirs(d, exist_ok=True)
+    emit("profile", action="start", dir=d, label=label)
+    jax.profiler.start_trace(d)
+    try:
+        yield d
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            with _lock:
+                _active = False
+            emit("profile", action="stop", dir=d, label=label)

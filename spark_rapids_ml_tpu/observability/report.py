@@ -1,0 +1,277 @@
+"""End-of-call reports — per-fit/per-transform attribution.
+
+Alchemist (PAPERS.md) attributes its offload wins via per-stage timing;
+"Memory Safe Computations with XLA" shows device memory must be measured
+to be controlled. This module is where both land for every fit: the
+estimator base class runs each ``fit`` inside a :class:`RunRecorder`,
+and the finished :class:`RunReport` hangs off the model
+(``model.fit_report()``) with
+
+  - the **stage-timing tree** rebuilt from the run's spans (TraceRange
+    now records span id / parent / depth / ok / exception type — the
+    ingest, H2D, compile, solver-segment and collective ranges nest the
+    way the code did);
+  - aggregate **stage totals** (seconds and call counts per range name);
+  - the **counter deltas** the call produced (compile counts, checkpoint
+    writes/restores, retry attempts, serving cache traffic);
+  - **device memory stats** (``jax.local_devices()[i].memory_stats()``
+    where the backend provides them), also published as
+    ``device.memory.*`` gauges.
+
+:func:`serving_report` is the transform-side sibling: a snapshot of the
+serving program cache, batch-size histogram and cache counters — the
+steady-state serving picture rather than one call's tree.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_ml_tpu.observability import events
+from spark_rapids_ml_tpu.observability.metrics import default_registry, gauge
+from spark_rapids_ml_tpu.observability.profiling import maybe_profile
+
+#: Counter prefixes a report folds into its summary.
+_REPORT_PREFIXES = ("serving.", "checkpoint.", "retry.", "gang.", "ingest.",
+                    "persistence.", "degrade.")
+
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """``{device_id: memory_stats}`` for every local device that exposes
+    them (TPU/GPU backends do; CPU returns nothing). Each scrape also
+    refreshes the ``device.memory.bytes_in_use`` / ``.peak_bytes_in_use``
+    / ``.bytes_limit`` gauges, labeled by device."""
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:  # backend not up — a report must never fail a fit
+        return out
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        key = str(getattr(dev, "id", len(out)))
+        out[key] = {k: int(v) for k, v in stats.items() if isinstance(v, (int, float))}
+        for field, metric in (
+            ("bytes_in_use", "device.memory.bytes_in_use"),
+            ("peak_bytes_in_use", "device.memory.peak_bytes_in_use"),
+            ("bytes_limit", "device.memory.bytes_limit"),
+        ):
+            if field in out[key]:
+                gauge(metric, f"per-device {field}").set(out[key][field], device=key)
+    return out
+
+
+def build_stage_tree(spans: List[dict]) -> List[dict]:
+    """Nest a span window into a stage tree via parent ids: each node is
+    ``{name, dur, ok, exc, thread, children}``. Spans whose parent closed
+    outside the window root themselves."""
+    by_id: Dict[int, dict] = {}
+    roots: List[dict] = []
+    for s in spans:
+        by_id[s["span"]] = {
+            "name": s["name"],
+            "dur": s["dur"],
+            "ok": s["ok"],
+            "exc": s["exc"],
+            "thread": s["thread"],
+            "children": [],
+        }
+    for s in spans:
+        node = by_id[s["span"]]
+        parent = by_id.get(s.get("parent"))
+        (parent["children"] if parent is not None else roots).append(node)
+    return roots
+
+
+def stage_totals(spans: List[dict]) -> Dict[str, Dict[str, float]]:
+    """``{range name: {seconds, calls}}`` aggregated over a span window."""
+    out: Dict[str, Dict[str, float]] = {}
+    for s in spans:
+        cell = out.setdefault(s["name"], {"seconds": 0.0, "calls": 0})
+        cell["seconds"] += s["dur"]
+        cell["calls"] += 1
+    return out
+
+
+class RunReport:
+    """One finished run's attribution. Plain data — picklable, JSON-able
+    via :meth:`summary`."""
+
+    def __init__(
+        self,
+        run_id: str,
+        kind: str,
+        label: str,
+        wall_seconds: float,
+        spans: List[dict],
+        counters: Dict[str, float],
+        device_memory: Dict[str, Dict[str, int]],
+        ok: bool = True,
+    ):
+        self.run_id = run_id
+        self.kind = kind
+        self.label = label
+        self.wall_seconds = wall_seconds
+        self.spans = spans
+        self.counters = counters
+        self.device_memory = device_memory
+        self.ok = ok
+
+    def stage_tree(self) -> List[dict]:
+        return build_stage_tree(self.spans)
+
+    def stage_totals(self) -> Dict[str, Dict[str, float]]:
+        return stage_totals(self.spans)
+
+    def compile_count(self) -> int:
+        """Compiles attributed to this run: compile-named spans plus the
+        serving-layer compile counter delta (whichever layer saw them)."""
+        from_spans = sum(1 for s in self.spans if "compile" in s["name"])
+        return max(from_spans, int(self.counters.get("serving.compile", 0)))
+
+    def checkpoint_activity(self) -> Dict[str, float]:
+        return {
+            k: v for k, v in self.counters.items() if k.startswith("checkpoint.")
+        }
+
+    def summary(self) -> dict:
+        return {
+            "run_id": self.run_id,
+            "kind": self.kind,
+            "label": self.label,
+            "ok": self.ok,
+            "wall_seconds": self.wall_seconds,
+            "stages": self.stage_totals(),
+            "compiles": self.compile_count(),
+            "counters": self.counters,
+            "checkpoint": self.checkpoint_activity(),
+            "device_memory": self.device_memory,
+        }
+
+    def _render_tree(self, nodes: List[dict], indent: int, lines: List[str]) -> None:
+        for n in nodes:
+            flag = "" if n["ok"] else f"  !! {n['exc'] or 'failed'}"
+            lines.append(
+                f"{'  ' * indent}{n['name']:<32s} {n['dur'] * 1e3:10.2f} ms{flag}"
+            )
+            self._render_tree(n["children"], indent + 1, lines)
+
+    def __str__(self) -> str:
+        lines = [
+            f"{self.kind} report  [{self.label}]  run_id={self.run_id}",
+            f"  wall: {self.wall_seconds:.3f}s  ok: {self.ok}  "
+            f"compiles: {self.compile_count()}",
+            "  stages:",
+        ]
+        self._render_tree(self.stage_tree(), 2, lines)
+        interesting = {
+            k: v for k, v in sorted(self.counters.items()) if v
+        }
+        if interesting:
+            lines.append("  counters:")
+            for k, v in interesting.items():
+                lines.append(f"    {k} = {v}")
+        for dev, stats in self.device_memory.items():
+            if "bytes_in_use" in stats:
+                lines.append(
+                    f"  device {dev}: {stats['bytes_in_use']} bytes in use"
+                )
+        return "\n".join(lines)
+
+
+class RunRecorder:
+    """Context manager wrapping one fit/transform: opens (or joins) a
+    run scope, optionally a profiler session (``TPUML_PROFILE_DIR``),
+    snapshots counters, and on exit builds the :class:`RunReport`,
+    emits the ``counters`` flush + ``report`` events, and refreshes the
+    device-memory gauges. ``attach(model)`` hangs the report on the
+    fitted model (``model.fit_report()``)."""
+
+    def __init__(self, kind: str, label: str = ""):
+        self.kind = kind
+        self.label = label
+        self.report: Optional[RunReport] = None
+        self._scope = None
+        self._profile = None
+
+    def __enter__(self) -> "RunRecorder":
+        self._profile = maybe_profile(f"{self.kind}:{self.label}")
+        self._profile.__enter__()
+        self._scope = events.run_scope(self.kind, self.label)
+        self._ctx = self._scope.__enter__()
+        self._span_start = self._ctx.span_count()
+        self._t0 = time.monotonic()
+        self._counters0 = default_registry.counters_snapshot()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        wall = time.monotonic() - self._t0
+        try:
+            spans = self._ctx.span_window(self._span_start)
+            now = default_registry.counters_snapshot()
+            delta = {
+                k: v - self._counters0.get(k, 0)
+                for k, v in now.items()
+                if k.startswith(_REPORT_PREFIXES)
+                and v != self._counters0.get(k, 0)
+            }
+            self.report = RunReport(
+                run_id=self._ctx.run_id,
+                kind=self.kind,
+                label=self.label,
+                wall_seconds=wall,
+                spans=spans,
+                counters=delta,
+                device_memory=device_memory_stats(),
+                ok=exc_type is None,
+            )
+            if events.enabled():
+                events.emit("counters", counters=delta, kind=self.kind,
+                            label=self.label)
+                events.emit("report", kind=self.kind,
+                            summary=self.report.summary())
+        finally:
+            self._scope.__exit__(exc_type, exc, tb)
+            self._profile.__exit__(exc_type, exc, tb)
+        return False
+
+    def attach(self, obj: Any, attr: str = "_fit_report") -> None:
+        if obj is not None and self.report is not None:
+            try:
+                setattr(obj, attr, self.report)
+            except AttributeError:  # __slots__ objects opt out
+                pass
+
+
+# --- the serving-side report ------------------------------------------
+
+_serve_lock = threading.Lock()
+
+
+def serving_report() -> dict:
+    """Steady-state serving picture: program-cache stats (size from the
+    lock-guarded gauge, not hit/miss arithmetic), cache/compile/donation
+    counters, and the ``serving.batch_rows`` histogram."""
+    from spark_rapids_ml_tpu.core.serving import program_cache_stats
+
+    with _serve_lock:
+        stats = program_cache_stats()
+        counters = {
+            k: v
+            for k, v in default_registry.counters_snapshot("serving.").items()
+        }
+        hist = default_registry.histogram("serving.batch_rows").value()
+    return {
+        "cache": stats,
+        "cache_size_gauge": default_registry.gauge("serving.cache.size").value(),
+        "counters": counters,
+        "batch_rows": hist,
+    }

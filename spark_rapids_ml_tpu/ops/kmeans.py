@@ -267,7 +267,10 @@ def lloyd_resumable(
         replicate_state_onto_mesh,
         segment_boundary,
     )
-    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+    import time
+
+    from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
+    from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
     n = x.shape[0]
     k = init_centers.shape[0]
@@ -295,13 +298,18 @@ def lloyd_resumable(
         moved, it = float(state[1]), int(state[2])
         if not (moved > tol_sq and it < max_iter):
             break
-        state = _lloyd_segment(
-            x, mask, *state, tol,
-            max_iter=max_iter, every=checkpointer.every,
-            precision=precision, cosine=cosine, block_rows=block_rows,
-        )
-        bump_counter("checkpoint.segments")
-        bump_counter("checkpoint.solver_iters", int(state[2]) - it)
+        seg_t0 = time.perf_counter()
+        with TraceRange("segment kmeans.lloyd", TraceColor.PURPLE):
+            state = _lloyd_segment(
+                x, mask, *state, tol,
+                max_iter=max_iter, every=checkpointer.every,
+                precision=precision, cosine=cosine, block_rows=block_rows,
+            )
+            bump_counter("checkpoint.segments")
+            # int() blocks on the segment's device work, so the range —
+            # and the histogram — cover dispatch + execution.
+            bump_counter("checkpoint.solver_iters", int(state[2]) - it)
+        observe_segment_seconds("kmeans.lloyd", time.perf_counter() - seg_t0)
         checkpointer.save_async(int(state[2]), state)
         segment_boundary(checkpointer)
 

@@ -278,7 +278,10 @@ def solve_elastic_net_resumable(
         replicate_state_onto_mesh,
         segment_boundary,
     )
-    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+    import time
+
+    from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
+    from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
     a_quad, b_lin, l1, lip, x_mean, y_mean = _enet_prep(
         xtx, xty, x_sum, y_sum, count, reg_param, elastic_net_param,
@@ -300,12 +303,15 @@ def solve_elastic_net_resumable(
         it, delta = int(carry[3]), float(carry[4])
         if not (it < max_iter and delta > tol):
             break
-        carry = _enet_segment(
-            a_quad, b_lin, l1, lip, tol, *carry,
-            max_iter=max_iter, every=checkpointer.every,
-        )
-        bump_counter("checkpoint.segments")
-        bump_counter("checkpoint.solver_iters", int(carry[3]) - it)
+        seg_t0 = time.perf_counter()
+        with TraceRange("segment linear.enet", TraceColor.PURPLE):
+            carry = _enet_segment(
+                a_quad, b_lin, l1, lip, tol, *carry,
+                max_iter=max_iter, every=checkpointer.every,
+            )
+            bump_counter("checkpoint.segments")
+            bump_counter("checkpoint.solver_iters", int(carry[3]) - it)
+        observe_segment_seconds("linear.enet", time.perf_counter() - seg_t0)
         checkpointer.save_async(int(carry[3]), carry)
         segment_boundary(checkpointer)
 

@@ -343,7 +343,10 @@ def fit_logistic_resumable(
         replicate_state_onto_mesh,
         segment_boundary,
     )
-    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+    import time
+
+    from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
+    from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
     if n_classes < 2:
         raise ValueError(f"need at least 2 classes, got {n_classes}")
@@ -398,15 +401,18 @@ def fit_logistic_resumable(
         it, gn = int(carry[2]), float(carry[3])
         if not (it < max_iter and gn > tol):
             break
-        params, opt_state, it_a, gn_a = _lbfgs_segment(
-            x, y_target, mask, offset, scale, n,
-            reg_param, tol, carry[0], carry[1], carry[2], carry[3],
-            c=c, fit_intercept=fit_intercept, max_iter=max_iter,
-            every=checkpointer.every, precision=precision,
-        )
-        carry = (params, opt_state, it_a, gn_a)
-        bump_counter("checkpoint.segments")
-        bump_counter("checkpoint.solver_iters", int(it_a) - it)
+        seg_t0 = time.perf_counter()
+        with TraceRange("segment logistic.lbfgs", TraceColor.PURPLE):
+            params, opt_state, it_a, gn_a = _lbfgs_segment(
+                x, y_target, mask, offset, scale, n,
+                reg_param, tol, carry[0], carry[1], carry[2], carry[3],
+                c=c, fit_intercept=fit_intercept, max_iter=max_iter,
+                every=checkpointer.every, precision=precision,
+            )
+            carry = (params, opt_state, it_a, gn_a)
+            bump_counter("checkpoint.segments")
+            bump_counter("checkpoint.solver_iters", int(it_a) - it)
+        observe_segment_seconds("logistic.lbfgs", time.perf_counter() - seg_t0)
         checkpointer.save_async(int(it_a), carry)
         segment_boundary(checkpointer)
 

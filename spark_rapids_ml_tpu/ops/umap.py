@@ -336,7 +336,10 @@ def optimize_layout_resumable(
     snapshotted asynchronously between segments, resumed mid-schedule
     from the latest valid checkpoint. Bit-identical final layout."""
     from spark_rapids_ml_tpu.robustness.checkpoint import segment_boundary
-    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+    import time
+
+    from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
+    from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
     state = (embedding, jax.random.key_data(key), jnp.asarray(0))
     restored = checkpointer.restore_latest(template=state)
@@ -346,15 +349,18 @@ def optimize_layout_resumable(
     while int(ep) < n_epochs:
         start = int(ep)
         stop = min(start + checkpointer.every, n_epochs)
-        y, kd = _layout_segment(
-            y, kd, jnp.asarray(start), jnp.asarray(stop), graph,
-            learning_rate, repulsion, a, b, target,
-            n_epochs=n_epochs, neg_rate=neg_rate, neg_pool=neg_pool,
-            move_other=move_other,
-        )
-        ep = jnp.asarray(stop)
-        bump_counter("checkpoint.segments")
-        bump_counter("checkpoint.solver_iters", stop - start)
+        seg_t0 = time.perf_counter()
+        with TraceRange("segment umap.layout", TraceColor.PURPLE):
+            y, kd = _layout_segment(
+                y, kd, jnp.asarray(start), jnp.asarray(stop), graph,
+                learning_rate, repulsion, a, b, target,
+                n_epochs=n_epochs, neg_rate=neg_rate, neg_pool=neg_pool,
+                move_other=move_other,
+            )
+            ep = jnp.asarray(stop)
+            bump_counter("checkpoint.segments")
+            bump_counter("checkpoint.solver_iters", stop - start)
+        observe_segment_seconds("umap.layout", time.perf_counter() - seg_t0)
         checkpointer.save_async(stop, (y, kd, ep))
         segment_boundary(checkpointer)
     checkpointer.finalize_success()

@@ -167,6 +167,23 @@ def initialize(
         "num_processes": num_processes,
         "process_id": process_id,
     }
+    # Stamp the event-log envelope with this process's gang index and
+    # record the bring-up, so every later record from this process is
+    # attributable in a merged multi-process stream.
+    from spark_rapids_ml_tpu.observability.events import emit, set_process_index
+
+    try:
+        set_process_index(
+            process_id if process_id is not None else jax.process_index()
+        )
+    except RuntimeError:  # backend not queryable yet — keep env fallback
+        pass
+    emit(
+        "distributed",
+        action="initialize",
+        coordinator=coordinator_address,
+        num_processes=num_processes,
+    )
 
 
 def bringup_executor(

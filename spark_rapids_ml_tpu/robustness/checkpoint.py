@@ -44,6 +44,7 @@ between segments, where chaos tests kill a fit mid-solve).
 
 from __future__ import annotations
 
+import contextvars
 import glob
 import hashlib
 import io
@@ -56,9 +57,10 @@ from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
+from spark_rapids_ml_tpu.observability.events import emit
 from spark_rapids_ml_tpu.robustness.faults import InjectedFault, fault_point
 from spark_rapids_ml_tpu.utils.envknobs import env_int, env_str
-from spark_rapids_ml_tpu.utils.tracing import bump_counter
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
 SCHEMA_VERSION = 1
 
@@ -257,6 +259,8 @@ class FitCheckpointer:
             step = int(meta["step"])
             bump_counter("checkpoint.restore")
             bump_counter("checkpoint.restore.steps", step)
+            emit("checkpoint", action="restore", step=step, path=path,
+                 uid=self.uid, solver=self.solver)
             return step, tree_util.tree_unflatten(treedef, leaves)
         return None
 
@@ -270,16 +274,26 @@ class FitCheckpointer:
         atomic write all happen off-thread, so the solver dispatches its
         next segment immediately. At most one write is in flight —
         ordering is preserved by joining the previous one first (a join
-        that only waits when writes are slower than whole segments)."""
+        that only waits when writes are slower than whole segments).
+
+        The writer runs under a COPY of the caller's context, so the
+        ambient run scope rides along: the write's span and its
+        ``checkpoint`` event carry the fit's ``run_id`` even though they
+        land from another thread."""
         leaves, _ = _tree_flatten(state)
         self.wait()
+        ctx = contextvars.copy_context()
         t = threading.Thread(
-            target=self._write, args=(step, leaves), daemon=True
+            target=ctx.run, args=(self._write, step, leaves), daemon=True
         )
         t.start()
         self._pending = t
 
     def _write(self, step: int, leaves: list) -> None:
+        with TraceRange("checkpoint write", TraceColor.ORANGE):
+            self._write_inner(step, leaves)
+
+    def _write_inner(self, step: int, leaves: list) -> None:
         from spark_rapids_ml_tpu.core.persistence import atomic_file_write
 
         final = os.path.join(self.run_dir, f"ckpt-{step:08d}.npz")
@@ -314,9 +328,13 @@ class FitCheckpointer:
                 raise
             atomic_file_write(final, data)
             bump_counter("checkpoint.write")
+            emit("checkpoint", action="write", step=step, path=final,
+                 uid=self.uid, solver=self.solver, bytes=len(data))
             self._prune()
         except BaseException as exc:
             bump_counter("checkpoint.write_failed")
+            emit("checkpoint", action="write_failed", step=step,
+                 uid=self.uid, error=type(exc).__name__)
             warnings.warn(
                 CheckpointWriteWarning(
                     f"checkpoint write for step {step} of {self.uid} failed "
@@ -348,6 +366,8 @@ class FitCheckpointer:
         self.wait()
         shutil.rmtree(self.run_dir, ignore_errors=True)
         bump_counter("checkpoint.completed")
+        emit("checkpoint", action="finalize", step=-1, uid=self.uid,
+             solver=self.solver)
 
 
 def segment_boundary(checkpointer: Optional["FitCheckpointer"] = None) -> None:

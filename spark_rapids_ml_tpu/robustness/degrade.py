@@ -26,6 +26,7 @@ from __future__ import annotations
 import warnings
 from typing import Any, Callable, Optional, TypeVar
 
+from spark_rapids_ml_tpu.observability.events import emit
 from spark_rapids_ml_tpu.robustness.retry import RetryExhaustedError
 from spark_rapids_ml_tpu.utils.envknobs import env_choice
 
@@ -100,22 +101,24 @@ def run_degradable(
     except RetryExhaustedError as exc:
         if degrade_mode() != "cpu":
             raise
-        warnings.warn(
-            DegradationWarning(
-                what,
-                f"retry budget exhausted at {site or exc.name}",
-                "the CPU path",
-            ),
-            stacklevel=2,
+        _record_degradation(
+            what, f"retry budget exhausted at {site or exc.name}"
         )
         return cpu_fn()
     except RuntimeError as exc:
         if not backend_unavailable(exc) or degrade_mode() != "cpu":
             raise
-        warnings.warn(
-            DegradationWarning(
-                what, f"accelerator backend unavailable ({exc})", "the CPU path"
-            ),
-            stacklevel=2,
-        )
+        _record_degradation(what, f"accelerator backend unavailable ({exc})")
         return cpu_fn()
+
+
+def _record_degradation(what: str, why: str) -> None:
+    """One degradation: the structured warning (unchanged surface), a
+    ``degrade`` event-log record, and a counter for dashboards."""
+    from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+    warnings.warn(
+        DegradationWarning(what, why, "the CPU path"), stacklevel=3
+    )
+    bump_counter("degrade.events")
+    emit("degrade", what=what, why=why, fallback="cpu")

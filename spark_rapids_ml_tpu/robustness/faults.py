@@ -49,6 +49,8 @@ import os
 import threading
 from typing import Dict, List, Optional, Tuple, Union
 
+from spark_rapids_ml_tpu.observability.events import emit
+
 KNOWN_SITES = frozenset(
     {
         "ingest.device_put",
@@ -184,6 +186,8 @@ class FaultPlan:
             self._counts[site] = invocation + 1
             if sched.should_fail(invocation):
                 self.fired.append((site, invocation))
+                emit("fault", action="fire", site=site, invocation=invocation,
+                     fatal=sched.fatal, torn=sched.torn)
                 raise InjectedFault(
                     site, invocation, fatal=sched.fatal, torn=sched.torn
                 )
@@ -213,12 +217,14 @@ def arm(spec: Union[str, Dict[str, Schedule]]) -> FaultPlan:
     global _active
     plan = FaultPlan(parse_spec(spec) if isinstance(spec, str) else spec)
     _active = plan
+    emit("fault", action="arm", sites=sorted(plan._schedules))
     return plan
 
 
 def disarm() -> None:
     global _active
     _active = None
+    emit("fault", action="disarm")
 
 
 class inject:

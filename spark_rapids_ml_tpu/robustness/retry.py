@@ -141,6 +141,11 @@ class RetryPolicy:
         name: str,
         on_retry: Optional[Callable[[int, BaseException], None]] = None,
     ) -> T:
+        from spark_rapids_ml_tpu.observability.events import emit
+        from spark_rapids_ml_tpu.observability.metrics import (
+            TIME_BUCKETS,
+            histogram,
+        )
         from spark_rapids_ml_tpu.utils.tracing import (
             TraceColor,
             TraceRange,
@@ -157,23 +162,39 @@ class RetryPolicy:
                 # last is non-None here: attempt 0 starts before any
                 # deadline check can trip (time 0 <= deadline).
                 bump_counter(f"retry.{name}.exhausted")
+                emit("retry", site=name, attempt=attempt, outcome="exhausted",
+                     error=type(last).__name__ if last else None)
                 raise RetryExhaustedError(
                     name, attempt, last, f"deadline of {self.deadline}s exceeded"
                 ) from last
             try:
                 bump_counter(f"retry.{name}.attempts")
                 with TraceRange(f"retry:{name}#{attempt}", TraceColor.YELLOW):
-                    return fn()
+                    result = fn()
+                emit("retry", site=name, attempt=attempt, outcome="ok")
+                return result
             except BaseException as exc:
                 if classify(exc) == "fatal":
+                    emit("retry", site=name, attempt=attempt, outcome="fatal",
+                         error=type(exc).__name__)
                     raise
                 last = exc
                 if on_retry is not None and attempt + 1 < self.max_attempts:
                     on_retry(attempt, exc)
             delay = self.backoff(name, attempt + 1)
-            if delay > 0 and attempt + 1 < self.max_attempts:
-                time.sleep(delay)
+            if attempt + 1 < self.max_attempts:
+                histogram(
+                    "retry.backoff_seconds",
+                    "backoff slept between retry attempts",
+                    buckets=TIME_BUCKETS,
+                ).observe(delay, site=name)
+                emit("retry", site=name, attempt=attempt, outcome="retry",
+                     error=type(last).__name__, backoff=delay)
+                if delay > 0:
+                    time.sleep(delay)
         bump_counter(f"retry.{name}.exhausted")
+        emit("retry", site=name, attempt=self.max_attempts, outcome="exhausted",
+             error=type(last).__name__ if last else None)
         raise RetryExhaustedError(
             name, self.max_attempts, last, "retry budget exhausted"
         ) from last

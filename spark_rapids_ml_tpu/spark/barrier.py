@@ -94,6 +94,7 @@ def barrier_gang_run(
     def wrapped(it):
         from pyspark import BarrierTaskContext
 
+        from spark_rapids_ml_tpu.observability.heartbeat import heartbeat_scope
         from spark_rapids_ml_tpu.robustness.faults import fault_point
 
         if checkpoint_dir is not None:
@@ -104,7 +105,16 @@ def barrier_gang_run(
         if ctx is not None:
             ctx.barrier()
         fault_point("barrier.attempt")
-        return task_fn(ctx, it)
+        try:
+            member = int(ctx.partitionId()) if ctx is not None else 0
+        except Exception:  # a stub context without partitionId
+            member = 0
+        # Per-member heartbeat stream for the task's whole lifetime
+        # (TPUML_GANG_HEARTBEAT_EVERY; observability/heartbeat.py): a
+        # stuck member's heartbeat age grows while its peers' stay near
+        # zero — visible BEFORE the stage deadline fires.
+        with heartbeat_scope(member, what="barrier"):
+            return task_fn(ctx, it)
 
     def fallback(it):
         # Degraded (driver-local) execution: no barrier, no gang, ctx=None
@@ -119,13 +129,19 @@ def barrier_gang_run(
             max_attempts=env_int(BARRIER_RESUBMITS_ENV, 1, minimum=1)
         )
 
+    from spark_rapids_ml_tpu.observability.events import emit
     from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+    def _on_resubmit(attempt, exc):
+        bump_counter("gang.resubmit")
+        emit("barrier", action="resubmit", attempt=attempt,
+             error=type(exc).__name__)
 
     return run_degradable(
         lambda: policy.run(
             lambda: rdd.barrier().mapPartitions(wrapped).collect(),
             name="barrier.stage",
-            on_retry=lambda attempt, exc: bump_counter("gang.resubmit"),
+            on_retry=_on_resubmit,
         ),
         lambda: rdd.mapPartitions(fallback).collect(),
         what="barrier gang fit",

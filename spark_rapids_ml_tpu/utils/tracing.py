@@ -1,20 +1,30 @@
-"""Profiling ranges — the NVTX subsystem, TPU-native.
+"""Profiling ranges + counter aliases — compat shim over ``observability/``.
 
-Reference: RAII ``NvtxRange`` (NvtxRange.java:37-58) + 9 ARGB colors
-(NvtxColor.java:20-29) + a JNI push/pop into an NVTX "Java" domain
-(rapidsml_jni.cu:32-34, 69-92), viewed in nsys.
+Historically this module WAS the observability layer: an NVTX-parity
+RAII range (reference ``NvtxRange``, NvtxRange.java:37-58, 9 ARGB colors
+NvtxColor.java:20-29, JNI push/pop rapidsml_jni.cu:32-34) backed by
+``jax.profiler.TraceAnnotation``, a ring buffer of (name, start, end)
+for profiler-less assertions, and a flat counter dict. The typed metrics
+registry, the JSONL event log, reports and heartbeats now live in
+``spark_rapids_ml_tpu/observability/``; this module keeps every legacy
+name working and remains the one import the instrumented layers use:
 
-TPU equivalent (per SURVEY.md §5): the same RAII surface backed by
-``jax.profiler.TraceAnnotation`` (XLA TraceMe), which lands in
-xprof/TensorBoard profile traces instead of nsys. Colors are retained for API
-parity and attached to the annotation name; a process-local ring buffer of
-(name, start, end) is kept so tests and the bench can assert instrumentation
-without a profiler session. The native C++ runtime exposes the same push/pop
-pair (native/src/tpuml_host.cpp) for ranges opened from C++.
+  - :class:`TraceRange` / ``NvtxRange`` — the RAII range, now also
+    recording span id / parent id / depth, an ``ok`` flag and the
+    exception type when the body raises (the old ``__exit__`` dropped
+    ``exc`` on the floor), feeding the ambient run context (for
+    ``model.fit_report()`` stage trees) and the event log (as ``span``
+    records) when either is active. The ring buffer keeps its exact
+    3-tuple shape; the disabled path stays allocation-light (budget test
+    in tests/test_observability.py).
+  - ``bump_counter`` / ``counter_value`` / ``counters`` /
+    ``clear_counters`` — aliases over the typed registry's counters,
+    same flat-dict semantics as before.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -22,6 +32,13 @@ from enum import Enum
 from typing import Deque, Optional, Tuple
 
 import jax
+
+from spark_rapids_ml_tpu.observability.events import (
+    current_run as _current_run,
+    emit as _emit,
+    enabled as _log_enabled,
+)
+from spark_rapids_ml_tpu.observability.metrics import default_registry
 
 
 class TraceColor(Enum):
@@ -44,36 +61,26 @@ NvtxColor = TraceColor
 _events_lock = threading.Lock()
 _events: Deque[Tuple[str, float, float]] = deque(maxlen=4096)
 
-# Named monotonic counters — the quantitative sibling of the range ring
-# buffer. The serving layer (core/serving.py) publishes its program-cache
-# hit/miss/evict/compile totals here so tests and the bench can assert
-# "zero compiles on the warm path" without a profiler session, the same
-# way the ring buffer lets them assert a range fired.
-_counters_lock = threading.Lock()
-_counters: dict = {}
+
+# --- counter aliases (the PR 2 surface, now registry-backed) ---
 
 
 def bump_counter(name: str, amount: int = 1) -> None:
     """Increment a named counter (created at zero on first bump)."""
-    with _counters_lock:
-        _counters[name] = _counters.get(name, 0) + amount
+    default_registry.counter(name).inc(amount)
 
 
 def counter_value(name: str) -> int:
-    with _counters_lock:
-        return _counters.get(name, 0)
+    return default_registry.counter(name).value()
 
 
 def counters(prefix: str = "") -> dict:
     """Snapshot of all counters whose name starts with ``prefix``."""
-    with _counters_lock:
-        return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+    return default_registry.counters_snapshot(prefix)
 
 
 def clear_counters(prefix: str = "") -> None:
-    with _counters_lock:
-        for k in [k for k in _counters if k.startswith(prefix)]:
-            del _counters[k]
+    default_registry.clear(prefix, kinds=("counter",))
 
 
 def recent_events() -> list:
@@ -86,30 +93,89 @@ def clear_events() -> None:
         _events.clear()
 
 
+# --- the RAII range ---
+
+_span_ids = itertools.count(1)
+_span_stack = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_span_stack, "s", None)
+    if s is None:
+        s = _span_stack.s = []
+    return s
+
+
 class TraceRange:
     """RAII profiling range: ``with TraceRange("compute cov", TraceColor.RED): ...``
 
     Same call sites as the reference's instrumentation (RapidsRowMatrix.scala:
     78 "compute cov" RED, :153 "mean center" ORANGE, :183 "concat before cov"
     PURPLE, :193 "gemm" GREEN, :88/:111 "SVD" BLUE).
+
+    Each range carries a process-unique ``span_id``; nesting is tracked
+    per thread, so ``parent_id``/``depth`` let reports rebuild the stage
+    tree. On exit, ``ok`` records whether the body raised and
+    ``exc_type`` the exception class name — visible in the run context's
+    span records and the event log, where the old implementation
+    silently discarded them.
     """
+
+    __slots__ = (
+        "name", "color", "_annotation", "_start",
+        "span_id", "parent_id", "depth", "ok", "exc_type",
+    )
 
     def __init__(self, name: str, color: Optional[TraceColor] = None):
         self.name = name
         self.color = color
         self._annotation = jax.profiler.TraceAnnotation(name)
         self._start = 0.0
+        self.ok = True
+        self.exc_type: Optional[str] = None
 
     def __enter__(self) -> "TraceRange":
+        stack = _stack()
+        self.parent_id = stack[-1] if stack else None
+        self.depth = len(stack)
+        self.span_id = next(_span_ids)
+        stack.append(self.span_id)
         self._start = time.perf_counter()
         self._annotation.__enter__()
         return self
 
-    def __exit__(self, *exc) -> None:
-        self._annotation.__exit__(*exc)
+    def __exit__(self, exc_type=None, exc=None, tb=None) -> None:
+        self._annotation.__exit__(exc_type, exc, tb)
         end = time.perf_counter()
+        stack = _stack()
+        if stack and stack[-1] == self.span_id:
+            stack.pop()
+        elif self.span_id in stack:  # tolerate interleaved exits
+            stack.remove(self.span_id)
+        self.ok = exc_type is None
+        self.exc_type = getattr(exc_type, "__name__", None)
         with _events_lock:
             _events.append((self.name, self._start, end))
+        # Everything below is inert unless a run scope or event sink is
+        # active — the production disabled path allocates one dict at most
+        # when a report is actually being recorded.
+        ctx = _current_run()
+        if ctx is not None or _log_enabled():
+            record = {
+                "name": self.name,
+                "start": self._start,
+                "end": end,
+                "dur": end - self._start,
+                "ok": self.ok,
+                "exc": self.exc_type,
+                "depth": self.depth,
+                "parent": self.parent_id,
+                "span": self.span_id,
+                "thread": threading.get_ident(),
+            }
+            if ctx is not None:
+                ctx.add_span(record)
+            _emit("span", **record)
 
 
 # Alias matching the reference class name (NvtxRange.java:37).

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Dump/inspect spark-tpu-ml telemetry: event logs and metric snapshots.
+
+Two subcommands:
+
+``events`` — parse a ``TPUML_EVENT_LOG`` JSONL stream, schema-validate
+every record (the same :func:`observability.events.validate_record` the
+tests use), and summarize per run: event counts by type, span count and
+total span seconds, counters flushed at run end. ``--validate`` exits
+non-zero on the first malformed line (the CI gate); ``--run`` restricts
+to one run id; ``--format json`` emits the summary machine-readable.
+
+``snapshot`` — render a ``TPUML_METRICS_DUMP`` JSON snapshot (or one
+written via ``observability.metrics.dump_snapshot``) as Prometheus-style
+text, or pretty-print it.
+
+Examples::
+
+    python tools/tpuml_metrics.py events /tmp/run.jsonl
+    python tools/tpuml_metrics.py events /tmp/run.jsonl --validate
+    python tools/tpuml_metrics.py snapshot /tmp/metrics.json --format prom
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def _import_validate_record():
+    """The shared schema validator — importable both with the package
+    installed and when this script runs straight from a checkout."""
+    try:
+        from spark_rapids_ml_tpu.observability.events import validate_record
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from spark_rapids_ml_tpu.observability.events import validate_record
+    return validate_record
+
+
+def parse_lines(lines: Iterable[str]) -> Tuple[List[dict], List[str]]:
+    """Decode + schema-validate a JSONL stream. Returns
+    ``(records, problems)`` where each problem names its line number."""
+    validate_record = _import_validate_record()
+
+    records: List[dict] = []
+    problems: List[str] = []
+    for i, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as exc:
+            problems.append(f"line {i}: not JSON ({exc})")
+            continue
+        for p in validate_record(rec):
+            problems.append(f"line {i}: {p}")
+        records.append(rec)
+    return records, problems
+
+
+def summarize(records: List[dict], run: Optional[str] = None) -> dict:
+    """Per-run rollup: event counts by type, span totals, the end-of-run
+    counter flush, and any failed spans."""
+    runs: Dict[str, dict] = {}
+    for rec in records:
+        rid = rec.get("run_id") or "<no-run>"
+        if run is not None and rid != run:
+            continue
+        cell = runs.setdefault(
+            rid,
+            {"events": {}, "spans": 0, "span_seconds": 0.0,
+             "failed_spans": [], "counters": {}, "processes": set()},
+        )
+        etype = rec.get("event", "?")
+        cell["events"][etype] = cell["events"].get(etype, 0) + 1
+        cell["processes"].add(rec.get("process"))
+        if etype == "span":
+            cell["spans"] += 1
+            cell["span_seconds"] += float(rec.get("dur", 0.0))
+            if rec.get("ok") is False:
+                cell["failed_spans"].append(
+                    {"name": rec.get("name"), "exc": rec.get("exc")}
+                )
+        elif etype == "counters":
+            cell["counters"].update(rec.get("counters") or {})
+    for cell in runs.values():
+        cell["processes"] = sorted(
+            p for p in cell["processes"] if p is not None
+        )
+    return {"runs": runs, "total_records": sum(
+        sum(c["events"].values()) for c in runs.values()
+    )}
+
+
+def _render_summary(summary: dict) -> str:
+    lines = [f"{summary['total_records']} records"]
+    for rid, cell in summary["runs"].items():
+        lines.append(f"run {rid}  (processes {cell['processes'] or [0]})")
+        ev = ", ".join(f"{k}={v}" for k, v in sorted(cell["events"].items()))
+        lines.append(f"  events: {ev}")
+        lines.append(
+            f"  spans: {cell['spans']} totaling {cell['span_seconds']:.3f}s"
+        )
+        for f in cell["failed_spans"]:
+            lines.append(f"  FAILED span {f['name']}: {f['exc']}")
+        for k, v in sorted(cell["counters"].items()):
+            lines.append(f"  counter {k} = {v}")
+    return "\n".join(lines)
+
+
+def render_snapshot_prometheus(snapshot: dict) -> str:
+    """A ``metrics.Registry.snapshot()`` JSON dict as Prometheus text."""
+    def prom(name: str) -> str:
+        base, _, labels = name.partition("{")
+        out = "".join(c if (c.isalnum() or c == "_") else "_" for c in base)
+        return f"tpuml_{out}" + (f"{{{labels}" if labels else "")
+
+    lines = []
+    for kind, metrics in (("counter", snapshot.get("counters", {})),
+                          ("gauge", snapshot.get("gauges", {}))):
+        for name, value in sorted(metrics.items()):
+            lines.append(f"# TYPE {prom(name).partition('{')[0]} {kind}")
+            lines.append(f"{prom(name)} {float(value)}")
+    for name, series in sorted(snapshot.get("histograms", {}).items()):
+        pname = prom(name).partition("{")[0]
+        lines.append(f"# TYPE {pname} histogram")
+        for sname, cell in sorted(series.items()):
+            for le, c in cell["buckets"].items():
+                le_s = "+Inf" if le in ("inf", "Infinity") else le
+                lines.append(f'{pname}_bucket{{le="{le_s}"}} {c}')
+            lines.append(f"{pname}_sum {cell['sum']}")
+            lines.append(f"{pname}_count {cell['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_ev = sub.add_parser("events", help="summarize/validate a JSONL event log")
+    p_ev.add_argument("path")
+    p_ev.add_argument("--run", default=None, help="restrict to one run_id")
+    p_ev.add_argument("--validate", action="store_true",
+                      help="exit 1 if any line is malformed")
+    p_ev.add_argument("--format", choices=("text", "json"), default="text")
+
+    p_sn = sub.add_parser("snapshot", help="render a metrics snapshot")
+    p_sn.add_argument("path")
+    p_sn.add_argument("--format", choices=("prom", "json"), default="prom")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "events":
+        with open(args.path) as f:
+            records, problems = parse_lines(f)
+        for p in problems:
+            print(f"INVALID {p}", file=sys.stderr)
+        summary = summarize(records, run=args.run)
+        if args.format == "json":
+            print(json.dumps(summary, indent=2, default=str))
+        else:
+            print(_render_summary(summary))
+        return 1 if (args.validate and problems) else 0
+
+    with open(args.path) as f:
+        snapshot = json.load(f)
+    if args.format == "json":
+        print(json.dumps(snapshot, indent=2))
+    else:
+        print(render_snapshot_prometheus(snapshot), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
