@@ -1,0 +1,114 @@
+"""Config 16: online-serving throughput — micro-batched vs unbatched.
+
+The serving-runtime claim (ISSUE 5): N concurrent single-row callers
+should share one AOT execution per coalesced batch, not pay one device
+program each. Two closed-loop runs over the SAME registered model and
+the same request stream, one JSON line:
+
+  - ``unbatched_rows_s``: ``max_batch=1`` — every request dispatches its
+    own program (the no-coalescing floor; dispatch overhead per row).
+  - ``value`` (rows/s): ``max_batch=THREADS`` with a straggler delay
+    window — the micro-batcher coalesces concurrent submitters into
+    shared bucketed executions, and a full round of closed-loop workers
+    fills the batch so it flushes WITHOUT waiting out the delay
+    (acceptance: batched >= 3x unbatched on CPU).
+
+Both runs are warmed first (every reachable row bucket pre-compiled),
+so the ratio measures dispatch amortization, not compilation. Knobs for
+small hosts: ``TPUML_BENCH_THREADS`` / ``_REQUESTS`` / ``_COLS`` / ``_K``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import emit
+
+THREADS = int(os.environ.get("TPUML_BENCH_THREADS", 16))
+REQUESTS = int(os.environ.get("TPUML_BENCH_REQUESTS", 150))
+D = int(os.environ.get("TPUML_BENCH_COLS", 32))
+K = int(os.environ.get("TPUML_BENCH_K", 8))
+
+
+def closed_loop(rt, name, probes) -> float:
+    """Drive THREADS workers, one outstanding single-row request each;
+    returns the wall-clock of the full run."""
+
+    def worker(tid: int) -> None:
+        for j in range(REQUESTS):
+            rt.submit(name, probes[tid, j]).result(timeout=120)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(THREADS)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    import numpy as np
+
+    from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+    from spark_rapids_ml_tpu.serving import ServingRuntime
+    from spark_rapids_ml_tpu.utils.tracing import counter_value
+
+    rng = np.random.default_rng(16)
+    model = KMeansModel("bench-serve", rng.normal(size=(K, D)))
+    probes = rng.normal(size=(THREADS, REQUESTS, D))
+    total = THREADS * REQUESTS
+
+    def fresh(max_batch: int, delay_ms: float) -> ServingRuntime:
+        rt = ServingRuntime(
+            max_batch=max_batch, max_delay_ms=delay_ms, queue_limit=4 * total
+        )
+        rt.register("km", model)
+        # Warm every bucket a coalesced batch can land in (pow-2 from the
+        # single-row bucket up to max_batch) so neither run compiles.
+        rt.warm("km", buckets=[1 << p for p in range(9) if (1 << p) <= max_batch])
+        return rt
+
+    # Unbatched floor: one device program per request.
+    rt = fresh(max_batch=1, delay_ms=0.0)
+    d0 = counter_value("serving.batch.dispatch")
+    unbatched_wall = closed_loop(rt, "km", probes)
+    unbatched_dispatches = counter_value("serving.batch.dispatch") - d0
+    rt.close()
+    assert unbatched_dispatches == total, "max_batch=1 must not coalesce"
+
+    # Micro-batched: concurrent submitters share bucketed executions.
+    # max_batch == the closed-loop population, so a full round flushes
+    # immediately; the delay window only ever covers stragglers.
+    rt = fresh(max_batch=THREADS, delay_ms=5.0)
+    d0 = counter_value("serving.batch.dispatch")
+    batched_wall = closed_loop(rt, "km", probes)
+    batched_dispatches = counter_value("serving.batch.dispatch") - d0
+    rt.close()
+    assert batched_dispatches * 4 <= total, (
+        f"micro-batcher coalesced only {total / batched_dispatches:.1f}x"
+    )
+
+    batched_rows_s = total / batched_wall
+    unbatched_rows_s = total / unbatched_wall
+    emit(
+        f"serving_runtime_batched_{THREADS}x{REQUESTS}_d{D}",
+        batched_rows_s,
+        "rows/s",
+        unbatched_rows_s=round(unbatched_rows_s, 1),
+        batched_vs_unbatched=round(batched_rows_s / unbatched_rows_s, 1),
+        batched_dispatches=batched_dispatches,
+        unbatched_dispatches=unbatched_dispatches,
+        requests_per_batch=round(total / batched_dispatches, 1),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,7 @@ CONFIGS = [
     ("config13_umap.py", {}),
     ("config14_evaluators.py", {}),
     ("config15_serving.py", {}),
+    ("config16_server.py", {}),
 ]
 
 

@@ -59,6 +59,7 @@ from __future__ import annotations
 import os
 import threading
 import warnings
+import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
@@ -91,6 +92,19 @@ MIN_ROW_BUCKET = 8
 
 #: Default bound on the AOT program LRU (``TPUML_SERVING_CACHE_SIZE``).
 DEFAULT_CACHE_SIZE = 32
+
+#: Row-block size for routing LARGE host batches through the
+#: double-buffered :func:`serve_stream` path (``TPUML_SERVE_STREAM_BLOCK``):
+#: a host batch bigger than one block pipelines H2D against compute
+#: instead of paying one serialized transfer of the whole matrix.
+DEFAULT_STREAM_BLOCK = 65536
+
+STREAM_BLOCK_ENV = "TPUML_SERVE_STREAM_BLOCK"
+
+
+def stream_block_rows() -> int:
+    """Rows per block for host-batch streaming (``TPUML_SERVE_STREAM_BLOCK``)."""
+    return env_int(STREAM_BLOCK_ENV, DEFAULT_STREAM_BLOCK, minimum=1)
 
 
 def bucket_rows(n: int, min_bucket: int = MIN_ROW_BUCKET) -> int:
@@ -180,13 +194,65 @@ def program_cache_stats() -> dict:
 
 
 def clear_program_cache() -> None:
-    """Drop every cached executable and zero the stats (tests, reconfigs)."""
+    """Drop every cached executable and zero the stats (tests, reconfigs).
+
+    Also invalidates the per-model DEVICE-WEIGHT caches (``_centers_dev``,
+    ``_wb_dev``, ``_coef_dev``, ``_forest_dev``, PCA's per-dtype component
+    cache) of every model that ever populated one: an executable cache
+    reset is a reconfiguration boundary, and a model whose weights were
+    hot-swapped underneath must not keep serving the stale device copy."""
     with _LOCK:
         _PROGRAMS.clear()
         _JIT_FALLBACKS.clear()
         for k in _STATS:
             _STATS[k] = 0
         _publish_cache_size()
+        models = list(_DEVICE_CACHED_MODELS)
+    for model in models:
+        invalidate_device_caches(model)
+
+
+#: Attributes holding a model family's device-resident weight copy
+#: (single array / pytree — dropped to None) and dict-shaped caches
+#: (cleared in place). One list so every family retires the same way.
+_DEVICE_CACHE_ATTRS = ("_centers_dev", "_wb_dev", "_coef_dev", "_forest_dev")
+_DEVICE_CACHE_DICTS = ("_pc_dev_cache",)
+
+#: Models that populated a device-weight cache (weakly held): the set
+#: :func:`clear_program_cache` sweeps so a cache reset cannot leave any
+#: model serving stale device weights.
+_DEVICE_CACHED_MODELS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def note_device_cache(model: Any) -> None:
+    """Record that ``model`` holds a device-weight cache (called by the
+    model families' lazy cache builders)."""
+    with _LOCK:
+        _DEVICE_CACHED_MODELS.add(model)
+
+
+def invalidate_device_caches(model: Any) -> int:
+    """Drop every device-weight cache ``model`` carries; returns how many
+    were live. The shared retire hook: the model registry calls this when
+    a version is retired or hot-swapped, and :func:`clear_program_cache`
+    sweeps it over every tracked model — either way the next predict
+    re-uploads from the model's host truth instead of serving stale
+    device bytes."""
+    dropped = 0
+    for attr in _DEVICE_CACHE_ATTRS:
+        if getattr(model, attr, None) is not None:
+            setattr(model, attr, None)
+            dropped += 1
+    for attr in _DEVICE_CACHE_DICTS:
+        cache = getattr(model, attr, None)
+        if cache:
+            cache.clear()
+            dropped += 1
+    if dropped:
+        bump_counter("serving.device_cache.invalidate", dropped)
+        emit("serving", action="invalidate",
+             model=type(model).__name__, caches=dropped)
+    return dropped
 
 
 def _spec_key(spec) -> tuple:
@@ -514,3 +580,49 @@ def serve_stream(
 
     if pending is not None:
         yield _slice_outputs(pending[0], pending[1], pending[2], True)
+
+
+# ---------------------------------------------------------------------------
+# serve_blocks — large host batches through the streaming path
+# ---------------------------------------------------------------------------
+
+
+def serve_blocks(
+    fn: Callable,
+    x_host: np.ndarray,
+    args: tuple = (),
+    *,
+    name: str,
+    static: Optional[dict] = None,
+    block: Optional[int] = None,
+):
+    """Run one LARGE host batch through :func:`serve_stream` in row blocks
+    and concatenate the host results — the double-buffered path (H2D of
+    block k+1 overlaps compute of block k) that ``models/pca.py`` already
+    uses, packaged so every family's big host-batch predict can take it
+    instead of paying one serialized whole-matrix transfer.
+
+    Results are bitwise what :func:`serve_rows` returns for the same
+    batch: every serving kernel is row-wise, so a row's output does not
+    depend on which block carried it. Tuple/pytree outputs concatenate
+    leaf-wise along the leading axis.
+    """
+    import jax
+
+    block = block or stream_block_rows()
+    x_host = np.asarray(x_host)
+    n = x_host.shape[0]
+    dtype = _compute_dtype(x_host.dtype)
+    blocks = (x_host[i : i + block] for i in range(0, n, block))
+    outs = list(
+        serve_stream(fn, blocks, args, name=name, static=static, dtype=dtype)
+    )
+    if len(outs) == 1:
+        return outs[0]
+    leaves0, treedef = jax.tree_util.tree_flatten(outs[0])
+    rest = [jax.tree_util.tree_flatten(o)[0] for o in outs[1:]]
+    cat = [
+        np.concatenate([first] + [r[i] for r in rest], axis=0)
+        for i, first in enumerate(leaves0)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, cat)

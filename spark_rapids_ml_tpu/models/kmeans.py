@@ -47,7 +47,12 @@ from spark_rapids_ml_tpu.ops.kmeans import (
     normalize_rows,
     random_init,
 )
-from spark_rapids_ml_tpu.core.serving import serve_rows
+from spark_rapids_ml_tpu.core.serving import (
+    note_device_cache,
+    serve_blocks,
+    serve_rows,
+    stream_block_rows,
+)
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
@@ -559,6 +564,21 @@ class KMeansModel(_KMeansParams, Model, LazyHostState):
         if self._centers_raw is None:
             raise RuntimeError("model has no cluster centers")
         x = matrix_like(x)
+        static = {"cosine": self.getDistanceMeasure() == "cosine"}
+        # Large HOST batches stream block by block (double-buffered: the
+        # H2D of block k+1 overlaps the assignment GEMM of block k —
+        # the PCA transform's discipline) instead of paying one
+        # serialized whole-matrix transfer.
+        if not is_device_array(x):
+            xh = np.asarray(x)
+            if xh.ndim == 2 and xh.shape[0] > stream_block_rows():
+                return serve_blocks(
+                    _assign_kernel,
+                    xh,
+                    (self._centers_serving(),),
+                    static=static,
+                    name="kmeans.predict",
+                )
         # Device queries get device labels (no host pull the caller didn't
         # ask for); host queries keep the numpy contract. Both run through
         # the shape-bucketed serving program cache.
@@ -566,7 +586,7 @@ class KMeansModel(_KMeansParams, Model, LazyHostState):
             _assign_kernel,
             x,
             (self._centers_serving(),),
-            static={"cosine": self.getDistanceMeasure() == "cosine"},
+            static=static,
             name="kmeans.predict",
         )
 
@@ -579,7 +599,29 @@ class KMeansModel(_KMeansParams, Model, LazyHostState):
             return raw
         if self._centers_dev is None:
             self._centers_dev = jnp.asarray(self._centers)
+            note_device_cache(self)
         return self._centers_dev
+
+    def serving_signature(self):
+        """The online-serving contract (serving/signature.py): the same
+        assignment kernel ``predict`` routes through the program cache,
+        the device-resident centers, and the label output spec the
+        admission controller prices requests with."""
+        from spark_rapids_ml_tpu.serving.signature import ServingSignature
+
+        if self._centers_raw is None:
+            raise RuntimeError("model has no cluster centers")
+        centers = self._centers_serving()
+        return ServingSignature(
+            kernel=_assign_kernel,
+            weights=(centers,),
+            static={"cosine": self.getDistanceMeasure() == "cosine"},
+            name="kmeans.predict",
+            n_features=int(centers.shape[1]),
+            output_spec=lambda n, dtype: (
+                jax.ShapeDtypeStruct((n,), np.int32),
+            ),
+        )
 
     def transform(self, dataset: Any) -> Any:
         rows = _extract_features(dataset, self.getFeaturesCol())

@@ -45,7 +45,7 @@ from spark_rapids_ml_tpu.ops.linear import (
     solve_normal,
     solve_normal_host,
 )
-from spark_rapids_ml_tpu.core.serving import serve_rows
+from spark_rapids_ml_tpu.core.serving import note_device_cache, serve_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
@@ -555,7 +555,30 @@ class LinearRegressionModel(_LinearRegressionParams, Model, LazyHostState):
                 else jnp.asarray(self.coefficients)
             )
             self._coef_dev = (coef, jnp.asarray(self._intercept_raw))
+            note_device_cache(self)
         return self._coef_dev
+
+    def serving_signature(self):
+        """The online-serving contract: the X·coef + b kernel, the
+        device-resident (coefficients, intercept) pair, and the (n,)
+        prediction output spec."""
+        import jax
+
+        from spark_rapids_ml_tpu.serving.signature import ServingSignature
+
+        if self._coef_raw is None:
+            raise RuntimeError("model has no coefficients")
+        coef, intercept = self._coef_serving()
+        return ServingSignature(
+            kernel=_predict_kernel,
+            weights=(coef, intercept),
+            static={},
+            name="linreg.predict",
+            n_features=int(coef.shape[0]),
+            output_spec=lambda n, dtype: (
+                jax.ShapeDtypeStruct((n,), dtype),
+            ),
+        )
 
     def transform(self, dataset: Any) -> Any:
         if isinstance(dataset, tuple):

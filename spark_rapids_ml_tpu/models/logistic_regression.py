@@ -54,7 +54,12 @@ from spark_rapids_ml_tpu.ops.logistic import (
     fit_logistic_resumable,
     predict_logistic,
 )
-from spark_rapids_ml_tpu.core.serving import serve_rows
+from spark_rapids_ml_tpu.core.serving import (
+    note_device_cache,
+    serve_blocks,
+    serve_rows,
+    stream_block_rows,
+)
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
@@ -550,16 +555,30 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
         cache; binomial labels honor the threshold param (applied INSIDE
         the program so a threshold change is a new program, not a per-call
         epilogue). Device queries keep everything on device; host queries
-        keep the numpy contract."""
+        keep the numpy contract. Large host batches stream block by
+        block through the double-buffered path (H2D of block k+1
+        overlaps the forward GEMM of block k)."""
         w, b = self._wb_serving()
+        static = {
+            "n_classes": self.numClasses,
+            "threshold": float(self.getThreshold()),
+        }
+        x = matrix_like(x)
+        if not is_device_array(x):
+            xh = np.asarray(x)
+            if xh.ndim == 2 and xh.shape[0] > stream_block_rows():
+                return serve_blocks(
+                    _forward_kernel,
+                    xh,
+                    (w, b),
+                    static=static,
+                    name="logreg.predict",
+                )
         return serve_rows(
             _forward_kernel,
-            matrix_like(x),
+            x,
             (w, b),
-            static={
-                "n_classes": self.numClasses,
-                "threshold": float(self.getThreshold()),
-            },
+            static=static,
             name="logreg.predict",
         )
 
@@ -570,7 +589,36 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
             w = self._w_raw if is_device_array(self._w_raw) else jnp.asarray(self.weights)
             b = self._b_raw if is_device_array(self._b_raw) else jnp.asarray(self.intercepts)
             self._wb_dev = (w, b.astype(w.dtype))
+            note_device_cache(self)
         return self._wb_dev
+
+    def serving_signature(self):
+        """The online-serving contract: the forward kernel, the
+        device-resident (weights, intercepts) pair, and the
+        (labels, probabilities, raw margins) output specs."""
+        import jax
+
+        from spark_rapids_ml_tpu.serving.signature import ServingSignature
+
+        if self._w_raw is None:
+            raise RuntimeError("model has no weights")
+        w, b = self._wb_serving()
+        n_out = max(2, self.numClasses)
+        return ServingSignature(
+            kernel=_forward_kernel,
+            weights=(w, b),
+            static={
+                "n_classes": self.numClasses,
+                "threshold": float(self.getThreshold()),
+            },
+            name="logreg.predict",
+            n_features=int(w.shape[0]),
+            output_spec=lambda n, dtype: (
+                jax.ShapeDtypeStruct((n,), np.int32),
+                jax.ShapeDtypeStruct((n, n_out), w.dtype),
+                jax.ShapeDtypeStruct((n, n_out), w.dtype),
+            ),
+        )
 
     def transform(self, dataset: Any) -> Any:
         if isinstance(dataset, DataFrame):

@@ -39,7 +39,11 @@ from spark_rapids_ml_tpu.core.persistence import (
     save_data,
     save_metadata,
 )
-from spark_rapids_ml_tpu.core.serving import serve_rows, serve_stream
+from spark_rapids_ml_tpu.core.serving import (
+    note_device_cache,
+    serve_rows,
+    serve_stream,
+)
 from spark_rapids_ml_tpu.linalg.row_matrix import RowMatrix
 from spark_rapids_ml_tpu.ops.linalg import project_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
@@ -615,6 +619,7 @@ class PCAModel(_PCAParams, Model, LazyHostState):
         key = str(dtype)
         if key not in self._pc_dev_cache:
             self._pc_dev_cache[key] = jnp.asarray(self._pc_raw).astype(dtype)
+            note_device_cache(self)
         return self._pc_dev_cache[key]
 
     def _serving_dtype(self):
@@ -624,6 +629,29 @@ class PCAModel(_PCAParams, Model, LazyHostState):
         import jax
 
         return jax.dtypes.canonicalize_dtype(self.pc.dtype)
+
+    def serving_signature(self):
+        """The online-serving contract: the projection kernel, the
+        device-resident components at the serving dtype, and the (n, k)
+        projected output spec."""
+        import jax
+
+        from spark_rapids_ml_tpu.serving.signature import ServingSignature
+
+        if self._pc_raw is None:
+            raise RuntimeError("model has no principal components")
+        pc = self._pc_device(self._serving_dtype())
+        d, k = int(pc.shape[0]), int(pc.shape[1])
+        return ServingSignature(
+            kernel=_project_kernel,
+            weights=(pc,),
+            static={},
+            name="pca.transform",
+            n_features=d,
+            output_spec=lambda n, dtype: (
+                jax.ShapeDtypeStruct((n, k), dtype),
+            ),
+        )
 
     # --- persistence (RapidsPCA.scala:207-255) ---
 

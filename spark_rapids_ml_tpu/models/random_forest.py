@@ -50,7 +50,7 @@ from spark_rapids_ml_tpu.ops.trees import (
     quantize_features,
     sample_weights,
 )
-from spark_rapids_ml_tpu.core.serving import serve_rows
+from spark_rapids_ml_tpu.core.serving import note_device_cache, serve_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
@@ -70,7 +70,24 @@ def _forest_device(model):
     predict call (host pickles drop it; it rebuilds lazily)."""
     if model._forest_dev is None:
         model._forest_dev = jax.tree_util.tree_map(jnp.asarray, model._forest)
+        note_device_cache(model)
     return model._forest_dev
+
+
+def _forest_signature(model, kernel, name, output_spec):
+    """Shared ``serving_signature()`` body for the two forest models."""
+    from spark_rapids_ml_tpu.serving.signature import ServingSignature
+
+    if model._forest is None:
+        raise RuntimeError("model has no fitted forest")
+    return ServingSignature(
+        kernel=kernel,
+        weights=(_forest_device(model),),
+        static={"depth": _forest_depth(model._forest)},
+        name=name,
+        n_features=int(model.numFeatures),
+        output_spec=output_spec,
+    )
 
 
 def resolve_feature_subset(strategy: str, d: int, n_trees: int, classification: bool) -> int:
@@ -519,6 +536,20 @@ class RandomForestClassificationModel(_RandomForestParams, Model):
         leaf distribution scaled by the tree count)."""
         return self.predictProbability(x) * self._forest.feature.shape[0]
 
+    def serving_signature(self):
+        """The online-serving contract: the tree-routing probability
+        kernel, the device-resident forest pytree, and the (n, C)
+        class-distribution output spec (float32, the forests' dtype)."""
+        n_classes = int(self.numClasses)
+        return _forest_signature(
+            self,
+            _proba_kernel,
+            "rf.predictProbability",
+            lambda n, dtype: (
+                jax.ShapeDtypeStruct((n, n_classes), np.float32),
+            ),
+        )
+
     def transform(self, dataset: Any) -> Any:
         rows = extract_features(dataset, self.getFeaturesCol(), drop=self.getLabelCol())
         probs = self.predictProbability(rows)
@@ -651,6 +682,17 @@ class RandomForestRegressionModel(_RandomForestParams, Model):
             (_forest_device(self),),
             static={"depth": _forest_depth(self._forest)},
             name="rf.predict",
+        )
+
+    def serving_signature(self):
+        """The online-serving contract: the tree-routing regression
+        kernel, the device-resident forest, and the (n,) mean-leaf-value
+        output spec (float32, the forests' dtype)."""
+        return _forest_signature(
+            self,
+            _reg_kernel,
+            "rf.predict",
+            lambda n, dtype: (jax.ShapeDtypeStruct((n,), np.float32),),
         )
 
     def transform(self, dataset: Any) -> Any:

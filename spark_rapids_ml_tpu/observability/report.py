@@ -259,7 +259,11 @@ _serve_lock = threading.Lock()
 def serving_report() -> dict:
     """Steady-state serving picture: program-cache stats (size from the
     lock-guarded gauge, not hit/miss arithmetic), cache/compile/donation
-    counters, and the ``serving.batch_rows`` histogram."""
+    counters, the ``serving.batch_rows`` histogram, and — when the
+    online-serving runtime (``spark_rapids_ml_tpu/serving/``) is live —
+    one snapshot per runtime (queue depth, inflight, reserved budget
+    bytes, registered models/versions/aliases) plus the request-latency
+    and batch-fill histograms its micro-batcher populates."""
     from spark_rapids_ml_tpu.core.serving import program_cache_stats
 
     with _serve_lock:
@@ -269,9 +273,23 @@ def serving_report() -> dict:
             for k, v in default_registry.counters_snapshot("serving.").items()
         }
         hist = default_registry.histogram("serving.batch_rows").value()
-    return {
+    out = {
         "cache": stats,
         "cache_size_gauge": default_registry.gauge("serving.cache.size").value(),
         "counters": counters,
         "batch_rows": hist,
     }
+    try:
+        from spark_rapids_ml_tpu.serving import batcher as _batcher
+        from spark_rapids_ml_tpu.serving.server import runtime_snapshots
+
+        runtimes = runtime_snapshots()
+    except ImportError:  # pragma: no cover - serving package stripped
+        runtimes = []
+    if runtimes:
+        out["runtimes"] = runtimes
+        # The batcher's own constructors, so a report scraped before the
+        # first dispatch still registers them with the right buckets.
+        out["request_latency_ms"] = _batcher._latency_hist().value()
+        out["batch_fill"] = _batcher._fill_hist().value()
+    return out

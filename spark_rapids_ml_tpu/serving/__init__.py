@@ -1,0 +1,32 @@
+"""Online serving runtime — registry, micro-batching, admission control.
+
+The in-process inference layer over the PR 2 AOT program cache
+(``core/serving.py``): a versioned :class:`ModelRegistry` with alias
+pinning, warm-up and hot swap; a :class:`MicroBatcher` coalescing
+concurrent callers into shared bucketed executions; memory-budgeted
+admission with structured :class:`Overloaded` shedding; and the
+:class:`ServingRuntime` façade tying them together. See each module's
+docstring for the design; README "Online serving" for the walkthrough.
+"""
+
+from spark_rapids_ml_tpu.serving.admission import (
+    AdmissionQueue,
+    DeadlineExceeded,
+    Overloaded,
+)
+from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
+from spark_rapids_ml_tpu.serving.registry import ModelRegistry, ModelVersion
+from spark_rapids_ml_tpu.serving.server import ServingRuntime, runtime_snapshots
+from spark_rapids_ml_tpu.serving.signature import ServingSignature
+
+__all__ = [
+    "AdmissionQueue",
+    "DeadlineExceeded",
+    "MicroBatcher",
+    "ModelRegistry",
+    "ModelVersion",
+    "Overloaded",
+    "ServingRuntime",
+    "ServingSignature",
+    "runtime_snapshots",
+]

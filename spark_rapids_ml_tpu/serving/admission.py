@@ -1,0 +1,276 @@
+"""Backpressure + memory-budgeted admission for the online-serving queue.
+
+Two independent gates, both shedding with a structured :class:`Overloaded`
+instead of queueing without bound (the "heavy traffic" posture: a loaded
+server that answers *no, retry elsewhere* in microseconds beats one that
+answers *yes* in thirty seconds):
+
+  - **Queue depth** (``TPUML_SERVE_QUEUE``): a bounded request queue.
+    Admission is O(1); the queue never grows past the bound.
+  - **Device-memory budget** (``TPUML_SERVE_MEM_BUDGET`` bytes, 0 = off):
+    each request is priced BEFORE admission from ``ShapeDtypeStruct``
+    sizes — its bucketed input block plus every kernel output at that
+    bucket (the model's declared ``output_spec``) — and the sum of
+    admitted-but-unfinished request bytes must stay under the budget.
+    "Memory Safe Computations with XLA Compiler" (PAPERS.md) motivates
+    exactly this: bound the working set up front rather than discovering
+    OOM mid-batch. The reservation releases when the request completes,
+    sheds, or times out.
+
+:func:`execute_with_fallback` is the degrade integration
+(``robustness/degrade.py``): a batch whose device execution dies with a
+backend-unavailable error re-runs on the cached CPU path under
+``TPUML_DEGRADE=cpu`` — one loud :class:`DegradationWarning` and a
+``degrade`` event, not an errored queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.core.serving import _jit_fallback, serve_rows
+from spark_rapids_ml_tpu.observability.events import emit
+from spark_rapids_ml_tpu.robustness.degrade import cpu_device, run_degradable
+from spark_rapids_ml_tpu.serving.signature import ServingSignature
+from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+QUEUE_ENV = "TPUML_SERVE_QUEUE"
+MEM_BUDGET_ENV = "TPUML_SERVE_MEM_BUDGET"
+
+DEFAULT_QUEUE_LIMIT = 1024
+
+
+class Overloaded(RuntimeError):
+    """Structured shed: the runtime refused a request at admission.
+
+    ``reason`` is ``"queue"`` (depth bound hit) or ``"memory"`` (the
+    request's priced bytes would push reserved device memory past the
+    budget); the remaining fields snapshot the state the decision was
+    made against, so a caller/load-balancer can log or route on them.
+    """
+
+    def __init__(
+        self,
+        reason: str,
+        model: str,
+        *,
+        queue_depth: int,
+        queue_limit: int,
+        reserved_bytes: int = 0,
+        request_bytes: int = 0,
+        mem_budget: int = 0,
+    ):
+        self.reason = reason
+        self.model = model
+        self.queue_depth = queue_depth
+        self.queue_limit = queue_limit
+        self.reserved_bytes = reserved_bytes
+        self.request_bytes = request_bytes
+        self.mem_budget = mem_budget
+        if reason == "memory":
+            detail = (
+                f"request needs ~{request_bytes} device bytes but "
+                f"{reserved_bytes} of the {mem_budget}-byte budget "
+                f"({MEM_BUDGET_ENV}) is reserved"
+            )
+        else:
+            detail = (
+                f"queue is at its depth bound {queue_limit} ({QUEUE_ENV})"
+            )
+        super().__init__(f"serving overloaded ({reason}) for {model!r}: {detail}")
+
+
+class DeadlineExceeded(TimeoutError):
+    """A request's deadline passed before its batch dispatched."""
+
+    def __init__(self, model: str, waited_ms: float, deadline_ms: float):
+        self.model = model
+        self.waited_ms = waited_ms
+        self.deadline_ms = deadline_ms
+        super().__init__(
+            f"serving deadline exceeded for {model!r}: waited "
+            f"{waited_ms:.1f} ms of a {deadline_ms:.1f} ms budget"
+        )
+
+
+@dataclass
+class Request:
+    """One admitted unit of work: ``n`` rows for one model version."""
+
+    key: Tuple  # (name, version, d, dtype) — the coalescing identity
+    x: np.ndarray  # (n, d) host rows, already at the compute dtype
+    n: int
+    version: Any  # registry.ModelVersion
+    run_id: str
+    future: Future = field(default_factory=Future)
+    cost: int = 0  # priced device bytes (bucketed input + outputs)
+    enqueue_mono: float = 0.0
+    deadline: Optional[float] = None  # absolute monotonic seconds
+    timeout_ms: float = 0.0
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and (now or time.monotonic()) > self.deadline
+
+
+class AdmissionQueue:
+    """The bounded, budget-priced request queue one dispatcher drains.
+
+    ``submit`` applies both admission gates under one lock and raises
+    :class:`Overloaded` on shed (counter + ``serving`` shed event
+    included); the dispatcher side pops the oldest request, drains
+    coalescing-compatible ones, and waits on the internal condition for
+    stragglers. Byte reservations persist until :meth:`release` — a
+    request holds its budget through execution, not just while queued.
+    """
+
+    def __init__(self, limit: int, mem_budget: int = 0):
+        self.limit = int(limit)
+        self.mem_budget = int(mem_budget)
+        self._dq: "deque[Request]" = deque()
+        self._cond = threading.Condition()
+        self._reserved = 0
+        self._closed = False
+
+    # --- producer side ---
+
+    def submit(self, req: Request) -> None:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("serving queue is closed")
+            name = req.key[0]
+            if len(self._dq) >= self.limit:
+                self._shed(req, "queue")
+                raise Overloaded(
+                    "queue", name,
+                    queue_depth=len(self._dq), queue_limit=self.limit,
+                )
+            if self.mem_budget and self._reserved + req.cost > self.mem_budget:
+                self._shed(req, "memory")
+                raise Overloaded(
+                    "memory", name,
+                    queue_depth=len(self._dq), queue_limit=self.limit,
+                    reserved_bytes=self._reserved, request_bytes=req.cost,
+                    mem_budget=self.mem_budget,
+                )
+            self._reserved += req.cost
+            req.enqueue_mono = time.monotonic()
+            self._dq.append(req)
+            self._cond.notify_all()
+
+    def _shed(self, req: Request, reason: str) -> None:
+        bump_counter(f"serving.shed.{reason}")
+        emit(
+            "serving", action="shed", reason=reason, model=req.key[0],
+            version=req.key[1], rows=req.n, run_id=req.run_id,
+            depth=len(self._dq), reserved_bytes=self._reserved,
+        )
+
+    def release(self, req: Request) -> None:
+        """Free the request's byte reservation (completion, shed, timeout)."""
+        with self._cond:
+            self._reserved -= req.cost
+
+    # --- dispatcher side ---
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._dq)
+
+    def reserved_bytes(self) -> int:
+        with self._cond:
+            return self._reserved
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def pop_first(self, timeout: float) -> Optional[Request]:
+        """The oldest queued request, waiting up to ``timeout`` for one."""
+        with self._cond:
+            if not self._dq:
+                self._cond.wait(timeout=timeout)
+            if not self._dq:
+                return None
+            return self._dq.popleft()
+
+    def drain_compatible(self, key: Tuple, max_rows: int) -> List[Request]:
+        """Remove (in arrival order) every queued request with ``key``
+        whose rows still fit in ``max_rows``. Requests that don't fit
+        stay queued for the next batch."""
+        out: List[Request] = []
+        with self._cond:
+            kept: List[Request] = []
+            budget = max_rows
+            for req in self._dq:
+                if req.key == key and req.n <= budget:
+                    out.append(req)
+                    budget -= req.n
+                else:
+                    kept.append(req)
+            if out:
+                self._dq.clear()
+                self._dq.extend(kept)
+        return out
+
+    def drain_all(self) -> List[Request]:
+        """Empty the queue (shutdown without drain)."""
+        with self._cond:
+            out = list(self._dq)
+            self._dq.clear()
+        return out
+
+    def wait_for_arrival(self, deadline_mono: float) -> bool:
+        """Block until a new submit lands or ``deadline_mono`` passes;
+        True if woken by activity (the caller re-scans), False on
+        timeout (the caller flushes its batch)."""
+        with self._cond:
+            remaining = deadline_mono - time.monotonic()
+            if remaining <= 0:
+                return False
+            return self._cond.wait(timeout=remaining)
+
+
+# ---------------------------------------------------------------------------
+# degraded execution
+# ---------------------------------------------------------------------------
+
+
+def execute_with_fallback(sig: ServingSignature, x: np.ndarray):
+    """One batch through the bucketed AOT cache — or, when the device
+    backend is gone and ``TPUML_DEGRADE=cpu``, through the cached CPU
+    path (host weight copies + the plain-jit fallback pinned to the CPU
+    device), so one failing device degrades THIS batch instead of
+    erroring the whole queue."""
+
+    def accel():
+        return serve_rows(
+            sig.kernel, x, sig.weights, static=sig.static, name=sig.name
+        )
+
+    def cpu():
+        import jax
+
+        bump_counter("serving.degraded_batches")
+        dev = cpu_device()
+        weights = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, dev), sig.cpu_weights()
+        )
+        xs = jax.device_put(np.asarray(x), dev)
+        out = _jit_fallback(sig.kernel, sig.static)(xs, *weights, **sig.static)
+        return jax.tree_util.tree_map(np.asarray, out)
+
+    return run_degradable(
+        accel, cpu, what=f"serving batch [{sig.name}]", site="serving.execute"
+    )

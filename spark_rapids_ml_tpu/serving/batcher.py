@@ -1,0 +1,250 @@
+"""Concurrent micro-batching — N callers, one AOT execution.
+
+The serving-time thesis (Flare, PAPERS.md): route a high-level API onto
+natively compiled programs and keep those programs HOT. PR 2 built the
+bucketed AOT program cache; this module builds the request path that
+exploits it under concurrency. Callers submit single rows or small
+blocks; one dispatcher thread coalesces compatible requests — same model,
+same VERSION, same width and compute dtype — into one padded
+``bucket_rows`` batch, runs ONE cached executable for all of them, and
+scatters row slices back onto per-request futures. Sixteen callers each
+scoring one row cost one device program, not sixteen.
+
+Batch assembly is bounded two ways (the classic latency/throughput knob
+pair): ``TPUML_SERVE_MAX_BATCH`` rows per dispatch, and
+``TPUML_SERVE_MAX_DELAY_MS`` of coalescing wait measured from the FIRST
+request in the forming batch — a lone request never waits longer than
+the delay bound, a burst fills the batch and dispatches immediately.
+
+Version atomicity falls out of the coalescing key: a request admitted
+against model version N can only ever share a batch with version N, so a
+hot swap mid-stream splits the stream between programs — it never mixes
+weights within one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.observability.events import emit
+from spark_rapids_ml_tpu.observability.metrics import histogram
+from spark_rapids_ml_tpu.serving.admission import (
+    AdmissionQueue,
+    DeadlineExceeded,
+    Request,
+    execute_with_fallback,
+)
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
+
+MAX_BATCH_ENV = "TPUML_SERVE_MAX_BATCH"
+MAX_DELAY_ENV = "TPUML_SERVE_MAX_DELAY_MS"
+
+DEFAULT_MAX_BATCH = 256
+DEFAULT_MAX_DELAY_MS = 5.0
+
+#: Buckets for the request-latency histogram (milliseconds).
+LATENCY_MS_BUCKETS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 5000.0
+)
+
+#: Buckets for the batch-fill histogram (dispatched rows / max_batch).
+FILL_BUCKETS = (0.0625, 0.125, 0.25, 0.5, 0.75, 1.0)
+
+
+def _latency_hist():
+    return histogram(
+        "serving.request.latency_ms",
+        "submit-to-result latency per request",
+        buckets=LATENCY_MS_BUCKETS,
+    )
+
+
+def _fill_hist():
+    return histogram(
+        "serving.batch.fill",
+        "dispatched rows as a fraction of TPUML_SERVE_MAX_BATCH",
+        buckets=FILL_BUCKETS,
+    )
+
+
+class MicroBatcher:
+    """One dispatcher thread coalescing an :class:`AdmissionQueue`."""
+
+    #: Idle poll interval — how often a parked dispatcher rechecks the
+    #: stop flag when the queue is empty.
+    _IDLE_POLL_S = 0.05
+
+    def __init__(
+        self,
+        queue: AdmissionQueue,
+        *,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        max_delay_ms: float = DEFAULT_MAX_DELAY_MS,
+    ):
+        self._queue = queue
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._drain = True
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="tpuml-serve-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Signal the dispatcher down. ``drain=True`` finishes every
+        queued request first; ``drain=False`` fails them immediately."""
+        self._drain = drain
+        self._stop = True
+        if not drain:
+            for req in self._queue.drain_all():
+                self._queue.release(req)
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(
+                        RuntimeError("serving runtime closed before dispatch")
+                    )
+        thread, self._thread = self._thread, None
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=timeout)
+
+    def inflight(self) -> int:
+        """Requests currently being executed (dispatched, unresolved)."""
+        with self._lock:
+            return self._inflight
+
+    # --- the dispatch loop ---
+
+    def _loop(self) -> None:
+        while True:
+            first = self._queue.pop_first(timeout=self._IDLE_POLL_S)
+            if first is None:
+                if self._stop:
+                    if not self._drain or self._queue.depth() == 0:
+                        return
+                continue
+            if self._fail_if_expired(first):
+                continue
+            batch = self._gather(first)
+            self._execute(batch)
+
+    def _gather(self, first: Request) -> List[Request]:
+        """Assemble one batch: everything compatible already queued, then
+        wait out the delay budget (from FIRST's enqueue) for stragglers
+        until the batch fills."""
+        batch = [first]
+        rows = first.n
+        flush_at = first.enqueue_mono + self.max_delay_s
+        while rows < self.max_batch:
+            for req in self._queue.drain_compatible(first.key, self.max_batch - rows):
+                if self._fail_if_expired(req):
+                    continue
+                batch.append(req)
+                rows += req.n
+            if rows >= self.max_batch or self._stop:
+                break
+            if not self._queue.wait_for_arrival(flush_at):
+                # Delay budget spent: one last sweep for anything that
+                # arrived with the final notification, then flush.
+                for req in self._queue.drain_compatible(
+                    first.key, self.max_batch - rows
+                ):
+                    if not self._fail_if_expired(req):
+                        batch.append(req)
+                        rows += req.n
+                break
+        return batch
+
+    def _fail_if_expired(self, req: Request) -> bool:
+        now = time.monotonic()
+        if not req.expired(now):
+            return False
+        self._queue.release(req)
+        waited_ms = (now - req.enqueue_mono) * 1e3
+        bump_counter("serving.deadline.expired")
+        emit(
+            "serving", action="timeout", model=req.key[0], version=req.key[1],
+            rows=req.n, run_id=req.run_id, waited_ms=round(waited_ms, 3),
+        )
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(
+                DeadlineExceeded(req.key[0], waited_ms, req.timeout_ms)
+            )
+        return True
+
+    def _execute(self, batch: List[Request]) -> None:
+        import jax
+
+        name, version = batch[0].key[0], batch[0].key[1]
+        sig = batch[0].version.signature
+        total = sum(r.n for r in batch)
+        x = (
+            np.concatenate([r.x for r in batch], axis=0)
+            if len(batch) > 1
+            else batch[0].x
+        )
+        with self._lock:
+            self._inflight += len(batch)
+        bump_counter("serving.batch.dispatch")
+        bump_counter("serving.batch.rows_total", total)
+        _fill_hist().observe(total / self.max_batch)
+        emit(
+            "serving", action="dispatch", model=name, version=version,
+            rows=total, requests=len(batch),
+            run_ids=[r.run_id for r in batch],
+        )
+        try:
+            with TraceRange(f"serve batch {name}", TraceColor.GREEN):
+                outs = execute_with_fallback(sig, x)
+        except BaseException as exc:  # noqa: BLE001 — fault isolation per batch
+            for req in batch:
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(exc)
+                emit(
+                    "serving", action="error", model=name, version=version,
+                    run_id=req.run_id, exc=type(exc).__name__,
+                )
+            bump_counter("serving.batch.errors")
+        else:
+            now = time.monotonic()
+            offset = 0
+            for req in batch:
+                lo, hi = offset, offset + req.n
+                sliced = jax.tree_util.tree_map(
+                    lambda leaf: leaf[lo:hi]
+                    if np.ndim(leaf) >= 1 and np.shape(leaf)[0] == total
+                    else leaf,
+                    outs,
+                )
+                offset = hi
+                latency_ms = (now - req.enqueue_mono) * 1e3
+                _latency_hist().observe(latency_ms)
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_result(sliced)
+                emit(
+                    "serving", action="complete", model=name, version=version,
+                    rows=req.n, run_id=req.run_id,
+                    latency_ms=round(latency_ms, 3),
+                )
+        finally:
+            for req in batch:
+                self._queue.release(req)
+            with self._lock:
+                self._inflight -= len(batch)

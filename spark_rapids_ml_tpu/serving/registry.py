@@ -1,0 +1,255 @@
+"""Versioned model registry — the serving runtime's source of truth.
+
+``register(name, model)`` assigns monotonic versions per name; aliases
+(``"prod"``, ``"canary"``) pin a version independently of ``latest`` so
+promotion is an O(1) alias move under the registry lock, not a data
+copy. Hot swap is exactly that move: in-flight requests admitted against
+the old version finish on the old version's weights (the micro-batcher's
+coalescing key carries the version), new resolutions see the new one —
+no mixed-version batch can form.
+
+Loading goes through the persistence layer (``model_cls.load(path)`` on
+an ``MLWriter``-written directory), and warm-up pre-populates the PR 2
+AOT program cache for the declared shape buckets by pushing zero batches
+through the model's own serving kernel — a freshly registered version
+serves its first real request compile-free.
+
+Retiring a version drops its device-weight caches through
+``core/serving.invalidate_device_caches`` so a retired (or hot-swapped
+out) model cannot pin stale weights in device memory.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Type
+
+import numpy as np
+
+from spark_rapids_ml_tpu.core.serving import (
+    bucket_rows,
+    invalidate_device_caches,
+    serve_rows,
+)
+from spark_rapids_ml_tpu.observability.events import emit
+from spark_rapids_ml_tpu.serving.signature import ServingSignature
+from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
+
+
+class ModelVersion:
+    """One immutable (name, version) registration."""
+
+    __slots__ = ("name", "version", "model", "signature", "created")
+
+    def __init__(self, name: str, version: int, model: Any,
+                 signature: ServingSignature):
+        self.name = name
+        self.version = version
+        self.model = model
+        self.signature = signature
+        self.created = time.time()
+
+    @property
+    def key(self) -> Tuple[str, int]:
+        return (self.name, self.version)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"ModelVersion({self.name!r}, v{self.version})"
+
+
+class ModelRegistry:
+    """Thread-safe versioned registry with alias pinning and warm-up."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._versions: Dict[str, Dict[int, ModelVersion]] = {}
+        # High-water version per name: never decremented, so a retired
+        # version number is never reissued to a different model.
+        self._next: Dict[str, int] = {}
+        self._aliases: Dict[str, Dict[str, int]] = {}
+
+    # --- registration / swap ---
+
+    def register(
+        self,
+        name: str,
+        model: Any,
+        *,
+        alias: Optional[str] = None,
+        warm_buckets: Iterable[int] = (),
+        warm_dtype: Any = None,
+    ) -> ModelVersion:
+        """Register ``model`` as the next version of ``name``. The model
+        must implement ``serving_signature()`` (all five families do).
+        ``alias`` optionally pins e.g. ``"prod"`` to this version in the
+        same registration; ``warm_buckets`` pre-compiles the AOT programs
+        for those row buckets before the version takes traffic."""
+        sig_fn = getattr(model, "serving_signature", None)
+        if sig_fn is None:
+            raise TypeError(
+                f"{type(model).__name__} declares no serving_signature(); "
+                "only servable model families can be registered"
+            )
+        sig = sig_fn()
+        with self._lock:
+            versions = self._versions.setdefault(name, {})
+            v = self._next.get(name, 0) + 1
+            mv = ModelVersion(name, v, model, sig)
+            versions[v] = mv
+            self._next[name] = v
+            bump_counter("serving.registry.register")
+            emit(
+                "serving", action="register", model=name, version=v,
+                kind=type(model).__name__,
+            )
+            if alias is not None:
+                self.set_alias(name, alias, v)
+        if warm_buckets:
+            self.warm(name, version=v, buckets=warm_buckets, dtype=warm_dtype)
+        return mv
+
+    def load(
+        self,
+        name: str,
+        path: str,
+        model_cls: Type,
+        *,
+        alias: Optional[str] = None,
+        warm_buckets: Iterable[int] = (),
+        warm_dtype: Any = None,
+    ) -> ModelVersion:
+        """Load an ``MLWriter``-saved model from ``path`` (via
+        ``model_cls.load``) and register it in one step."""
+        with TraceRange(f"registry load {name}", TraceColor.WHITE):
+            model = model_cls.load(path)
+        return self.register(
+            name, model, alias=alias,
+            warm_buckets=warm_buckets, warm_dtype=warm_dtype,
+        )
+
+    def set_alias(self, name: str, alias: str, version: int) -> None:
+        """Pin ``name@alias`` to ``version`` — the hot-swap primitive."""
+        with self._lock:
+            if version not in self._versions.get(name, {}):
+                raise KeyError(f"model {name!r} has no version {version}")
+            previous = self._aliases.setdefault(name, {}).get(alias)
+            self._aliases[name][alias] = version
+        bump_counter("serving.registry.swap")
+        emit(
+            "serving", action="swap", model=name, alias=alias,
+            version=version, previous=previous,
+        )
+
+    def retire(self, name: str, version: int) -> None:
+        """Remove one version: it resolves no more, its aliases drop, and
+        its device-weight caches are invalidated so the next owner of
+        that HBM is not a model nobody can reach."""
+        with self._lock:
+            versions = self._versions.get(name, {})
+            mv = versions.pop(version, None)
+            if mv is None:
+                raise KeyError(f"model {name!r} has no version {version}")
+            aliases = self._aliases.get(name, {})
+            for a in [a for a, v in aliases.items() if v == version]:
+                del aliases[a]
+        invalidate_device_caches(mv.model)
+        bump_counter("serving.registry.retire")
+        emit("serving", action="retire", model=name, version=version)
+
+    # --- resolution ---
+
+    def resolve(self, name: str, version: Optional[Any] = None) -> ModelVersion:
+        """The :class:`ModelVersion` for ``name`` — latest by default, or
+        a pinned one via ``version=`` (an int or an alias string), or the
+        ``"name@alias"`` / ``"name@3"`` shorthand."""
+        if version is None and "@" in name:
+            name, _, version = name.partition("@")
+        with self._lock:
+            versions = self._versions.get(name)
+            if not versions:
+                raise KeyError(f"no model registered under {name!r}")
+            if version is None:
+                # Latest = highest LIVE version (versions are monotonic,
+                # so this is also the most recently registered one).
+                v = max(versions)
+            elif isinstance(version, str) and not version.isdigit():
+                alias_map = self._aliases.get(name, {})
+                if version not in alias_map:
+                    raise KeyError(f"model {name!r} has no alias {version!r}")
+                v = alias_map[version]
+            else:
+                v = int(version)
+            mv = versions.get(v)
+            if mv is None:
+                raise KeyError(f"model {name!r} has no version {v}")
+            return mv
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return [n for n, vs in self._versions.items() if vs]
+
+    def versions(self, name: str) -> List[int]:
+        with self._lock:
+            return sorted(self._versions.get(name, {}))
+
+    def aliases(self, name: str) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._aliases.get(name, {}))
+
+    # --- warm-up ---
+
+    def warm(
+        self,
+        name: str,
+        *,
+        version: Optional[int] = None,
+        buckets: Iterable[int] = (),
+        dtype: Any = None,
+    ) -> int:
+        """Pre-populate the AOT program cache for ``buckets`` (row counts;
+        each rounds up to its pow-2 bucket) by running zero batches
+        through the version's serving kernel at ``dtype`` (default: the
+        model's weight dtype — the dtype steady-state traffic computes
+        at). Returns the number of distinct buckets warmed."""
+        mv = self.resolve(name, version)
+        sig = mv.signature
+        dt = np.dtype(dtype) if dtype is not None else sig.weights_dtype()
+        warmed = set()
+        with TraceRange(f"registry warm {name}", TraceColor.YELLOW):
+            for b in buckets:
+                bucket = bucket_rows(int(b))
+                if bucket in warmed:
+                    continue
+                warmed.add(bucket)
+                serve_rows(
+                    sig.kernel,
+                    np.zeros((bucket, sig.n_features), dtype=dt),
+                    sig.weights,
+                    static=sig.static,
+                    name=sig.name,
+                )
+                bump_counter("serving.registry.warm")
+        emit(
+            "serving", action="warm", model=name, version=mv.version,
+            buckets=sorted(warmed), dtype=str(dt),
+        )
+        return len(warmed)
+
+    # --- introspection ---
+
+    def snapshot(self) -> dict:
+        """JSON-able registry state for ``serving_report()``."""
+        with self._lock:
+            return {
+                name: {
+                    "versions": sorted(vs),
+                    "latest": max(vs),
+                    "aliases": dict(self._aliases.get(name, {})),
+                    "weights_bytes": {
+                        v: mv.signature.weights_bytes() for v, mv in vs.items()
+                    },
+                }
+                for name, vs in self._versions.items()
+                if vs
+            }

@@ -1,0 +1,274 @@
+"""ServingRuntime — the in-process online-inference façade.
+
+One object ties the subsystem together: a :class:`ModelRegistry` (shared
+or owned), an :class:`AdmissionQueue` applying the depth and memory
+gates, and a :class:`MicroBatcher` dispatcher thread. Callers use three
+methods — ``submit`` (rows in, ``Future`` out), ``submit_many``, and
+``close`` (drains by default) — plus the registry delegates for the
+register → warm → promote → retire lifecycle.
+
+Observability is first-class, not bolted on: every request carries its
+own ``run_id`` from admission to completion (``serving`` events:
+enqueue / dispatch / complete / shed / timeout all join on it),
+``serving.queue.depth`` and ``serving.inflight`` read as live gauges,
+``serving.request.latency_ms`` and ``serving.batch.fill`` as histograms,
+and :func:`runtime_snapshots` feeds the runtime section of
+``observability.report.serving_report()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from concurrent.futures import Future
+from typing import Any, Iterable, List, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.core.serving import _compute_dtype, bucket_rows
+from spark_rapids_ml_tpu.observability.events import emit, new_run_id
+from spark_rapids_ml_tpu.observability.metrics import gauge
+from spark_rapids_ml_tpu.serving.admission import (
+    AdmissionQueue,
+    Request,
+)
+from spark_rapids_ml_tpu.serving.batcher import (
+    DEFAULT_MAX_BATCH,
+    DEFAULT_MAX_DELAY_MS,
+    MAX_BATCH_ENV,
+    MAX_DELAY_ENV,
+    MicroBatcher,
+)
+from spark_rapids_ml_tpu.serving.admission import (
+    DEFAULT_QUEUE_LIMIT,
+    MEM_BUDGET_ENV,
+    QUEUE_ENV,
+)
+from spark_rapids_ml_tpu.serving.registry import ModelRegistry, ModelVersion
+from spark_rapids_ml_tpu.serving.signature import spec_bytes
+from spark_rapids_ml_tpu.utils.envknobs import env_float, env_int
+from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+#: Live runtimes (weak): the serving report's runtime section.
+_RUNTIMES: "weakref.WeakSet[ServingRuntime]" = weakref.WeakSet()
+_runtime_seq_lock = threading.Lock()
+_runtime_seq = 0
+
+
+def runtime_snapshots() -> List[dict]:
+    """Point-in-time state of every live :class:`ServingRuntime`."""
+    return [rt.snapshot() for rt in list(_RUNTIMES)]
+
+
+class ServingRuntime:
+    """In-process online serving: micro-batching + admission + registry.
+
+    Parameters default from the ``TPUML_SERVE_*`` knobs; explicit
+    arguments win. ``start=False`` builds the runtime with the
+    dispatcher parked (requests queue but nothing executes) — tests and
+    staged warm-ups call :meth:`start` when ready.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[ModelRegistry] = None,
+        *,
+        max_batch: Optional[int] = None,
+        max_delay_ms: Optional[float] = None,
+        queue_limit: Optional[int] = None,
+        mem_budget: Optional[int] = None,
+        start: bool = True,
+    ):
+        global _runtime_seq
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.max_batch = (
+            max_batch
+            if max_batch is not None
+            else env_int(MAX_BATCH_ENV, DEFAULT_MAX_BATCH, minimum=1)
+        )
+        self.max_delay_ms = (
+            max_delay_ms
+            if max_delay_ms is not None
+            else env_float(MAX_DELAY_ENV, DEFAULT_MAX_DELAY_MS, minimum=0.0)
+        )
+        self.queue_limit = (
+            queue_limit
+            if queue_limit is not None
+            else env_int(QUEUE_ENV, DEFAULT_QUEUE_LIMIT, minimum=1)
+        )
+        self.mem_budget = (
+            mem_budget
+            if mem_budget is not None
+            else env_int(MEM_BUDGET_ENV, 0, minimum=0)
+        )
+        self._queue = AdmissionQueue(self.queue_limit, self.mem_budget)
+        self._batcher = MicroBatcher(
+            self._queue,
+            max_batch=self.max_batch,
+            max_delay_ms=self.max_delay_ms,
+        )
+        self._closed = False
+        with _runtime_seq_lock:
+            _runtime_seq += 1
+            self.runtime_id = f"serving-runtime-{_runtime_seq}"
+        gauge("serving.queue.depth", "queued serving requests").set_function(
+            self._queue.depth, runtime=self.runtime_id
+        )
+        gauge("serving.inflight", "requests in execution").set_function(
+            self._batcher.inflight, runtime=self.runtime_id
+        )
+        _RUNTIMES.add(self)
+        if start:
+            self.start()
+
+    # --- registry delegates (one façade for the whole lifecycle) ---
+
+    def register(self, name: str, model: Any, **kwargs) -> ModelVersion:
+        return self.registry.register(name, model, **kwargs)
+
+    def load(self, name: str, path: str, model_cls, **kwargs) -> ModelVersion:
+        return self.registry.load(name, path, model_cls, **kwargs)
+
+    def set_alias(self, name: str, alias: str, version: int) -> None:
+        self.registry.set_alias(name, alias, version)
+
+    def retire(self, name: str, version: int) -> None:
+        self.registry.retire(name, version)
+
+    def warm(self, name: str, **kwargs) -> int:
+        return self.registry.warm(name, **kwargs)
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        if self._closed:
+            raise RuntimeError("serving runtime is closed")
+        self._batcher.start()
+
+    @property
+    def running(self) -> bool:
+        return self._batcher.running
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the runtime. ``drain=True`` (default) finishes every
+        queued request before the dispatcher exits; ``drain=False``
+        fails still-queued futures immediately. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if drain and not self._batcher.running and self._queue.depth():
+            # A never-started (parked) runtime still owes its queued
+            # callers answers: run the dispatcher for the drain.
+            self._batcher.start()
+        self._batcher.stop(drain=drain)
+        self._queue.close()
+        emit("serving", action="close", runtime=self.runtime_id, drain=drain)
+
+    def __enter__(self) -> "ServingRuntime":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(drain=exc_type is None)
+
+    # --- the request path ---
+
+    def submit(
+        self,
+        name: str,
+        x: Any,
+        *,
+        timeout: Optional[float] = None,
+        version: Optional[Any] = None,
+    ) -> Future:
+        """Admit one request — a single row ``(d,)`` or a small block
+        ``(k, d)`` — for ``name`` (or ``"name@alias"``); returns a
+        ``Future`` resolving to the model's serving-kernel output for
+        exactly those rows (leading axis = submitted row count).
+
+        ``timeout`` (seconds) is a DEADLINE: if the request has not been
+        dispatched when it expires, the future fails with a structured
+        :class:`DeadlineExceeded` instead of executing stale work.
+        Raises :class:`Overloaded` synchronously when admission sheds.
+        """
+        import time as _time
+
+        if self._closed:
+            raise RuntimeError("serving runtime is closed")
+        mv = self.registry.resolve(name, version)
+        sig = mv.signature
+        xh = np.asarray(x)
+        if xh.ndim == 1:
+            xh = xh[None, :]
+        if xh.ndim != 2:
+            raise ValueError(f"serving input must be 1-D or 2-D, got {xh.ndim}-D")
+        if xh.shape[1] != sig.n_features:
+            raise ValueError(
+                f"model {mv.name!r} v{mv.version} expects {sig.n_features} "
+                f"features, got {xh.shape[1]}"
+            )
+        dtype = _compute_dtype(xh.dtype)
+        xh = np.ascontiguousarray(xh, dtype=dtype)
+        n = int(xh.shape[0])
+        bucket = bucket_rows(max(n, 1))
+        cost = bucket * sig.n_features * dtype.itemsize + spec_bytes(
+            sig.output_spec(bucket, dtype)
+        )
+        timeout_ms = float(timeout) * 1e3 if timeout is not None else 0.0
+        req = Request(
+            key=(mv.name, mv.version, int(xh.shape[1]), str(dtype)),
+            x=xh,
+            n=n,
+            version=mv,
+            run_id=new_run_id("serve"),
+            cost=cost,
+            deadline=(_time.monotonic() + timeout) if timeout is not None else None,
+            timeout_ms=timeout_ms,
+        )
+        emit(
+            "serving", action="enqueue", model=mv.name, version=mv.version,
+            rows=n, run_id=req.run_id, cost_bytes=cost,
+        )
+        self._queue.submit(req)  # raises Overloaded on shed
+        bump_counter("serving.requests")
+        bump_counter("serving.request.rows", n)
+        return req.future
+
+    def submit_many(
+        self,
+        name: str,
+        xs: Iterable[Any],
+        *,
+        timeout: Optional[float] = None,
+        version: Optional[Any] = None,
+    ) -> List[Future]:
+        """One future per element of ``xs`` (each a row or small block).
+        Resolution happens ONCE up front, so the whole set is
+        version-consistent even across a concurrent hot swap."""
+        mv = self.registry.resolve(name, version)
+        return [
+            self.submit(mv.name, x, timeout=timeout, version=mv.version)
+            for x in xs
+        ]
+
+    # --- introspection ---
+
+    def queue_depth(self) -> int:
+        return self._queue.depth()
+
+    def inflight(self) -> int:
+        return self._batcher.inflight()
+
+    def snapshot(self) -> dict:
+        return {
+            "runtime": self.runtime_id,
+            "running": self.running,
+            "closed": self._closed,
+            "max_batch": self.max_batch,
+            "max_delay_ms": self.max_delay_ms,
+            "queue_limit": self.queue_limit,
+            "mem_budget": self.mem_budget,
+            "queue_depth": self._queue.depth(),
+            "reserved_bytes": self._queue.reserved_bytes(),
+            "inflight": self._batcher.inflight(),
+            "models": self.registry.snapshot(),
+        }

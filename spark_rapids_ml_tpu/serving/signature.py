@@ -1,0 +1,93 @@
+"""The serving contract a model family declares — kernel, weights, specs.
+
+Every servable model implements ``serving_signature()`` returning one
+:class:`ServingSignature`: the row-wise serving kernel (the SAME function
+object its own ``predict``/``transform`` routes through ``core/serving``,
+so the registry, the micro-batcher and the model's direct calls all share
+one AOT program per shape bucket), the device-resident weight pytree the
+kernel closes over at RUN time, the static config baked into the program
+key, and an output-spec callable the admission controller sizes requests
+with (``ShapeDtypeStruct`` sizes against ``TPUML_SERVE_MEM_BUDGET`` —
+"Memory Safe Computations with XLA", PAPERS.md: admit against an explicit
+budget instead of discovering OOM mid-batch).
+
+This module is deliberately dependency-light (no jax import at module
+scope) so model modules can import it without ordering constraints.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class ServingSignature:
+    """One model's serving declaration.
+
+    ``output_spec(n, dtype)`` returns the kernel's output pytree as
+    ``jax.ShapeDtypeStruct`` leaves for an ``n``-row batch computing at
+    ``dtype`` — the admission controller's sizing truth; it must cover
+    every output the kernel materializes on device.
+    """
+
+    kernel: Callable
+    weights: Tuple[Any, ...]
+    static: Dict[str, Any]
+    name: str
+    n_features: int
+    output_spec: Callable[[int, Any], Any]
+    # Host copies of the weights for the degraded CPU path, built lazily
+    # on first fallback and reused (the "cached CPU path").
+    _cpu_weights: Optional[Tuple[Any, ...]] = field(
+        default=None, repr=False, compare=False
+    )
+
+    def weights_dtype(self):
+        """Dtype of the first floating weight leaf — the warm-up default
+        (the dtype steady-state traffic computes at)."""
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(self.weights):
+            dt = np.dtype(getattr(leaf, "dtype", np.float64))
+            if np.issubdtype(dt, np.floating):
+                return dt
+        return np.dtype(np.float32)
+
+    def weights_bytes(self) -> int:
+        """Resident device bytes of the weight pytree."""
+        import jax
+
+        return int(
+            sum(
+                int(np.prod(np.shape(leaf))) * np.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree_util.tree_leaves(self.weights)
+                if hasattr(leaf, "dtype")
+            )
+        )
+
+    def cpu_weights(self) -> Tuple[Any, ...]:
+        """The weight pytree as host numpy, cached — the degraded path
+        must not re-pull device buffers (possibly from a dead device) on
+        every batch."""
+        import jax
+
+        if self._cpu_weights is None:
+            self._cpu_weights = jax.tree_util.tree_map(
+                lambda a: np.asarray(a), self.weights
+            )
+        return self._cpu_weights
+
+
+def spec_bytes(spec_tree: Any) -> int:
+    """Total bytes of a ``ShapeDtypeStruct`` pytree."""
+    import jax
+
+    return int(
+        sum(
+            int(np.prod(s.shape)) * np.dtype(s.dtype).itemsize
+            for s in jax.tree_util.tree_leaves(spec_tree)
+        )
+    )

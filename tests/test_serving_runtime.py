@@ -1,0 +1,583 @@
+"""Online serving runtime (spark_rapids_ml_tpu/serving/) contracts.
+
+The ISSUE 5 acceptance surface: concurrent submitters coalesce into
+shared AOT executions (counter-asserted), results are bitwise what the
+sequential model calls produce, deadlines and overload shed with
+STRUCTURED errors instead of queueing without bound, hot swap under load
+is version-atomic, and every request's events join one run_id in the
+JSONL log.
+
+Float parity notes: batch coalescing changes the PADDED program shape a
+row executes in, so float parity across paths is only guaranteed when
+the row-wise reductions are EXACT. The fixtures use dyadic-rational
+inputs and weights (integers / 4) whose dot products are exactly
+representable in float64 — any accumulation order produces the same
+bits, making "bitwise parity with sequential transform" a theorem
+rather than a tolerance.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.core import serving as core_serving
+from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+from spark_rapids_ml_tpu.models.linear_regression import LinearRegressionModel
+from spark_rapids_ml_tpu.models.logistic_regression import LogisticRegressionModel
+from spark_rapids_ml_tpu.models.pca import PCAModel
+from spark_rapids_ml_tpu.observability import events
+from spark_rapids_ml_tpu.serving import (
+    DeadlineExceeded,
+    ModelRegistry,
+    Overloaded,
+    ServingRuntime,
+)
+from spark_rapids_ml_tpu.serving import admission
+from spark_rapids_ml_tpu.utils.tracing import counter_value
+
+D = 8  # feature width shared by every fixture model
+
+
+def dyadic(rng, shape, scale=4):
+    """Arrays of integers/4 — dot products exact in f64, so results are
+    bitwise identical across program shapes (module docstring)."""
+    return rng.integers(-4 * scale, 4 * scale, size=shape).astype(np.float64) / 4.0
+
+
+@pytest.fixture(scope="module")
+def models():
+    rng = np.random.default_rng(7)
+    km = KMeansModel("srv-km", dyadic(rng, (4, D)))
+    lr = LinearRegressionModel("srv-lr", dyadic(rng, (D,)), 0.25)
+    logreg = LogisticRegressionModel(
+        "srv-logreg", dyadic(rng, (D, 1)), np.asarray([0.5]), numClasses=2
+    )
+    pca = PCAModel("srv-pca", dyadic(rng, (D, 3)), np.full(3, 1.0 / 3))
+    return {"km": km, "lr": lr, "logreg": logreg, "pca": pca}
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_versioning_aliases_and_retire(models):
+    reg = ModelRegistry()
+    v1 = reg.register("km", models["km"])
+    v2 = reg.register("km", models["km"])
+    assert (v1.version, v2.version) == (1, 2)
+    assert reg.resolve("km").version == 2
+
+    reg.set_alias("km", "prod", 1)
+    assert reg.resolve("km", "prod").version == 1
+    assert reg.resolve("km@prod").version == 1
+    assert reg.resolve("km@2").version == 2
+    assert reg.resolve("km", 1).version == 1
+
+    reg.retire("km", 2)
+    assert reg.resolve("km").version == 1
+    # A retired version number is never reissued to a different model.
+    v3 = reg.register("km", models["km"])
+    assert v3.version == 3
+    assert reg.versions("km") == [1, 3]
+
+    with pytest.raises(KeyError):
+        reg.resolve("km@canary")
+    with pytest.raises(KeyError):
+        reg.resolve("km", 2)
+    with pytest.raises(KeyError):
+        reg.resolve("missing")
+    with pytest.raises(TypeError):
+        reg.register("bad", object())
+
+
+def test_registry_load_from_mlwriter_path_and_warmup(models, tmp_path):
+    path = str(tmp_path / "km_model")
+    models["km"].write.overwrite().save(path)
+
+    core_serving.clear_program_cache()
+    reg = ModelRegistry()
+    mv = reg.load(
+        "km", path, KMeansModel, alias="prod", warm_buckets=(5, 64),
+        warm_dtype=np.float64,
+    )
+    stats = core_serving.program_cache_stats()
+    # 5 rounds up to bucket 8; 64 is its own bucket -> exactly 2 programs.
+    assert stats["compiles"] == 2
+    assert reg.resolve("km@prod").version == mv.version
+
+    # The warmed bucket serves real traffic compile-free.
+    rng = np.random.default_rng(0)
+    x = dyadic(rng, (5, D))
+    out = core_serving.serve_rows(
+        mv.signature.kernel, x, mv.signature.weights,
+        static=mv.signature.static, name=mv.signature.name,
+    )
+    assert core_serving.program_cache_stats()["compiles"] == 2
+    np.testing.assert_array_equal(out, models["km"].predict(x))
+
+
+def test_retire_invalidates_device_caches():
+    rng = np.random.default_rng(3)
+    km = KMeansModel("retire-km", dyadic(rng, (4, D)))
+    km.predict(dyadic(rng, (3, D)))  # populates _centers_dev
+    assert km._centers_dev is not None
+    reg = ModelRegistry()
+    mv = reg.register("km", km)
+    before = counter_value("serving.device_cache.invalidate")
+    reg.retire("km", mv.version)
+    assert km._centers_dev is None
+    assert counter_value("serving.device_cache.invalidate") > before
+
+
+def test_clear_program_cache_drops_model_device_caches():
+    rng = np.random.default_rng(4)
+    km = KMeansModel("clear-km", dyadic(rng, (4, D)))
+    lr = LinearRegressionModel("clear-lr", dyadic(rng, (D,)), 0.0)
+    km.predict(dyadic(rng, (3, D)))
+    lr.predict(dyadic(rng, (3, D)))
+    assert km._centers_dev is not None and lr._coef_dev is not None
+    core_serving.clear_program_cache()
+    assert km._centers_dev is None
+    assert lr._coef_dev is None
+    # Predictions after the sweep rebuild lazily and still agree.
+    x = dyadic(rng, (3, D))
+    np.testing.assert_array_equal(km.predict(x), km.predict(x))
+
+
+# ---------------------------------------------------------------------------
+# micro-batching: coalescing + parity
+# ---------------------------------------------------------------------------
+
+
+def test_coalescing_many_callers_share_one_program(models):
+    """16 threads x 16 single rows: >= 4x fewer device programs than
+    requests, exactly one AOT execution per dispatched batch, and every
+    request's rows come back bitwise-identical to sequential predict."""
+    rng = np.random.default_rng(11)
+    rows = dyadic(rng, (256, D))
+    rt = ServingRuntime(max_batch=64, max_delay_ms=5.0, start=False)
+    rt.register("km", models["km"])
+
+    results = {}
+    lock = threading.Lock()
+
+    def worker(tid):
+        futs = [
+            (tid * 16 + j, rt.submit("km", rows[tid * 16 + j]))
+            for j in range(16)
+        ]
+        with lock:
+            results.update(futs)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rt.queue_depth() == 256
+
+    d0 = counter_value("serving.batch.dispatch")
+    s0 = core_serving.program_cache_stats()
+    rt.start()
+    got = {i: np.asarray(f.result(timeout=60)) for i, f in results.items()}
+    rt.close()
+    dispatches = counter_value("serving.batch.dispatch") - d0
+    s1 = core_serving.program_cache_stats()
+    programs = (s1["hits"] + s1["misses"]) - (s0["hits"] + s0["misses"])
+
+    assert dispatches * 4 <= 256, f"only {256 / dispatches:.1f}x coalescing"
+    assert programs == dispatches, "more device programs than batches"
+
+    expected = models["km"].predict(rows)
+    for i, out in got.items():
+        assert out.shape == (1,)
+        np.testing.assert_array_equal(out, expected[i : i + 1])
+
+
+@pytest.mark.parametrize("family", ["km", "lr", "logreg", "pca"])
+def test_single_family_parity(models, family):
+    rng = np.random.default_rng(21)
+    block = dyadic(rng, (6, D))
+    with ServingRuntime(max_batch=32, max_delay_ms=2.0) as rt:
+        rt.register(family, models[family])
+        out = rt.submit(family, block).result(timeout=30)
+    expected_kernel = models[family].serving_signature()
+    direct = core_serving.serve_rows(
+        expected_kernel.kernel, block, expected_kernel.weights,
+        static=expected_kernel.static, name=expected_kernel.name,
+    )
+    import jax
+
+    for got, want in zip(
+        jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(direct)
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_concurrent_mixed_families_bitwise_parity(models):
+    """>= 16 submitter threads x mixed families, blocks of varying size,
+    all against one runtime — bitwise parity with sequential calls."""
+    import jax
+
+    families = ["km", "lr", "logreg", "pca"]
+    rng = np.random.default_rng(31)
+    jobs = []  # (family, block)
+    for t in range(16):
+        fam = families[t % len(families)]
+        jobs.append((fam, dyadic(rng, (1 + (t % 5), D))))
+
+    rt = ServingRuntime(max_batch=64, max_delay_ms=5.0)
+    for fam in families:
+        rt.register(fam, models[fam])
+    outs = [None] * len(jobs)
+
+    def worker(i):
+        fam, block = jobs[i]
+        outs[i] = rt.submit(fam, block).result(timeout=60)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(jobs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rt.close()
+
+    for (fam, block), out in zip(jobs, outs):
+        sig = models[fam].serving_signature()
+        direct = core_serving.serve_rows(
+            sig.kernel, block, sig.weights, static=sig.static, name=sig.name
+        )
+        for got, want in zip(
+            jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(direct)
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# deadlines + admission
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expiry_is_structured(models):
+    rt = ServingRuntime(start=False)  # parked: nothing dispatches
+    rt.register("km", models["km"])
+    fut = rt.submit("km", np.zeros(D), timeout=0.01)
+    time.sleep(0.05)
+    c0 = counter_value("serving.deadline.expired")
+    rt.start()
+    with pytest.raises(DeadlineExceeded) as err:
+        fut.result(timeout=30)
+    assert err.value.model == "km"
+    assert err.value.waited_ms >= 10.0
+    assert counter_value("serving.deadline.expired") == c0 + 1
+    rt.close()
+
+
+def test_shed_on_queue_overload(models):
+    rt = ServingRuntime(queue_limit=3, start=False)
+    rt.register("km", models["km"])
+    for _ in range(3):
+        rt.submit("km", np.zeros(D))
+    c0 = counter_value("serving.shed.queue")
+    with pytest.raises(Overloaded) as err:
+        rt.submit("km", np.zeros(D))
+    assert err.value.reason == "queue"
+    assert err.value.queue_depth == 3 and err.value.queue_limit == 3
+    assert counter_value("serving.shed.queue") == c0 + 1
+    rt.close()  # drains the three queued requests
+
+
+def test_shed_on_memory_budget_and_release(models):
+    sig = models["km"].serving_signature()
+    # Price one 8-row-bucket f64 request exactly as admission does.
+    from spark_rapids_ml_tpu.serving.signature import spec_bytes
+
+    one = 8 * D * 8 + spec_bytes(sig.output_spec(8, np.dtype(np.float64)))
+    rt = ServingRuntime(mem_budget=2 * one, queue_limit=100, start=False)
+    rt.register("km", models["km"])
+    rt.submit("km", np.zeros(D))
+    rt.submit("km", np.zeros(D))
+    c0 = counter_value("serving.shed.memory")
+    with pytest.raises(Overloaded) as err:
+        rt.submit("km", np.zeros(D))
+    assert err.value.reason == "memory"
+    assert err.value.mem_budget == 2 * one
+    assert err.value.reserved_bytes == 2 * one
+    assert counter_value("serving.shed.memory") == c0 + 1
+    # Completion releases the reservation: after the drain, fresh
+    # requests are admitted again.
+    rt.start()
+    time.sleep(0.2)
+    assert rt.snapshot()["reserved_bytes"] == 0
+    fut = rt.submit("km", np.zeros(D))
+    assert fut.result(timeout=30) is not None
+    rt.close()
+
+
+def test_submit_validation_errors(models):
+    rt = ServingRuntime(start=False)
+    rt.register("km", models["km"])
+    with pytest.raises(ValueError, match="features"):
+        rt.submit("km", np.zeros(D + 1))
+    with pytest.raises(ValueError, match="2-D"):
+        rt.submit("km", np.zeros((2, 2, 2)))
+    with pytest.raises(KeyError):
+        rt.submit("nope", np.zeros(D))
+    rt.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        rt.submit("km", np.zeros(D))
+
+
+def test_close_without_drain_fails_pending(models):
+    rt = ServingRuntime(start=False)
+    rt.register("km", models["km"])
+    futs = [rt.submit("km", np.zeros(D)) for _ in range(4)]
+    rt.close(drain=False)
+    for f in futs:
+        with pytest.raises(RuntimeError, match="closed"):
+            f.result(timeout=5)
+
+
+def test_close_with_drain_answers_everyone(models):
+    rt = ServingRuntime(start=False)  # never started: close must drain
+    rt.register("km", models["km"])
+    x = np.zeros((2, D))
+    futs = [rt.submit("km", x) for _ in range(5)]
+    rt.close(drain=True)
+    for f in futs:
+        assert np.asarray(f.result(timeout=5)).shape == (2,)
+
+
+# ---------------------------------------------------------------------------
+# hot swap
+# ---------------------------------------------------------------------------
+
+
+def test_hot_swap_under_load_is_version_atomic(models, tmp_path):
+    """Swap ``prod`` from v1 to v2 while 8 threads stream single rows at
+    the alias: every result must be bitwise v1's answer or v2's answer,
+    and the event log must show every request dispatched on EXACTLY the
+    version it was admitted against (no mixed-version batch)."""
+    rng = np.random.default_rng(41)
+    c1 = dyadic(rng, (4, D))
+    c2 = dyadic(rng, (4, D)) + 64.0  # a genuinely different model
+    m1 = KMeansModel("swap-v1", c1)
+    m2 = KMeansModel("swap-v2", c2)
+    probes = dyadic(rng, (240, D))
+    exp1 = m1.predict(probes)
+    exp2 = m2.predict(probes)
+
+    log = tmp_path / "swap_events.jsonl"
+    events.configure(str(log))
+    try:
+        rt = ServingRuntime(max_batch=16, max_delay_ms=2.0)
+        v1 = rt.register("km", m1, alias="prod")
+        collected = []
+        lock = threading.Lock()
+
+        def worker(tid):
+            local = []
+            for j in range(30):
+                i = tid * 30 + j
+                out = rt.submit("km@prod", probes[i]).result(timeout=60)
+                local.append((i, np.asarray(out)))
+            with lock:
+                collected.extend(local)
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.02)
+        v2 = rt.register("km", m2)
+        rt.set_alias("km", "prod", v2.version)
+        for t in threads:
+            t.join()
+        rt.close()
+    finally:
+        events.configure()
+
+    n_v1 = n_v2 = 0
+    for i, out in collected:
+        if np.array_equal(out, exp1[i : i + 1]):
+            n_v1 += 1
+        elif np.array_equal(out, exp2[i : i + 1]):
+            n_v2 += 1
+        else:  # pragma: no cover - the failure being hunted
+            raise AssertionError(f"row {i} matches neither version: {out}")
+    assert n_v1 + n_v2 == 240
+    assert v1.version == 1 and v2.version == 2
+
+    # Event-log atomicity: a request's admitted version IS the version
+    # its batch dispatched and completed on.
+    records = [json.loads(line) for line in log.read_text().splitlines()]
+    serving_recs = [r for r in records if r["event"] == "serving"]
+    admitted = {
+        r["run_id"]: r["version"]
+        for r in serving_recs
+        if r["action"] == "enqueue"
+    }
+    assert len(admitted) == 240
+    for r in serving_recs:
+        if r["action"] == "dispatch":
+            for rid in r["run_ids"]:
+                assert admitted[rid] == r["version"], "mixed-version batch"
+        elif r["action"] == "complete":
+            assert admitted[r["run_id"]] == r["version"]
+
+
+# ---------------------------------------------------------------------------
+# events / run ids
+# ---------------------------------------------------------------------------
+
+
+def test_every_request_joins_one_run_id(models, tmp_path):
+    log = tmp_path / "serve_events.jsonl"
+    events.configure(str(log))
+    try:
+        with ServingRuntime(max_batch=8, max_delay_ms=2.0) as rt:
+            rt.register("km", models["km"])
+            futs = [rt.submit("km", np.zeros(D)) for _ in range(6)]
+            for f in futs:
+                f.result(timeout=30)
+    finally:
+        events.configure()  # back to the env-configured sink
+
+    records = [json.loads(line) for line in log.read_text().splitlines()]
+    for rec in records:
+        assert events.validate_record(rec) == [], rec
+
+    serving_recs = [r for r in records if r["event"] == "serving"]
+    enq = {r["run_id"]: r for r in serving_recs if r["action"] == "enqueue"}
+    done = {r["run_id"]: r for r in serving_recs if r["action"] == "complete"}
+    dispatched = [
+        rid
+        for r in serving_recs
+        if r["action"] == "dispatch"
+        for rid in r["run_ids"]
+    ]
+    assert len(enq) == 6
+    # Every request's lifecycle joins on its one run_id.
+    assert set(done) == set(enq)
+    assert sorted(dispatched) == sorted(enq)
+    for rid, r in done.items():
+        assert r["model"] == "km" and "latency_ms" in r
+
+
+# ---------------------------------------------------------------------------
+# degradation
+# ---------------------------------------------------------------------------
+
+
+def test_failing_device_degrades_batch_to_cpu(models, monkeypatch):
+    monkeypatch.setenv("TPUML_DEGRADE", "cpu")
+    x = dyadic(np.random.default_rng(5), (4, D))
+    expected = models["km"].predict(x)
+
+    def broken(*a, **k):
+        raise RuntimeError("jax backend: device unavailable")
+
+    monkeypatch.setattr(admission, "serve_rows", broken)
+    c0 = counter_value("serving.degraded_batches")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with ServingRuntime() as rt:
+            rt.register("km", models["km"])
+            out = rt.submit("km", x).result(timeout=30)
+    np.testing.assert_array_equal(np.asarray(out), expected)
+    assert counter_value("serving.degraded_batches") == c0 + 1
+
+
+def test_failing_device_without_degrade_errors_the_request(models, monkeypatch):
+    monkeypatch.setenv("TPUML_DEGRADE", "off")
+
+    def broken(*a, **k):
+        raise RuntimeError("jax backend: device unavailable")
+
+    monkeypatch.setattr(admission, "serve_rows", broken)
+    with ServingRuntime() as rt:
+        rt.register("km", models["km"])
+        fut = rt.submit("km", np.zeros(D))
+        with pytest.raises(RuntimeError, match="device unavailable"):
+            fut.result(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# satellite: big host batches stream through serve_stream
+# ---------------------------------------------------------------------------
+
+
+def test_kmeans_big_host_batch_streams(models, monkeypatch):
+    rng = np.random.default_rng(51)
+    big = dyadic(rng, (1000, D))
+    ref = models["km"].predict(big)  # default block: no streaming at 1000
+    monkeypatch.setenv("TPUML_SERVE_STREAM_BLOCK", "128")
+    c0 = counter_value("serving.stream.blocks")
+    out = models["km"].predict(big)
+    assert counter_value("serving.stream.blocks") - c0 == 8
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_logreg_big_host_batch_streams(models, monkeypatch):
+    rng = np.random.default_rng(52)
+    big = dyadic(rng, (600, D))
+    ref_labels, ref_probs, ref_raw = models["logreg"]._predict_all(big)
+    monkeypatch.setenv("TPUML_SERVE_STREAM_BLOCK", "100")
+    c0 = counter_value("serving.stream.blocks")
+    labels, probs, raw = models["logreg"]._predict_all(big)
+    assert counter_value("serving.stream.blocks") - c0 == 6
+    np.testing.assert_array_equal(labels, ref_labels)
+    np.testing.assert_array_equal(probs, ref_probs)
+    np.testing.assert_array_equal(raw, ref_raw)
+
+
+# ---------------------------------------------------------------------------
+# report integration
+# ---------------------------------------------------------------------------
+
+
+def test_serving_report_runtime_section(models):
+    from spark_rapids_ml_tpu.observability.report import serving_report
+
+    with ServingRuntime(max_batch=8, max_delay_ms=1.0) as rt:
+        rt.register("km", models["km"], alias="prod")
+        rt.submit("km", np.zeros(D)).result(timeout=30)
+        rep = serving_report()
+    mine = [
+        r for r in rep.get("runtimes", []) if r["runtime"] == rt.runtime_id
+    ]
+    assert mine, "runtime missing from serving_report"
+    snap = mine[0]
+    assert snap["models"]["km"]["aliases"] == {"prod": 1}
+    assert snap["queue_depth"] == 0
+    assert rep["request_latency_ms"]["count"] >= 1
+    assert rep["batch_fill"]["count"] >= 1
+
+
+def test_random_forest_serving_roundtrip():
+    """RF rides the same runtime: fit a tiny forest, register, and check
+    the runtime's class distributions match the model's own."""
+    from spark_rapids_ml_tpu.models.random_forest import RandomForestClassifier
+
+    rng = np.random.default_rng(61)
+    x = rng.normal(size=(80, 4)).astype(np.float64)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int64)
+    model = (
+        RandomForestClassifier()
+        .setNumTrees(4)
+        .setMaxDepth(3)
+        .setSeed(0)
+        .fit((x, y))
+    )
+    probe = rng.normal(size=(5, 4))
+    expected = model.predictProbability(probe)
+    with ServingRuntime(max_batch=8, max_delay_ms=1.0) as rt:
+        rt.register("rf", model)
+        out = rt.submit("rf", probe).result(timeout=60)
+    np.testing.assert_array_equal(np.asarray(out), expected)

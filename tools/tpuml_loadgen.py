@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the online serving runtime.
+
+Spins up a :class:`ServingRuntime`, registers one synthetic model per
+requested family, and drives it closed-loop — ``--threads`` workers each
+keep ONE request outstanding (submit, block on the future, repeat) for
+``--requests`` iterations — then prints the latency distribution
+(p50/p95/p99, interpolated from the ``serving.request.latency_ms``
+histogram in the metrics registry) and sustained rows/s, plus the shed /
+deadline / batch counters the run produced. Closed-loop is the honest
+serving-latency posture: each worker's next arrival waits for its last
+answer, so queueing delay shows up in the numbers instead of in an
+unbounded backlog.
+
+Examples::
+
+    python tools/tpuml_loadgen.py --family kmeans --threads 16 --requests 200
+    python tools/tpuml_loadgen.py --family logreg --rows 4 --max-batch 128 \
+        --delay-ms 2 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(family: str, d: int, k: int, seed: int):
+    """A synthetic fitted model of the requested family (no training —
+    the load generator measures the serving path, not the solver)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    if family == "kmeans":
+        from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+
+        return KMeansModel("loadgen-km", rng.normal(size=(k, d)))
+    if family == "logreg":
+        from spark_rapids_ml_tpu.models.logistic_regression import (
+            LogisticRegressionModel,
+        )
+
+        return LogisticRegressionModel(
+            "loadgen-logreg", rng.normal(size=(d, 1)), rng.normal(size=1)
+        )
+    if family == "linreg":
+        from spark_rapids_ml_tpu.models.linear_regression import (
+            LinearRegressionModel,
+        )
+
+        return LinearRegressionModel("loadgen-linreg", rng.normal(size=d), 0.5)
+    if family == "pca":
+        from spark_rapids_ml_tpu.models.pca import PCAModel
+
+        q, _ = np.linalg.qr(rng.normal(size=(d, min(k, d))))
+        return PCAModel("loadgen-pca", q, np.full(q.shape[1], 1.0 / q.shape[1]))
+    raise SystemExit(f"unknown --family {family!r}")
+
+
+def percentile_from_histogram(hist_value: dict, q: float) -> float:
+    """Linear-interpolated percentile from a fixed-bucket histogram
+    snapshot (``{"buckets": {le: cumulative}, "count": n}``). The +Inf
+    bucket reports its lower edge (the histogram's resolution limit)."""
+    count = hist_value["count"]
+    if count == 0:
+        return float("nan")
+    target = q * count
+    prev_le, prev_cum = 0.0, 0
+    for le, cum in sorted(hist_value["buckets"].items()):
+        if cum >= target:
+            if le == float("inf"):
+                return prev_le
+            if cum == prev_cum:
+                return le
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_le + frac * (le - prev_le)
+        prev_le, prev_cum = le, cum
+    return prev_le
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--family", default="kmeans",
+                        choices=("kmeans", "logreg", "linreg", "pca"))
+    parser.add_argument("--threads", type=int, default=16,
+                        help="closed-loop workers (one outstanding request each)")
+    parser.add_argument("--requests", type=int, default=200,
+                        help="requests per worker")
+    parser.add_argument("--rows", type=int, default=1,
+                        help="rows per request (1 = single-row scoring)")
+    parser.add_argument("--features", type=int, default=32)
+    parser.add_argument("--k", type=int, default=8,
+                        help="clusters / components for kmeans / pca")
+    parser.add_argument("--max-batch", type=int, default=None)
+    parser.add_argument("--delay-ms", type=float, default=None)
+    parser.add_argument("--queue", type=int, default=None)
+    parser.add_argument("--mem-budget", type=int, default=None)
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="per-request deadline in seconds")
+    parser.add_argument("--warm", action="store_true",
+                        help="pre-compile the expected row buckets before timing")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable one-line summary only")
+    args = parser.parse_args()
+
+    import numpy as np
+
+    from spark_rapids_ml_tpu.observability.metrics import default_registry
+    from spark_rapids_ml_tpu.serving import (
+        DeadlineExceeded,
+        Overloaded,
+        ServingRuntime,
+    )
+    from spark_rapids_ml_tpu.serving.batcher import _latency_hist
+    from spark_rapids_ml_tpu.utils.tracing import counter_value
+
+    model = build_model(args.family, args.features, args.k, args.seed)
+    rng = np.random.default_rng(args.seed + 1)
+    probes = rng.normal(size=(args.threads, args.requests, args.rows, args.features))
+
+    rt = ServingRuntime(
+        max_batch=args.max_batch,
+        max_delay_ms=args.delay_ms,
+        queue_limit=args.queue,
+        mem_budget=args.mem_budget,
+    )
+    rt.register(args.family, model)
+    if args.warm:
+        # Every bucket the run can hit: rows per request up to a full batch.
+        rt.warm(args.family, buckets=(args.rows, rt.max_batch))
+
+    errors = {"overloaded": 0, "deadline": 0, "other": 0}
+    ok = [0] * args.threads
+    err_lock = threading.Lock()
+
+    def worker(tid: int) -> None:
+        for j in range(args.requests):
+            try:
+                rt.submit(
+                    args.family, probes[tid, j], timeout=args.timeout
+                ).result()
+                ok[tid] += 1
+            except Overloaded:
+                with err_lock:
+                    errors["overloaded"] += 1
+            except DeadlineExceeded:
+                with err_lock:
+                    errors["deadline"] += 1
+            except Exception:  # noqa: BLE001 - loadgen keeps driving
+                with err_lock:
+                    errors["other"] += 1
+
+    c_dispatch0 = counter_value("serving.batch.dispatch")
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(args.threads)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    rt.close()
+
+    completed = sum(ok)
+    rows_done = completed * args.rows
+    hist = _latency_hist().value()
+    dispatches = counter_value("serving.batch.dispatch") - c_dispatch0
+    summary = {
+        "family": args.family,
+        "threads": args.threads,
+        "requests": args.threads * args.requests,
+        "completed": completed,
+        "rows_per_request": args.rows,
+        "rows_per_s": round(rows_done / wall, 1) if wall > 0 else 0.0,
+        "wall_s": round(wall, 3),
+        "p50_ms": round(percentile_from_histogram(hist, 0.50), 3),
+        "p95_ms": round(percentile_from_histogram(hist, 0.95), 3),
+        "p99_ms": round(percentile_from_histogram(hist, 0.99), 3),
+        "batches": dispatches,
+        "mean_batch_requests": round(completed / dispatches, 2) if dispatches else 0,
+        "shed_queue": counter_value("serving.shed.queue"),
+        "shed_memory": counter_value("serving.shed.memory"),
+        "deadline_expired": counter_value("serving.deadline.expired"),
+        "errors": errors,
+    }
+    if args.json:
+        print(json.dumps(summary))
+        return
+    print(f"loadgen [{args.family}] {summary['requests']} requests "
+          f"x {args.rows} row(s), {args.threads} closed-loop workers")
+    print(f"  rows/s:      {summary['rows_per_s']}")
+    print(f"  latency ms:  p50={summary['p50_ms']}  "
+          f"p95={summary['p95_ms']}  p99={summary['p99_ms']}")
+    print(f"  batching:    {dispatches} dispatches, "
+          f"{summary['mean_batch_requests']} requests/batch")
+    print(f"  shed:        queue={summary['shed_queue']} "
+          f"memory={summary['shed_memory']} "
+          f"deadline={summary['deadline_expired']}")
+    if any(errors.values()):
+        print(f"  errors:      {errors}")
+
+
+if __name__ == "__main__":
+    main()
