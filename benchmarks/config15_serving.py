@@ -27,11 +27,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks.common import bytes_roofline, emit, time_amortized
+from spark_rapids_ml_tpu.utils.envknobs import env_int
 
-N = int(os.environ.get("TPUML_BENCH_ROWS", 1_000_000))
-D = int(os.environ.get("TPUML_BENCH_COLS", 1024))
-K = int(os.environ.get("TPUML_BENCH_K", 16))
-BLOCK = int(os.environ.get("TPUML_BENCH_BLOCK", 131_072))
+N = env_int("TPUML_BENCH_ROWS", 1_000_000)
+D = env_int("TPUML_BENCH_COLS", 1024)
+K = env_int("TPUML_BENCH_K", 16)
+BLOCK = env_int("TPUML_BENCH_BLOCK", 131_072)
 
 
 def main() -> None:

@@ -28,11 +28,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks.common import emit
+from spark_rapids_ml_tpu.utils.envknobs import env_int
 
-THREADS = int(os.environ.get("TPUML_BENCH_THREADS", 16))
-REQUESTS = int(os.environ.get("TPUML_BENCH_REQUESTS", 150))
-D = int(os.environ.get("TPUML_BENCH_COLS", 32))
-K = int(os.environ.get("TPUML_BENCH_K", 8))
+THREADS = env_int("TPUML_BENCH_THREADS", 16)
+REQUESTS = env_int("TPUML_BENCH_REQUESTS", 150)
+D = env_int("TPUML_BENCH_COLS", 32)
+K = env_int("TPUML_BENCH_K", 8)
 
 
 def closed_loop(rt, name, probes) -> float:

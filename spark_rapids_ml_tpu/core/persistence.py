@@ -296,8 +296,25 @@ def save_rows(path: str, columns: Dict[str, tuple]) -> None:
         )
 
 
+def _read_all_parts(parquets: list) -> "pa.Table":
+    """One table from EVERY part file, in part order. Spark writes one
+    part per task — a genuine executor-written model dir has many, and
+    reading only ``parquets[0]`` silently dropped every row the other
+    tasks wrote (for forests: whole trees). Schemas are unified across
+    parts so a dictionary-encoded or column-reordered part still joins."""
+    tables = [pq.read_table(p) for p in parquets]
+    if len(tables) == 1:
+        return tables[0]
+    schema = tables[0].schema.remove_metadata()
+    return pa.concat_tables(
+        [t.cast(schema) if t.schema.remove_metadata() != schema else t
+         for t in tables]
+    )
+
+
 def load_rows(path: str) -> Dict[str, list]:
-    """Read a multi-row ``<path>/data`` table into {name: [decoded values]}."""
+    """Read a multi-row ``<path>/data`` table — ALL part files — into
+    {name: [decoded values]}."""
     data_dir = os.path.join(path, "data")
     parquets = [
         p
@@ -305,7 +322,7 @@ def load_rows(path: str) -> Dict[str, list]:
         if not p.endswith("_SUCCESS")
     ]
     if parquets and _HAS_ARROW:
-        table = pq.read_table(parquets[0])
+        table = _read_all_parts(parquets)
         out: Dict[str, list] = {name: [] for name in table.column_names}
         for row in table.to_pylist():
             for name, value in row.items():
@@ -324,14 +341,17 @@ def load_rows(path: str) -> Dict[str, list]:
 
 
 def load_data(path: str) -> Dict[str, Any]:
-    """Read ``<path>/data`` back into {name: decoded value}."""
+    """Read ``<path>/data`` back into {name: decoded value}. All part
+    files are read: Spark tasks with no rows still write an EMPTY part,
+    so the single data row may live in ``part-00001`` while a zero-row
+    ``part-00000`` sorts first."""
     data_dir = os.path.join(path, "data")
     parquets = sorted(glob.glob(os.path.join(data_dir, "*.parquet"))) or sorted(
         glob.glob(os.path.join(data_dir, "part-*"))
     )
     parquets = [p for p in parquets if not p.endswith("_SUCCESS")]
     if parquets and _HAS_ARROW:
-        table = pq.read_table(parquets[0])
+        table = _read_all_parts(parquets)
         row = table.to_pylist()[0]
         out: Dict[str, Any] = {}
         for name, value in row.items():

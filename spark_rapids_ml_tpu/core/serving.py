@@ -80,11 +80,13 @@ def _observe_batch(n: int) -> None:
     ).observe(n)
 
 
-def _publish_cache_size() -> None:
-    """``serving.cache.size`` gauge, updated UNDER the cache lock at
-    every mutation — the thread-safe size truth (tests used to derive it
-    from hit/miss arithmetic, which races concurrent servers)."""
-    _gauge("serving.cache.size", "AOT program cache entries").set(len(_PROGRAMS))
+def _publish_cache_size(size: int) -> None:
+    """``serving.cache.size`` gauge, updated at every mutation with a
+    size snapshotted UNDER the cache lock — the thread-safe size truth
+    (tests used to derive it from hit/miss arithmetic, which races
+    concurrent servers). The size arrives as an argument so this helper
+    stays lexically lock-free (tpuml-lint: lock-guarded)."""
+    _gauge("serving.cache.size", "AOT program cache entries").set(size)
 
 #: Smallest row bucket — tiny interactive batches (a single scored row, a
 #: 3-row unit test) all share one program instead of one each.
@@ -121,8 +123,8 @@ def bucket_rows(n: int, min_bucket: int = MIN_ROW_BUCKET) -> int:
 # ---------------------------------------------------------------------------
 
 _cache_lock = threading.Lock()
-_cache_wired: Optional[str] = None
-_cache_checked = False
+_cache_wired: Optional[str] = None  # guarded-by: _cache_lock
+_cache_checked = False  # guarded-by: _cache_lock
 
 
 def configure_compile_cache(path: Optional[str] = None, *, force: bool = False):
@@ -172,8 +174,8 @@ def _reset_compile_cache_wiring_for_tests() -> None:
 # ---------------------------------------------------------------------------
 
 _LOCK = threading.RLock()
-_PROGRAMS: "OrderedDict[tuple, Any]" = OrderedDict()
-_STATS = {"hits": 0, "misses": 0, "evictions": 0, "compiles": 0}
+_PROGRAMS: "OrderedDict[tuple, Any]" = OrderedDict()  # guarded-by: _LOCK
+_STATS = {"hits": 0, "misses": 0, "evictions": 0, "compiles": 0}  # guarded-by: _LOCK
 
 
 def _capacity() -> int:
@@ -206,7 +208,7 @@ def clear_program_cache() -> None:
         _JIT_FALLBACKS.clear()
         for k in _STATS:
             _STATS[k] = 0
-        _publish_cache_size()
+        _publish_cache_size(len(_PROGRAMS))
         models = list(_DEVICE_CACHED_MODELS)
     for model in models:
         invalidate_device_caches(model)
@@ -221,7 +223,7 @@ _DEVICE_CACHE_DICTS = ("_pc_dev_cache",)
 #: Models that populated a device-weight cache (weakly held): the set
 #: :func:`clear_program_cache` sweeps so a cache reset cannot leave any
 #: model serving stale device weights.
-_DEVICE_CACHED_MODELS: "weakref.WeakSet" = weakref.WeakSet()
+_DEVICE_CACHED_MODELS: "weakref.WeakSet" = weakref.WeakSet()  # guarded-by: _LOCK
 
 
 def note_device_cache(model: Any) -> None:
@@ -323,7 +325,7 @@ def _get_program(fn: Callable, x_spec, args: tuple, static: dict, donate: bool):
                 _STATS["evictions"] += 1
                 bump_counter("serving.cache.evict")
                 emit("serving", action="evict")
-            _publish_cache_size()
+            _publish_cache_size(len(_PROGRAMS))
         return _PROGRAMS[key]
 
 
@@ -396,7 +398,7 @@ def _jit_fallback(fn: Callable, static: dict):
         return jitted
 
 
-_JIT_FALLBACKS: Dict[tuple, Any] = {}
+_JIT_FALLBACKS: Dict[tuple, Any] = {}  # guarded-by: _LOCK
 
 
 def serve_rows(

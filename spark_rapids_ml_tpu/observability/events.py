@@ -39,7 +39,7 @@ from typing import Any, Dict, List, Optional
 
 import contextvars
 
-from spark_rapids_ml_tpu.utils.envknobs import env_str
+from spark_rapids_ml_tpu.utils.envknobs import EnvKnobError, env_int, env_str
 
 EVENT_LOG_ENV = "TPUML_EVENT_LOG"
 
@@ -171,9 +171,11 @@ def run_scope(kind: str, label: str = ""):
 # --- the sink ----------------------------------------------------------
 
 _sink = None  # None = disabled: emit() is a single attribute check
-_sink_owned = False  # did we open the file (close it on reconfigure)?
+# (_sink itself is deliberately NOT lock-guarded: the disabled fast path
+# reads it lock-free once, then re-checks under the lock before writing.)
+_sink_owned = False  # guarded-by: _sink_lock
 _sink_lock = threading.Lock()
-_n_emitted = 0
+_n_emitted = 0  # guarded-by: _sink_lock
 _process_index: Optional[int] = None
 
 
@@ -187,13 +189,13 @@ def set_process_index(idx: int) -> None:
 def _resolve_process_index() -> int:
     if _process_index is not None:
         return _process_index
-    raw = os.environ.get("TPUML_PROCESS_ID", "").strip()
-    if raw:
-        try:
-            return int(raw)
-        except ValueError:
-            pass
-    return 0
+    try:
+        idx = env_int("TPUML_PROCESS_ID")
+    except EnvKnobError:
+        # A malformed rank must not make every emit() raise — the
+        # distributed bring-up validates the same knob loudly.
+        return 0
+    return 0 if idx is None else idx
 
 
 def configure(path: Optional[str] = None) -> Optional[str]:
@@ -227,7 +229,8 @@ def enabled() -> bool:
 
 def emitted_count() -> int:
     """Total records written since import — the zero-events assertion."""
-    return _n_emitted
+    with _sink_lock:
+        return _n_emitted
 
 
 def emit(etype: str, **fields) -> None:

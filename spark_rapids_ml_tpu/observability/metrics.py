@@ -32,10 +32,11 @@ from __future__ import annotations
 
 import atexit
 import json
-import os
 import threading
 import time
 from typing import Callable, Dict, Iterable, Optional, Tuple, Union
+
+from spark_rapids_ml_tpu.utils.envknobs import env_str
 
 METRICS_DUMP_ENV = "TPUML_METRICS_DUMP"
 
@@ -81,7 +82,7 @@ class _Metric:
         self.name = name
         self.help = help
         self._lock = lock
-        self._series: Dict[LabelKey, Union[int, float]] = {}
+        self._series: Dict[LabelKey, Union[int, float]] = {}  # guarded-by: _lock
 
     def _snapshot_series(self) -> Dict[LabelKey, Union[int, float]]:
         with self._lock:
@@ -112,7 +113,7 @@ class Gauge(_Metric):
 
     def __init__(self, name: str, help: str, lock: threading.Lock):
         super().__init__(name, help, lock)
-        self._functions: Dict[LabelKey, Callable[[], float]] = {}
+        self._functions: Dict[LabelKey, Callable[[], float]] = {}  # guarded-by: _lock
 
     def set(self, value: Union[int, float], **labels) -> None:
         key = _label_key(labels)
@@ -217,7 +218,7 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, _Metric] = {}  # guarded-by: _lock
 
     def _get(self, name: str, kind: type, help: str, **kwargs) -> _Metric:
         with self._lock:
@@ -365,7 +366,7 @@ def dump_snapshot(path: str, registry: Optional[Registry] = None) -> None:
 
 
 def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess
-    path = os.environ.get(METRICS_DUMP_ENV, "").strip()
+    path = env_str(METRICS_DUMP_ENV)
     if path:
         try:
             dump_snapshot(path)

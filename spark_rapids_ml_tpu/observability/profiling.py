@@ -25,7 +25,7 @@ from spark_rapids_ml_tpu.utils.envknobs import env_str
 PROFILE_DIR_ENV = "TPUML_PROFILE_DIR"
 
 _lock = threading.Lock()
-_active = False
+_active = False  # guarded-by: _lock
 
 
 def profile_dir() -> Optional[str]:

@@ -211,8 +211,11 @@ def _node_totals(
 
 @partial(
     jax.jit,
+    # `level` stays traced: fold_in takes a traced int, and keeping it out
+    # of the program key avoids a per-level retrace on top of the
+    # shape-driven one (tpuml-lint: jax-static-loop-arg).
     static_argnames=(
-        "level", "impurity", "feat_subset", "min_instances", "min_info_gain"
+        "impurity", "feat_subset", "min_instances", "min_info_gain"
     ),
 )
 def split_level(

@@ -26,7 +26,6 @@ Deployment shape (mirrors the reference's executor model):
 from __future__ import annotations
 
 import functools as _functools
-import os
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -41,7 +40,7 @@ from spark_rapids_ml_tpu.parallel.mesh import (
 )
 from spark_rapids_ml_tpu.robustness.faults import fault_point
 from spark_rapids_ml_tpu.robustness.retry import default_policy
-from spark_rapids_ml_tpu.utils.envknobs import EnvKnobError, env_int
+from spark_rapids_ml_tpu.utils.envknobs import EnvKnobError, env_int, env_str
 
 _initialized = False
 # The coordinates the active runtime was actually brought up with —
@@ -81,7 +80,7 @@ def _check_reinit_request(
 
     if _init_record is None:
         return
-    requested = {"coordinator_address": coordinator_address or os.environ.get("TPUML_COORDINATOR")}
+    requested = {"coordinator_address": coordinator_address or env_str("TPUML_COORDINATOR")}
     try:
         requested["num_processes"] = (
             num_processes if num_processes is not None
@@ -135,7 +134,7 @@ def initialize(
     # env_int (utils/envknobs.py) names the variable, the bad value, and
     # the expected form — a launcher typo used to surface as an anonymous
     # `invalid literal for int()` on every gang member at once.
-    coordinator_address = coordinator_address or os.environ.get("TPUML_COORDINATOR")
+    coordinator_address = coordinator_address or env_str("TPUML_COORDINATOR")
     if num_processes is None:
         num_processes = env_int("TPUML_NUM_PROCESSES", minimum=1)
     if process_id is None:

@@ -45,11 +45,11 @@ or the :func:`inject` context manager. When no plan is active,
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, List, Optional, Tuple, Union
 
 from spark_rapids_ml_tpu.observability.events import emit
+from spark_rapids_ml_tpu.utils.envknobs import env_str
 
 KNOWN_SITES = frozenset(
     {
@@ -169,7 +169,7 @@ class FaultPlan:
 
     def __init__(self, schedules: Dict[str, Schedule]):
         self._schedules = dict(schedules)
-        self._counts: Dict[str, int] = {}
+        self._counts: Dict[str, int] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self.fired: List[Tuple[str, int]] = []
 
@@ -258,7 +258,7 @@ def arm_from_env() -> Optional[FaultPlan]:
     Runs once at import so a launcher can inject into any process with
     zero code changes; callable again by harnesses that set the env
     after import."""
-    spec = os.environ.get(FAULTS_ENV)
+    spec = env_str(FAULTS_ENV)
     if spec:
         return arm(spec)
     return None

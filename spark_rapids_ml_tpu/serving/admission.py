@@ -133,10 +133,10 @@ class AdmissionQueue:
     def __init__(self, limit: int, mem_budget: int = 0):
         self.limit = int(limit)
         self.mem_budget = int(mem_budget)
-        self._dq: "deque[Request]" = deque()
+        self._dq: "deque[Request]" = deque()  # guarded-by: _cond
         self._cond = threading.Condition()
-        self._reserved = 0
-        self._closed = False
+        self._reserved = 0  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
 
     # --- producer side ---
 
@@ -145,18 +145,19 @@ class AdmissionQueue:
             if self._closed:
                 raise RuntimeError("serving queue is closed")
             name = req.key[0]
-            if len(self._dq) >= self.limit:
-                self._shed(req, "queue")
+            depth, reserved = len(self._dq), self._reserved
+            if depth >= self.limit:
+                self._shed(req, "queue", depth, reserved)
                 raise Overloaded(
                     "queue", name,
-                    queue_depth=len(self._dq), queue_limit=self.limit,
+                    queue_depth=depth, queue_limit=self.limit,
                 )
-            if self.mem_budget and self._reserved + req.cost > self.mem_budget:
-                self._shed(req, "memory")
+            if self.mem_budget and reserved + req.cost > self.mem_budget:
+                self._shed(req, "memory", depth, reserved)
                 raise Overloaded(
                     "memory", name,
-                    queue_depth=len(self._dq), queue_limit=self.limit,
-                    reserved_bytes=self._reserved, request_bytes=req.cost,
+                    queue_depth=depth, queue_limit=self.limit,
+                    reserved_bytes=reserved, request_bytes=req.cost,
                     mem_budget=self.mem_budget,
                 )
             self._reserved += req.cost
@@ -164,12 +165,15 @@ class AdmissionQueue:
             self._dq.append(req)
             self._cond.notify_all()
 
-    def _shed(self, req: Request, reason: str) -> None:
+    def _shed(self, req: Request, reason: str, depth: int, reserved: int) -> None:
+        # Queue state arrives as arguments: the caller snapshots it under
+        # the admission lock, so this helper stays lexically lock-free
+        # (tpuml-lint: lock-guarded).
         bump_counter(f"serving.shed.{reason}")
         emit(
             "serving", action="shed", reason=reason, model=req.key[0],
             version=req.key[1], rows=req.n, run_id=req.run_id,
-            depth=len(self._dq), reserved_bytes=self._reserved,
+            depth=depth, reserved_bytes=reserved,
         )
 
     def release(self, req: Request) -> None:
@@ -194,7 +198,8 @@ class AdmissionQueue:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._cond:
+            return self._closed
 
     def pop_first(self, timeout: float) -> Optional[Request]:
         """The oldest queued request, waiting up to ``timeout`` for one."""

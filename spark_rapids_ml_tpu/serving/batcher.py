@@ -91,7 +91,7 @@ class MicroBatcher:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._drain = True
-        self._inflight = 0
+        self._inflight = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # --- lifecycle ---

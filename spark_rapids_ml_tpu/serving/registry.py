@@ -63,11 +63,11 @@ class ModelRegistry:
 
     def __init__(self):
         self._lock = threading.RLock()
-        self._versions: Dict[str, Dict[int, ModelVersion]] = {}
+        self._versions: Dict[str, Dict[int, ModelVersion]] = {}  # guarded-by: _lock
         # High-water version per name: never decremented, so a retired
         # version number is never reissued to a different model.
-        self._next: Dict[str, int] = {}
-        self._aliases: Dict[str, Dict[str, int]] = {}
+        self._next: Dict[str, int] = {}  # guarded-by: _lock
+        self._aliases: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
 
     # --- registration / swap ---
 

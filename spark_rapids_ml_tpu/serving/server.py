@@ -52,7 +52,7 @@ from spark_rapids_ml_tpu.utils.tracing import bump_counter
 #: Live runtimes (weak): the serving report's runtime section.
 _RUNTIMES: "weakref.WeakSet[ServingRuntime]" = weakref.WeakSet()
 _runtime_seq_lock = threading.Lock()
-_runtime_seq = 0
+_runtime_seq = 0  # guarded-by: _runtime_seq_lock
 
 
 def runtime_snapshots() -> List[dict]:

@@ -1,4 +1,4 @@
-"""One home for ``TPUML_*`` environment-knob parsing.
+"""One home for ``TPUML_*`` environment-knob parsing AND registration.
 
 Every env knob used to be read with a bare ``int(os.environ[...])``, so a
 malformed value (``TPUML_HEARTBEAT_TIMEOUT=ten``) surfaced as an anonymous
@@ -6,12 +6,22 @@ malformed value (``TPUML_HEARTBEAT_TIMEOUT=ten``) surfaced as an anonymous
 was broken or what shape it expects — the exact failure mode a launcher
 typo produces on every gang member at once. These helpers raise one
 uniform, named error instead: variable, offending value, expected form.
+
+:data:`KNOBS` is the central registry: every ``TPUML_*`` name the system
+reads is declared here ONCE (type, default, subsystem, one-line meaning).
+The accessors refuse unregistered ``TPUML_*`` names (``TPUML_TEST_*``
+harness inputs excepted), the static analyzer (``tools/tpuml_lint``,
+rule ``knob-unregistered``) flags literals that bypass this table, and
+rule ``knob-undocumented`` cross-checks the table against the knob
+tables in ``docs/PARITY.md`` — so code, registry, and docs cannot drift
+apart silently.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
 
 
 class EnvKnobError(ValueError):
@@ -27,12 +37,138 @@ class EnvKnobError(ValueError):
         )
 
 
+@dataclass(frozen=True)
+class Knob:
+    """One registered ``TPUML_*`` environment knob."""
+
+    name: str
+    kind: str  # "int" | "float" | "str" | "choice"
+    subsystem: str
+    meaning: str
+    default: object = None
+    choices: Tuple[str, ...] = field(default=())
+
+
+def _knob_table(*knobs: Knob) -> Dict[str, Knob]:
+    return {k.name: k for k in knobs}
+
+
+#: Every runtime ``TPUML_*`` knob, keyed by name. ``TPUML_TEST_*``
+#: variables are test-harness inputs, not runtime knobs, and are exempt
+#: from registration (PARITY.md documents the same split).
+KNOBS: Dict[str, Knob] = _knob_table(
+    # distributed bring-up
+    Knob("TPUML_COORDINATOR", "str", "distributed",
+         "coordinator host:port for jax.distributed.initialize"),
+    Knob("TPUML_NUM_PROCESSES", "int", "distributed",
+         "gang size for the distributed bring-up"),
+    Knob("TPUML_PROCESS_ID", "int", "distributed",
+         "this process's rank in the gang (also stamps event envelopes)"),
+    Knob("TPUML_HEARTBEAT_TIMEOUT", "int", "distributed",
+         "seconds before a dead peer fails survivors' collectives"),
+    # robustness: fault injection / retry / degradation
+    Knob("TPUML_FAULTS", "str", "robustness",
+         "deterministic fault-injection spec (site=N[:fatal|:torn];...)"),
+    Knob("TPUML_RETRY_MAX_ATTEMPTS", "int", "robustness",
+         "attempts per recoverable operation", default=3),
+    Knob("TPUML_RETRY_BASE_DELAY", "float", "robustness",
+         "first backoff in seconds (doubles per attempt)", default=0.05),
+    Knob("TPUML_RETRY_MAX_DELAY", "float", "robustness",
+         "backoff cap in seconds", default=2.0),
+    Knob("TPUML_RETRY_DEADLINE", "float", "robustness",
+         "overall wall-clock retry budget in seconds"),
+    Knob("TPUML_BARRIER_RESUBMITS", "int", "robustness",
+         "driver-side whole-stage resubmissions in barrier_gang_run",
+         default=1),
+    Knob("TPUML_DEGRADE", "choice", "robustness",
+         "off: errors propagate; cpu: single-process fits fall back",
+         default="off", choices=("off", "cpu")),
+    # checkpoint / resume
+    Knob("TPUML_CHECKPOINT_EVERY", "int", "checkpoint",
+         "solver iterations per jitted segment (0 = monolithic)",
+         default=0),
+    Knob("TPUML_CHECKPOINT_DIR", "str", "checkpoint",
+         "checkpoint root reachable by every gang member"),
+    Knob("TPUML_CHECKPOINT_KEEP", "int", "checkpoint",
+         "snapshots retained per fit", default=2),
+    Knob("TPUML_CHECKPOINT_UMAP", "choice", "checkpoint",
+         "1 opts UMAP layout SGD into the global checkpoint knobs",
+         default="0", choices=("0", "1")),
+    # observability
+    Knob("TPUML_EVENT_LOG", "str", "observability",
+         "JSONL event-log destination (path or 'stderr'); unset = off"),
+    Knob("TPUML_PROFILE_DIR", "str", "observability",
+         "wrap top-level fits/transforms in a jax.profiler session here"),
+    Knob("TPUML_METRICS_DUMP", "str", "observability",
+         "write a metrics snapshot at exit (.prom = Prometheus text)"),
+    Knob("TPUML_GANG_HEARTBEAT_EVERY", "float", "observability",
+         "seconds between gang heartbeat records (0 disables)",
+         default=5.0),
+    # serving-path program cache
+    Knob("TPUML_SERVING_CACHE_SIZE", "int", "serving",
+         "bound on the AOT executable LRU (entries per process)",
+         default=32),
+    Knob("TPUML_SERVING_DONATE", "choice", "serving",
+         "donate layer-owned padded scratch inputs to executables",
+         default="on", choices=("on", "off")),
+    Knob("TPUML_COMPILE_CACHE_DIR", "str", "serving",
+         "persistent XLA compilation cache directory"),
+    Knob("TPUML_COMPILE_CACHE_FORCE", "choice", "serving",
+         "1 forces the compile cache on the CPU backend",
+         default="0", choices=("0", "1")),
+    Knob("TPUML_SERVE_STREAM_BLOCK", "int", "serving",
+         "rows per block for double-buffered host-batch streaming",
+         default=65536),
+    # online-serving runtime
+    Knob("TPUML_SERVE_MAX_BATCH", "int", "serving-runtime",
+         "rows per coalesced micro-batch dispatch", default=256),
+    Knob("TPUML_SERVE_MAX_DELAY_MS", "float", "serving-runtime",
+         "coalescing window from the first request of a forming batch",
+         default=5.0),
+    Knob("TPUML_SERVE_QUEUE", "int", "serving-runtime",
+         "admission queue depth bound", default=1024),
+    Knob("TPUML_SERVE_MEM_BUDGET", "int", "serving-runtime",
+         "device-memory admission budget in bytes (0 = gate off)",
+         default=0),
+    # benchmark shape overrides (benchmarks/ only)
+    Knob("TPUML_BENCH_ROWS", "int", "benchmarks",
+         "row-count override for serving benchmarks"),
+    Knob("TPUML_BENCH_COLS", "int", "benchmarks",
+         "feature-count override for serving benchmarks"),
+    Knob("TPUML_BENCH_K", "int", "benchmarks",
+         "output-dimension override for serving benchmarks"),
+    Knob("TPUML_BENCH_BLOCK", "int", "benchmarks",
+         "stream-block override for the serving benchmark"),
+    Knob("TPUML_BENCH_THREADS", "int", "benchmarks",
+         "client thread count for the server benchmark"),
+    Knob("TPUML_BENCH_REQUESTS", "int", "benchmarks",
+         "per-thread request count for the server benchmark"),
+)
+
+
+def _require_registered(name: str) -> None:
+    """Accessors refuse unregistered ``TPUML_*`` names: a typo'd knob
+    read must fail loudly at the read site, not silently return the
+    default forever. ``TPUML_TEST_*`` harness inputs are exempt."""
+    if (
+        name.startswith("TPUML_")
+        and not name.startswith("TPUML_TEST_")
+        and name not in KNOBS
+    ):
+        raise ValueError(
+            f"environment knob {name!r} is not registered in "
+            "spark_rapids_ml_tpu.utils.envknobs.KNOBS — add a Knob entry "
+            "(and a docs/PARITY.md row) before reading it"
+        )
+
+
 def env_int(
     name: str,
     default: Optional[int] = None,
     minimum: Optional[int] = None,
 ) -> Optional[int]:
     """``int(os.environ[name])`` with a named, actionable error."""
+    _require_registered(name)
     raw = os.environ.get(name)
     if raw is None:
         return default
@@ -51,6 +187,7 @@ def env_float(
     minimum: Optional[float] = None,
 ) -> Optional[float]:
     """``float(os.environ[name])`` with a named, actionable error."""
+    _require_registered(name)
     raw = os.environ.get(name)
     if raw is None:
         return default
@@ -66,6 +203,7 @@ def env_float(
 def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
     """A free-form string knob (paths, addresses); empty strings read as
     unset so ``TPUML_X= cmd`` shell idioms disable rather than misconfigure."""
+    _require_registered(name)
     raw = os.environ.get(name)
     if raw is None:
         return default
@@ -75,6 +213,7 @@ def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
 
 def env_choice(name: str, choices: Sequence[str], default: str) -> str:
     """A string knob restricted to an explicit vocabulary."""
+    _require_registered(name)
     raw = os.environ.get(name)
     if raw is None:
         return default

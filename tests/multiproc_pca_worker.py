@@ -34,6 +34,7 @@ _x64 = os.environ.get("TPUML_TEST_NO_X64") != "1"
 jax.config.update("jax_enable_x64", _x64)
 
 from spark_rapids_ml_tpu.parallel import distributed as dist
+from spark_rapids_ml_tpu.utils.envknobs import env_int
 
 dist.initialize()  # from TPUML_* env
 
@@ -43,7 +44,7 @@ from spark_rapids_ml_tpu.feature import PCA
 def main() -> None:
     pid = jax.process_index()
     n_proc = jax.process_count()
-    assert n_proc == int(os.environ["TPUML_NUM_PROCESSES"]), n_proc
+    assert n_proc == env_int("TPUML_NUM_PROCESSES"), n_proc
 
     # Deterministic global dataset; every worker derives the same one and
     # takes a DIFFERENT (deliberately uneven) slice as its local data.

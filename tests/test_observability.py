@@ -40,11 +40,12 @@ from spark_rapids_ml_tpu.robustness.checkpoint import FitCheckpointer
 from spark_rapids_ml_tpu.robustness.faults import inject
 from spark_rapids_ml_tpu.robustness.retry import RetryExhaustedError, RetryPolicy
 from spark_rapids_ml_tpu.utils import tracing
+from spark_rapids_ml_tpu.utils.envknobs import env_str
 
 
 # --- sink plumbing ------------------------------------------------------
 
-_PREV_LOG = os.environ.get(events.EVENT_LOG_ENV)
+_PREV_LOG = env_str(events.EVENT_LOG_ENV)
 
 
 def _restore_sink():

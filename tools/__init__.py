@@ -1,0 +1,4 @@
+"""Developer tooling: the static quality gate (``tools.tpuml_lint``),
+telemetry CLIs (``tpuml_metrics``), and the serving load generator
+(``tpuml_loadgen``). A package so ``python -m tools.tpuml_lint`` works
+from a checkout with no install step."""

@@ -1,124 +1,32 @@
-"""Self-contained static quality gate (the `-Xfatal-warnings` / apache-rat
-analogue of the reference's build, pom.xml:194,361-397 — the image ships no
-ruff/mypy/pyflakes, so the checks are implemented on the stdlib ast).
+"""Compatibility shim — the lint gate moved to ``tools/tpuml_lint/``.
 
-Checks per file:
-  - parses (syntax)
-  - module docstring present (the rat-style header gate; this repo's
-    convention documents every module instead of license boilerplate)
-  - no unused imports (module scope)
-  - no bare `except:`
-  - no mutable default arguments
-  - no `import *`
+The seed's six generic checks (docstring, unused imports, bare except,
+mutable defaults, ``import *``, syntax) now live in
+``tools/tpuml_lint/generic.py`` as one of five checker families of a
+plugin analyzer (JAX retrace/sync hazards, guarded-by lock discipline,
+the ``TPUML_*`` knob registry, observability drift). Run the real
+thing::
 
-Run: ``python tools/lint.py [paths...]`` — exits non-zero on findings.
-The test suite runs it over the package + tests (tests/test_quality.py),
-so the gate fails the build like the reference's fatal warnings did.
+    python -m tools.tpuml_lint [--format json] [--validate-baseline]
+
+This wrapper keeps ``python tools/lint.py`` working for muscle memory
+and old scripts; it delegates to the package CLI (baseline applied).
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
-DEFAULT_PATHS = [REPO / "spark_rapids_ml_tpu", REPO / "tests", REPO / "benchmarks"]
-
-# Names whose import is intentionally "unused" at module scope.
-_IMPORT_SIDE_EFFECT_OK = {"annotations"}
 
 
-def _imported_names(tree: ast.Module):
-    """(bound-name, lineno) for every import binding, in ANY scope —
-    a binding unused anywhere in the file is flagged regardless of where
-    the import statement sits."""
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                name = (a.asname or a.name).split(".")[0]
-                out.append((name, node.lineno))
-        elif isinstance(node, ast.ImportFrom):
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                out.append((a.asname or a.name, node.lineno))
-    return out
+def main(argv) -> int:
+    if str(REPO) not in sys.path:
+        sys.path.insert(0, str(REPO))
+    from tools.tpuml_lint.__main__ import main as lint_main
 
-
-def _used_names(tree: ast.Module):
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-    # Names referenced in __all__ strings count as used (re-export files).
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Assign)
-            and any(
-                isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets
-            )
-            and isinstance(node.value, (ast.List, ast.Tuple))
-        ):
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
-                    used.add(elt.value)
-    return used
-
-
-def lint_file(path: Path) -> list[str]:
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-    findings = []
-
-    if ast.get_docstring(tree) is None and path.name != "__init__.py":
-        findings.append(f"{path}:1: missing module docstring")
-
-    used = _used_names(tree)
-    noqa_lines = {
-        i + 1 for i, line in enumerate(src.splitlines()) if "# noqa" in line
-    }
-    for name, lineno in _imported_names(tree):
-        if name in _IMPORT_SIDE_EFFECT_OK or lineno in noqa_lines:
-            continue
-        if name not in used:
-            findings.append(f"{path}:{lineno}: unused import {name!r}")
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append(f"{path}:{node.lineno}: bare except")
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None
-            ]:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                    findings.append(
-                        f"{path}:{node.lineno}: mutable default argument "
-                        f"in {node.name}()"
-                    )
-        if isinstance(node, ast.ImportFrom) and any(
-            a.name == "*" for a in node.names
-        ):
-            findings.append(f"{path}:{node.lineno}: import *")
-    return findings
-
-
-def main(argv: list[str]) -> int:
-    paths = [Path(p) for p in argv] if argv else DEFAULT_PATHS
-    files = []
-    for p in paths:
-        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
-    findings = []
-    for f in files:
-        findings.extend(lint_file(f))
-    for line in findings:
-        print(line)
-    print(f"lint: {len(files)} files, {len(findings)} findings")
-    return 1 if findings else 0
+    return lint_main(list(argv))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,84 @@
+"""CLI entry: ``python -m tools.tpuml_lint [paths...]``.
+
+Exit codes: 0 clean (no non-baselined findings; with
+``--validate-baseline`` also no stale baseline entries), 1 findings,
+2 bad invocation. ``--format json`` emits one machine-readable document
+(the CI artifact); text mode prints one finding per line plus a summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    import tools.tpuml_lint as tl
+    from tools.tpuml_lint import baseline as bl
+
+    ap = argparse.ArgumentParser(
+        prog="tools.tpuml_lint",
+        description="Static quality + domain-invariant gate "
+                    "(JAX hazards, lock discipline, knob registry, "
+                    "observability drift).",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: "
+                         f"{', '.join(tl.DEFAULT_PATHS)})")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {tl.DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--validate-baseline", action="store_true",
+                    help="CI mode: also fail on stale baseline entries")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="adopt the current findings as the new baseline")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else tl.REPO_ROOT
+    findings, n_files = tl.run(root=root, paths=args.paths or None)
+
+    baseline_path = Path(args.baseline) if args.baseline else tl.DEFAULT_BASELINE
+    if args.write_baseline:
+        bl.save(baseline_path, findings)
+        print(f"tpuml-lint: wrote {len(findings)} baseline entries to "
+              f"{baseline_path}")
+        return 0
+
+    entries = [] if args.no_baseline else bl.load(baseline_path)
+    new, baselined, stale = bl.apply(findings, entries)
+
+    failed = bool(new) or (args.validate_baseline and bool(stale))
+    if args.format == "json":
+        doc = {
+            "files": n_files,
+            "new": [f.to_json() for f in new],
+            "baselined": [f.to_json() for f in baselined],
+            "stale": stale,
+            "ok": not failed,
+        }
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if args.validate_baseline:
+            for e in stale:
+                print(
+                    f"{e.get('path')}: stale baseline entry for rule "
+                    f"{e.get('rule')!r}: {e.get('message')} — remove it "
+                    f"from {baseline_path}"
+                )
+        print(
+            f"tpuml-lint: {n_files} files, {len(new)} new finding(s), "
+            f"{len(baselined)} baselined, {len(stale)} stale"
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

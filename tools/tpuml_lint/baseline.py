@@ -1,0 +1,64 @@
+"""Baseline file handling — adopt-then-ratchet for legacy findings.
+
+The baseline is a committed JSON list of finding keys (path, rule,
+message — line numbers excluded so unrelated edits above a finding do
+not invalidate it). A run fails only on findings NOT in the baseline;
+``--validate-baseline`` additionally fails on STALE entries (baselined
+findings that no longer occur), so the baseline can only shrink.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from tools.tpuml_lint.findings import Finding
+
+
+def load(path: Path) -> List[dict]:
+    if not Path(path).is_file():
+        return []
+    data = json.loads(Path(path).read_text() or "[]")
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {path} must hold a JSON list")
+    return data
+
+
+def save(path: Path, findings: List[Finding]) -> None:
+    entries = [
+        {"path": f.path, "rule": f.rule, "message": f.message}
+        for f in sorted(findings, key=lambda f: f.baseline_key())
+    ]
+    Path(path).write_text(json.dumps(entries, indent=2) + "\n")
+
+
+def _key(entry: dict) -> Tuple[str, str, str]:
+    return (entry.get("path", ""), entry.get("rule", ""),
+            entry.get("message", ""))
+
+
+def apply(findings: List[Finding], entries: List[dict]
+          ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Split ``findings`` against the baseline: (new, baselined, stale).
+    Multiplicity counts — two identical findings need two entries."""
+    budget: Dict[Tuple[str, str, str], int] = Counter(
+        _key(e) for e in entries
+    )
+    new: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in findings:
+        k = f.baseline_key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = []
+    for e in entries:
+        k = _key(e)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            stale.append(e)
+    return new, baselined, stale

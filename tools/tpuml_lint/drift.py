@@ -1,0 +1,131 @@
+"""Checker family (d): observability drift.
+
+The event log's consumers (``tools/tpuml_metrics.py --validate``, the CI
+gates, dashboards) trust two contracts the type system cannot see:
+
+  - every ``emit(<type>, ...)`` callsite names a record type declared in
+    ``events.py::SCHEMA`` and passes every required field that type
+    declares (``event-unknown-type`` / ``event-missing-field``) — a
+    drifted callsite would write lines the validator rejects AFTER the
+    run that produced them;
+  - every literal metric name at a ``counter()`` / ``gauge()`` /
+    ``histogram()`` / ``bump_counter()`` callsite follows the dotted
+    naming rule ``subsystem.metric[.detail]`` (``metric-name``), so the
+    Prometheus exposition stays uniform.
+
+Callsites are matched through import bindings (``from ...events import
+emit``, ``import ... as``), so a local function that happens to be
+called ``emit`` — the benchmarks have one — is never confused with the
+event-log entry point. Dynamic names (f-strings, variables) are skipped:
+the rule is about literals drifting, not about proving dataflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from tools.tpuml_lint.engine import ModuleContext, RepoContext
+from tools.tpuml_lint.findings import Finding
+
+_EVENTS_MOD = "spark_rapids_ml_tpu.observability.events"
+_METRICS_MOD = "spark_rapids_ml_tpu.observability.metrics"
+_TRACING_MOD = "spark_rapids_ml_tpu.utils.tracing"
+
+_METRIC_NAME = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+
+_METRIC_FACTORIES = {
+    f"{_METRICS_MOD}.counter",
+    f"{_METRICS_MOD}.gauge",
+    f"{_METRICS_MOD}.histogram",
+    f"{_TRACING_MOD}.bump_counter",
+    f"{_TRACING_MOD}.counter_value",
+}
+
+
+def _emit_call(node: ast.Call, module: ModuleContext) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        if module.binds_to(f.id, f"{_EVENTS_MOD}.emit"):
+            return True
+        # Inside events.py itself, emit is a local definition.
+        return (
+            module.rel == RepoContext.EVENTS_REL and f.id == "emit"
+        )
+    if isinstance(f, ast.Attribute) and f.attr == "emit":
+        return (
+            isinstance(f.value, ast.Name)
+            and module.import_bindings.get(f.value.id) == _EVENTS_MOD
+        )
+    return False
+
+
+def _metric_call(node: ast.Call, module: ModuleContext) -> bool:
+    f = node.func
+    local_names = {"counter", "gauge", "histogram", "bump_counter"}
+    if isinstance(f, ast.Name):
+        origin = module.import_bindings.get(f.id)
+        if origin in _METRIC_FACTORIES:
+            return True
+        # The defining modules call their own factories by local name.
+        defining = module.rel in (
+            "spark_rapids_ml_tpu/observability/metrics.py",
+            "spark_rapids_ml_tpu/utils/tracing.py",
+        )
+        return defining and f.id in local_names
+    if isinstance(f, ast.Attribute) and f.attr in local_names:
+        return (
+            isinstance(f.value, ast.Name)
+            and module.import_bindings.get(f.value.id)
+            in (_METRICS_MOD, _TRACING_MOD)
+        )
+    return False
+
+
+def check(module: ModuleContext, repo: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    rel = module.rel
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _emit_call(node, module) and repo.event_schema is not None:
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            etype = node.args[0].value
+            if etype not in repo.event_schema:
+                findings.append(Finding(
+                    rel, node.lineno, node.col_offset, "event-unknown-type",
+                    f"emit({etype!r}, ...) — no such record type in "
+                    "events.py::SCHEMA",
+                ))
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs splat: fields not statically known
+            provided = {kw.arg for kw in node.keywords}
+            missing = sorted(repo.event_schema[etype] - provided)
+            if missing:
+                findings.append(Finding(
+                    rel, node.lineno, node.col_offset, "event-missing-field",
+                    f"emit({etype!r}, ...) is missing required field(s) "
+                    f"{', '.join(missing)} (events.py::SCHEMA)",
+                ))
+        elif _metric_call(node, module):
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            name = node.args[0].value
+            if not _METRIC_NAME.match(name):
+                findings.append(Finding(
+                    rel, node.lineno, node.col_offset, "metric-name",
+                    f"metric name {name!r} does not match the dotted "
+                    "naming rule (lowercase 'subsystem.metric[.detail]')",
+                ))
+    return findings

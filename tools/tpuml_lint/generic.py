@@ -1,0 +1,105 @@
+"""The generic hygiene family — the seed ``tools/lint.py`` checks, as
+engine plugins: module docstring, unused imports, bare except, mutable
+defaults, ``import *``. (Syntax errors are reported by the engine itself
+so every other checker can assume a parse tree.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.tpuml_lint.engine import ModuleContext, RepoContext
+from tools.tpuml_lint.findings import Finding
+
+# Names whose import is intentionally "unused" at module scope.
+_IMPORT_SIDE_EFFECT_OK = {"annotations"}
+
+
+def _imported_names(tree: ast.Module):
+    """(bound-name, lineno, col) for every import binding, in ANY scope —
+    a binding unused anywhere in the file is flagged regardless of where
+    the import statement sits."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                out.append((name, node.lineno, node.col_offset))
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out.append((a.asname or a.name, node.lineno, node.col_offset))
+    return out
+
+
+def _used_names(tree: ast.Module):
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+    # Names referenced in __all__ strings count as used (re-export files).
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    used.add(elt.value)
+    return used
+
+
+def check(module: ModuleContext, repo: RepoContext) -> List[Finding]:
+    tree = module.tree
+    findings: List[Finding] = []
+    rel = module.rel
+
+    if ast.get_docstring(tree) is None and module.path.name != "__init__.py":
+        findings.append(
+            Finding(rel, 1, 0, "missing-docstring", "missing module docstring")
+        )
+
+    used = _used_names(tree)
+    # The historic `# noqa` marker (any flavor) keeps suppressing unused
+    # imports — re-export modules carry `# noqa: F401` from the seed.
+    noqa_lines = {
+        i + 1 for i, line in enumerate(module.lines) if "# noqa" in line
+    }
+    for name, lineno, col in _imported_names(tree):
+        if name in _IMPORT_SIDE_EFFECT_OK or lineno in noqa_lines:
+            continue
+        if name not in used:
+            findings.append(
+                Finding(rel, lineno, col, "unused-import",
+                        f"unused import {name!r}")
+            )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(
+                Finding(rel, node.lineno, node.col_offset, "bare-except",
+                        "bare except")
+            )
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(
+                        Finding(rel, node.lineno, node.col_offset,
+                                "mutable-default",
+                                f"mutable default argument in {node.name}()")
+                    )
+        if isinstance(node, ast.ImportFrom) and any(
+            a.name == "*" for a in node.names
+        ):
+            findings.append(
+                Finding(rel, node.lineno, node.col_offset, "import-star",
+                        "import *")
+            )
+    return findings

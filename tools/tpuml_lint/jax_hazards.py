@@ -1,0 +1,279 @@
+"""Checker family (a): JAX retrace / host-sync hazards.
+
+The serving stack's perf story (PR 2/5) rests on two invariants a single
+stray line can erase: jitted code must never force a host sync, and
+program caches must be keyed by a small, stable set of static values.
+Three rules, all scoped to functions this checker can PROVE are traced —
+``@jax.jit``-decorated (directly or through ``partial``) or
+segment-registered (the ``*_segment`` programs the preemption-tolerant
+drivers re-enter):
+
+  - ``jax-host-sync``: ``print()`` (trace-time only — use
+    ``jax.debug.print``), ``numpy.asarray/array`` on traced values,
+    ``.item()`` / ``.tolist()``, and ``float()/int()/bool()`` applied to
+    a non-static parameter.
+  - ``jax-traced-branch``: Python ``if``/``while``/conditional
+    expressions whose test references a non-static parameter — shape/
+    dtype/ndim reads are static under tracing and exempt, as are
+    ``is``/``is not`` identity tests (static per trace).
+  - ``jax-static-loop-arg``: a callsite of a module-known jitted
+    function passing a loop variable for one of its STATIC arguments —
+    every distinct value compiles a fresh program.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.tpuml_lint.engine import ModuleContext, RepoContext
+from tools.tpuml_lint.findings import Finding
+
+#: Attribute reads that are static under tracing (safe in Python branches).
+SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "itemsize"}
+
+#: Method calls that force a device->host copy.
+SYNC_METHODS = {"item", "tolist"}
+
+#: Builtins that concretize a traced value.
+SYNC_BUILTINS = {"float", "int", "bool"}
+
+_NUMPY_ORIGINS = ("numpy",)
+
+
+def _is_jit_ref(node: ast.AST, module: ModuleContext) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return (
+            isinstance(node.value, ast.Name)
+            and module.import_bindings.get(node.value.id) == "jax"
+        )
+    if isinstance(node, ast.Name):
+        return module.binds_to(node.id, "jax.jit")
+    return False
+
+
+def _is_partial_ref(node: ast.AST, module: ModuleContext) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "partial":
+        return (
+            isinstance(node.value, ast.Name)
+            and module.import_bindings.get(node.value.id) == "functools"
+        )
+    if isinstance(node, ast.Name):
+        return module.binds_to(node.id, "functools.partial")
+    return False
+
+
+def _static_names_from_call(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                return {
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+    return set()
+
+
+def jit_static_names(fn: ast.FunctionDef, module: ModuleContext) -> Optional[Set[str]]:
+    """None if ``fn`` is not provably traced; else its static argnames."""
+    for dec in fn.decorator_list:
+        if _is_jit_ref(dec, module):
+            return set()
+        if isinstance(dec, ast.Call):
+            if _is_jit_ref(dec.func, module):
+                return _static_names_from_call(dec)
+            if (
+                _is_partial_ref(dec.func, module)
+                and dec.args
+                and _is_jit_ref(dec.args[0], module)
+            ):
+                return _static_names_from_call(dec)
+    if fn.name.endswith("_segment"):
+        # Segment-registered programs (the checkpointable solver bodies)
+        # are traced by contract even when the jit wrapper lives at the
+        # driver; parameters annotated with plain Python types are the
+        # static configuration.
+        static = set()
+        for a in fn.args.args + fn.args.kwonlyargs:
+            ann = a.annotation
+            if isinstance(ann, ast.Name) and ann.id in ("int", "str", "bool"):
+                static.add(a.arg)
+        return static
+    return None
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    return [
+        a.arg
+        for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+    ]
+
+
+def _traced_name_refs(node: ast.AST, traced: Set[str]) -> List[ast.Name]:
+    """Name nodes in ``node`` referring to traced params, skipping
+    static-safe attribute reads and identity comparisons."""
+    out: List[ast.Name] = []
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in SAFE_ATTRS:
+            return
+        if isinstance(n, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops
+        ):
+            return
+        if isinstance(n, ast.Name) and n.id in traced:
+            out.append(n)
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return out
+
+
+def _check_traced_region(fn: ast.FunctionDef, module: ModuleContext,
+                         static: Set[str]) -> List[Finding]:
+    rel = module.rel
+    findings: List[Finding] = []
+    traced = {p for p in _param_names(fn) if p not in static}
+
+    # Host syncs: anywhere in the traced region, nested helpers included.
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "print":
+            findings.append(Finding(
+                rel, node.lineno, node.col_offset, "jax-host-sync",
+                f"print() inside jitted {fn.name}() runs at trace time "
+                "only — use jax.debug.print",
+            ))
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr in ("asarray", "array")
+            and isinstance(f.value, ast.Name)
+            and module.import_bindings.get(f.value.id) in _NUMPY_ORIGINS
+        ):
+            findings.append(Finding(
+                rel, node.lineno, node.col_offset, "jax-host-sync",
+                f"numpy.{f.attr}() inside jitted {fn.name}() forces a "
+                "host round trip (use jnp)",
+            ))
+        elif isinstance(f, ast.Attribute) and f.attr in SYNC_METHODS:
+            findings.append(Finding(
+                rel, node.lineno, node.col_offset, "jax-host-sync",
+                f".{f.attr}() inside jitted {fn.name}() blocks on a "
+                "device->host copy",
+            ))
+        elif (
+            isinstance(f, ast.Name)
+            and f.id in SYNC_BUILTINS
+            and node.args
+            and _traced_name_refs(node.args[0], traced)
+        ):
+            findings.append(Finding(
+                rel, node.lineno, node.col_offset, "jax-host-sync",
+                f"{f.id}() concretizes traced value inside jitted "
+                f"{fn.name}()",
+            ))
+
+    # Python control flow on traced values: direct statements only (a
+    # nested def rebinds its own parameter namespace).
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(n, (ast.If, ast.While, ast.IfExp)):
+            refs = _traced_name_refs(n.test, traced)
+            if refs:
+                names = ", ".join(sorted({r.id for r in refs}))
+                kind = "while" if isinstance(n, ast.While) else "if"
+                findings.append(Finding(
+                    rel, n.test.lineno, n.test.col_offset, "jax-traced-branch",
+                    f"Python {kind} on traced value(s) {names} inside "
+                    f"jitted {fn.name}() — use lax.cond/lax.while_loop "
+                    "or mark the argument static",
+                ))
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+    return findings
+
+
+def _static_positions(fn: ast.FunctionDef, static: Set[str]) -> Dict[int, str]:
+    out: Dict[int, str] = {}
+    params = fn.args.posonlyargs + fn.args.args
+    for i, a in enumerate(params):
+        if a.arg in static:
+            out[i] = a.arg
+    return out
+
+
+def _check_retrace_bait(module: ModuleContext,
+                        jitted: Dict[str, Tuple[ast.FunctionDef, Set[str]]]
+                        ) -> List[Finding]:
+    findings: List[Finding] = []
+    rel = module.rel
+
+    def loop_targets(target: ast.AST) -> Sequence[str]:
+        if isinstance(target, ast.Name):
+            return (target.id,)
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for e in target.elts:
+                out.extend(loop_targets(e))
+            return out
+        return ()
+
+    def visit(node: ast.AST, loops: Set[str]) -> None:
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            loops = loops | set(loop_targets(node.target))
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            entry = jitted.get(node.func.id)
+            if entry is not None:
+                fn, static = entry
+                positions = _static_positions(fn, static)
+                suspects: List[Tuple[str, ast.AST]] = []
+                for i, arg in enumerate(node.args):
+                    if i in positions:
+                        suspects.append((positions[i], arg))
+                for kw in node.keywords:
+                    if kw.arg in static:
+                        suspects.append((kw.arg, kw.value))
+                for pname, expr in suspects:
+                    hit = [
+                        n.id
+                        for n in ast.walk(expr)
+                        if isinstance(n, ast.Name) and n.id in loops
+                    ]
+                    if hit:
+                        findings.append(Finding(
+                            rel, node.lineno, node.col_offset,
+                            "jax-static-loop-arg",
+                            f"static argument {pname!r} of jitted "
+                            f"{node.func.id}() varies with loop "
+                            f"variable(s) {', '.join(sorted(set(hit)))} — "
+                            "every distinct value compiles a new program",
+                        ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, loops)
+
+    visit(module.tree, set())
+    return findings
+
+
+def check(module: ModuleContext, repo: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    jitted: Dict[str, Tuple[ast.FunctionDef, Set[str]]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.FunctionDef):
+            static = jit_static_names(node, module)
+            if static is not None:
+                jitted[node.name] = (node, static)
+                findings.extend(_check_traced_region(node, module, static))
+    findings.extend(_check_retrace_bait(module, jitted))
+    return findings

@@ -1,0 +1,224 @@
+"""Checker family (b): guarded-by lock discipline.
+
+Convention: the line that first assigns a shared attribute carries a
+trailing ``# guarded-by: <lock>`` comment::
+
+    self._versions = {}      # guarded-by: _lock      (class attribute)
+    _PROGRAMS = OrderedDict() # guarded-by: _LOCK     (module global)
+
+The checker then enforces what the comment promises, lexically: every
+subsequent read or write of the guarded attribute in the owning class
+(inheritance within the module included) — or, for a module global,
+inside any function of the module — must sit inside a ``with
+self.<lock>:`` / ``with <lock>:`` block. ``__init__``/``__new__`` are
+exempt (the object is not shared during construction), as is module
+top-level code (imports run single-threaded by convention).
+
+A helper that is only ever CALLED with the lock held still gets flagged
+— that is deliberate: the convention is lexical so it can be machine-
+checked; restructure the helper to take values as arguments, or
+document the exception with ``# tpuml: noqa[lock-guarded]``.
+
+``lock-unknown`` fires when an annotation names a lock the owning scope
+never defines, so a typo'd annotation cannot silently check nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tpuml_lint.engine import ModuleContext, RepoContext
+from tools.tpuml_lint.findings import Finding
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def _annotation_on(module: ModuleContext, lineno: int) -> Optional[str]:
+    if 1 <= lineno <= len(module.lines):
+        m = _GUARDED_RE.search(module.lines[lineno - 1])
+        if m:
+            return m.group(1)
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
+        self.guarded: Dict[str, Tuple[str, int]] = {}  # attr -> (lock, line)
+        self.assigned_attrs: Set[str] = set()
+
+
+def _scan_class(module: ModuleContext, node: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(node)
+    for sub in ast.walk(node):
+        targets: List[ast.AST] = []
+        if isinstance(sub, ast.Assign):
+            targets = list(sub.targets)
+        elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+            targets = [sub.target]
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is None:
+                continue
+            info.assigned_attrs.add(attr)
+            lock = _annotation_on(module, sub.lineno)
+            if lock is not None:
+                info.guarded[attr] = (lock, sub.lineno)
+    return info
+
+
+def _effective(info: _ClassInfo, classes: Dict[str, _ClassInfo],
+               seen: Optional[Set[str]] = None
+               ) -> Tuple[Dict[str, Tuple[str, int]], Set[str]]:
+    """(guarded map, attrs-assigned) including same-module base classes."""
+    seen = seen or set()
+    guarded = dict(info.guarded)
+    assigned = set(info.assigned_attrs)
+    for base in info.bases:
+        b = classes.get(base)
+        if b is None or base in seen:
+            continue
+        g, a = _effective(b, classes, seen | {info.node.name})
+        for attr, v in g.items():
+            guarded.setdefault(attr, v)
+        assigned |= a
+    return guarded, assigned
+
+
+def _check_method(module: ModuleContext, cls: str, fn: ast.FunctionDef,
+                  guarded: Dict[str, Tuple[str, int]]) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    inner.add(attr)
+            for child in node.body:
+                visit(child, inner)
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in guarded:
+            lock = guarded[attr][0]
+            if lock not in held:
+                ctx = getattr(node, "ctx", None)
+                verb = "written" if isinstance(ctx, (ast.Store, ast.Del)) else "read"
+                findings.append(Finding(
+                    module.rel, node.lineno, node.col_offset, "lock-guarded",
+                    f"self.{attr} is {verb} in {cls}.{fn.name}() outside "
+                    f"'with self.{lock}:' (declared guarded-by {lock})",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, set())
+    return findings
+
+
+def _check_module_globals(module: ModuleContext,
+                          guarded: Dict[str, Tuple[str, int]]) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, held: Set[str], in_fn: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in node.body:
+                visit(child, held, True)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Name):
+                    inner.add(item.context_expr.id)
+            for child in node.body:
+                visit(child, inner, in_fn)
+            return
+        if in_fn and isinstance(node, ast.Name) and node.id in guarded:
+            lock = guarded[node.id][0]
+            if lock not in held:
+                verb = (
+                    "written"
+                    if isinstance(node.ctx, (ast.Store, ast.Del))
+                    else "read"
+                )
+                findings.append(Finding(
+                    module.rel, node.lineno, node.col_offset, "lock-guarded",
+                    f"module global {node.id} is {verb} outside "
+                    f"'with {lock}:' (declared guarded-by {lock})",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, in_fn)
+
+    for stmt in module.tree.body:
+        visit(stmt, set(), False)
+    return findings
+
+
+def check(module: ModuleContext, repo: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # Module globals: annotated top-level assignments.
+    module_guarded: Dict[str, Tuple[str, int]] = {}
+    module_names: Set[str] = set()
+    for stmt in module.tree.body:
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign)
+            else [stmt.target] if isinstance(stmt, ast.AnnAssign)
+            else []
+        )
+        for t in targets:
+            if isinstance(t, ast.Name):
+                module_names.add(t.id)
+                lock = _annotation_on(module, stmt.lineno)
+                if lock is not None:
+                    module_guarded[t.id] = (lock, stmt.lineno)
+    for name, (lock, line) in module_guarded.items():
+        if lock not in module_names:
+            findings.append(Finding(
+                module.rel, line, 0, "lock-unknown",
+                f"guarded-by names {lock!r}, which this module never "
+                "assigns at top level",
+            ))
+    if module_guarded:
+        findings.extend(_check_module_globals(module, module_guarded))
+
+    # Classes (inheritance resolved within the module).
+    classes: Dict[str, _ClassInfo] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            classes[node.name] = _scan_class(module, node)
+    for name, info in classes.items():
+        guarded, assigned = _effective(info, classes)
+        if not guarded:
+            continue
+        for attr, (lock, line) in sorted(guarded.items()):
+            if attr in info.guarded and lock not in assigned:
+                findings.append(Finding(
+                    module.rel, line, 0, "lock-unknown",
+                    f"guarded-by names self.{lock}, which {name} (and its "
+                    "bases here) never assigns",
+                ))
+        for stmt in info.node.body:
+            if (
+                isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and stmt.name not in ("__init__", "__new__")
+            ):
+                findings.extend(
+                    _check_method(module, name, stmt, guarded)
+                )
+    return findings

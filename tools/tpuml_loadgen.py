@@ -112,7 +112,6 @@ def main() -> None:
 
     import numpy as np
 
-    from spark_rapids_ml_tpu.observability.metrics import default_registry
     from spark_rapids_ml_tpu.serving import (
         DeadlineExceeded,
         Overloaded,
