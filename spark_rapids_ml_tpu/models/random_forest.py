@@ -435,12 +435,16 @@ class RandomForestClassifier(_RandomForestParams, Estimator, MLReadable):
         x, y = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         declared = self.getNumClasses()
         if declared:
-            # Trusted label-metadata path (see setNumClasses): no scan.
-            y_int = (
-                y.ravel().astype(jnp.int32)
-                if is_device_array(y)
-                else np.asarray(y).ravel().astype(np.int64)
-            )
+            if is_device_array(y):
+                # Trusted label-metadata path (see setNumClasses): no
+                # readback — inferring min/max is the sync the hint
+                # exists to avoid.
+                y_int = y.ravel().astype(jnp.int32)
+            else:
+                # Host labels cost nothing to validate, and skipping it
+                # let a negative label wrap silently into the LAST class
+                # column of the one-hot scatter below (ADVICE r5).
+                y_int, _ = validate_int_labels(y)
             n_classes = declared
         else:
             y_int, n_classes = validate_int_labels(y)

@@ -38,12 +38,20 @@ from spark_rapids_ml_tpu.observability.metrics import (  # noqa: F401
 )
 from spark_rapids_ml_tpu.observability.events import (  # noqa: F401
     EVENT_LOG_ENV,
+    TELEMETRY_DIR_ENV,
+    TraceContext,
     configure,
     current_run,
     current_run_id,
+    current_trace,
+    current_trace_context,
     emit,
     enabled,
+    extract_env,
+    flush_telemetry,
+    inject_env,
     run_scope,
+    trace_scope,
     validate_record,
 )
 from spark_rapids_ml_tpu.observability.report import (  # noqa: F401

@@ -9,7 +9,8 @@ Every record is one JSON object per line with a common envelope::
 
     {"event": "<type>", "ts": <wall epoch>, "mono": <monotonic>,
      "pid": <os pid>, "process": <jax process index>,
-     "run_id": "<fit-...|serve-...|null>", ...type fields...}
+     "run_id": "<fit-...|serve-...|null>", "trace": "<trace id|null>",
+     ...type fields...}
 
 ``run_id`` comes from the ambient :func:`run_scope` (a contextvar): the
 estimator base class opens one per fit, the serving entries open one per
@@ -19,15 +20,34 @@ spans, retry attempts, fault firings, checkpoint writes (including those
 from the async writer thread, which receives a copied context), serving
 cache hits and barrier resubmits all join on one id.
 
+``trace`` is the Dapper-style DISTRIBUTED identity: a
+:class:`TraceContext` (trace id + the span remote children parent to)
+propagated across process boundaries via an env-var carrier
+(:func:`inject_env` on the launcher, :func:`extract_env` — or simply
+environment inheritance — on the member) and across in-process thread
+hops via :func:`current_trace_context` + :func:`trace_scope`. A gang
+fit or a served request is ONE trace id in every member's records, and
+span parent ids are globally unique, so per-process shards reassemble
+into one tree (``observability/trace.py`` / ``tools/tpuml_trace.py``).
+
+``TPUML_TELEMETRY_DIR=<dir>`` turns on PER-PROCESS SHARDING: each
+process appends to its own ``events-<pid>.jsonl`` under the dir (taking
+precedence over ``TPUML_EVENT_LOG`` — N processes interleaving one file
+is exactly what shards exist to avoid) and writes an at-exit
+``metrics-<pid>.json`` snapshot plus a ``manifest-<pid>.json`` (pid,
+process index, trace roots, shard names). :func:`flush_telemetry` writes
+the manifest early for long-lived processes and tests.
+
 :data:`SCHEMA` names every record type and its required fields;
 :func:`validate_record` is the one validator the tests AND the
-``tools/tpuml_metrics.py`` CLI share.
+``tools/tpuml_metrics.py`` / ``tools/tpuml_trace.py`` CLIs share.
 """
 
 from __future__ import annotations
 
 import atexit
 import contextlib
+import dataclasses
 import itertools
 import json
 import os
@@ -42,6 +62,9 @@ import contextvars
 from spark_rapids_ml_tpu.utils.envknobs import EnvKnobError, env_int, env_str
 
 EVENT_LOG_ENV = "TPUML_EVENT_LOG"
+TELEMETRY_DIR_ENV = "TPUML_TELEMETRY_DIR"
+TRACE_ID_ENV = "TPUML_TRACE_ID"
+TRACE_PARENT_ENV = "TPUML_TRACE_PARENT"
 
 #: Spans kept per run context for report building (reports read a window
 #: of this deque; an unbounded long-lived scope must not grow forever).
@@ -50,7 +73,9 @@ MAX_RUN_SPANS = 16384
 # --- record schema -----------------------------------------------------
 
 #: Fields every record carries.
-BASE_FIELDS = frozenset({"event", "ts", "mono", "pid", "process", "run_id"})
+BASE_FIELDS = frozenset(
+    {"event", "ts", "mono", "pid", "process", "run_id", "trace"}
+)
 
 #: Required extra fields per record type — the single source of truth
 #: for schema validation (tests + CLI).
@@ -72,6 +97,7 @@ SCHEMA: Dict[str, frozenset] = {
     "profile": frozenset({"action", "dir"}),
     "distributed": frozenset({"action"}),
     "persistence": frozenset({"action", "path"}),
+    "telemetry": frozenset({"action", "path"}),
 }
 
 
@@ -94,6 +120,116 @@ def validate_record(rec: Any) -> List[str]:
         if f in rec and not isinstance(rec[f], (int, float)):
             problems.append(f"{etype}: {f} must be a number")
     return problems
+
+
+# --- trace context -----------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Dapper-style trace coordinates carried across process and thread
+    boundaries alongside ``run_id``.
+
+    ``trace_id`` names the whole distributed episode; ``span_id`` is the
+    span that REMOTE (other-process / other-thread) children parent to —
+    the caller's innermost open span at hand-off time; ``parent_span_id``
+    is that span's own parent, carried for introspection only."""
+
+    trace_id: str
+    span_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+_TRACE: "contextvars.ContextVar[Optional[TraceContext]]" = contextvars.ContextVar(
+    "tpuml_trace_ctx", default=None
+)
+#: Trace propagated INTO this process via the env carrier — the ambient
+#: fallback when no in-process scope is active, so a spawned gang member
+#: joins the launcher's trace with zero member-side code.
+_env_trace: Optional[TraceContext] = None
+_trace_roots: set = set()  # guarded-by: _sink_lock
+
+
+def _note_trace_root(trace_id: str) -> None:
+    with _sink_lock:
+        _trace_roots.add(trace_id)
+
+
+def begin_trace() -> TraceContext:
+    """A fresh root :class:`TraceContext`, recorded as one of THIS
+    process's trace roots (the shard manifest lists them)."""
+    tc = TraceContext(new_trace_id())
+    _note_trace_root(tc.trace_id)
+    return tc
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The ambient trace: an in-process :func:`trace_scope` if one is
+    active, else the trace injected via the env carrier, else None."""
+    tc = _TRACE.get()
+    return tc if tc is not None else _env_trace
+
+
+def current_trace_context() -> Optional[TraceContext]:
+    """Snapshot for a cross-thread/cross-process hop: the ambient trace
+    id with the caller's innermost OPEN span as the remote children's
+    parent — hand it to the receiving thread's :func:`trace_scope`."""
+    tc = current_trace()
+    if tc is None:
+        return None
+    from spark_rapids_ml_tpu.utils.tracing import current_span_id
+
+    sid = current_span_id()
+    if sid is None:
+        return tc
+    return TraceContext(tc.trace_id, sid, tc.span_id)
+
+
+@contextlib.contextmanager
+def trace_scope(ctx: Optional[TraceContext]):
+    """Make ``ctx`` the ambient trace for the block (None = no-op): the
+    in-memory carrier for dispatcher threads, async writers, and any
+    other hop that outlives the submitting frame."""
+    if ctx is None:
+        yield None
+        return
+    token = _TRACE.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _TRACE.reset(token)
+
+
+def inject_env(env: Optional[dict] = None) -> dict:
+    """Write the current trace coordinates into an env-var carrier
+    (``TPUML_TRACE_ID`` / ``TPUML_TRACE_PARENT``) for a process about to
+    be spawned — or a task closure about to ship to an executor. With no
+    ambient trace a fresh one is begun, so one gang launch is one trace.
+    Mutates and returns ``env`` (a new dict when omitted)."""
+    tc = current_trace_context()
+    if tc is None:
+        tc = begin_trace()
+    carrier = env if env is not None else {}
+    carrier[TRACE_ID_ENV] = tc.trace_id
+    if tc.span_id:
+        carrier[TRACE_PARENT_ENV] = tc.span_id
+    else:
+        carrier.pop(TRACE_PARENT_ENV, None)
+    return carrier
+
+
+def extract_env() -> Optional[TraceContext]:
+    """The member side of :func:`inject_env`: the TraceContext this
+    process's environment carries, or None. :func:`configure` calls this
+    once and keeps the result as the ambient fallback."""
+    trace_id = env_str(TRACE_ID_ENV)
+    if not trace_id:
+        return None
+    return TraceContext(trace_id, env_str(TRACE_PARENT_ENV))
 
 
 # --- run scopes --------------------------------------------------------
@@ -152,13 +288,18 @@ def run_scope(kind: str, label: str = ""):
     """Enter (or join) a run: a fresh ``run_id`` when none is active, the
     AMBIENT one otherwise — a transform inside a fit, or a fit+transform
     pair inside a caller's job scope, shares the outer id so the whole
-    episode joins in the event log."""
+    episode joins in the event log. A fresh run with no ambient trace
+    (in-process or env-injected) also roots a fresh trace, so every run
+    is part of exactly one trace."""
     cur = _CTX.get()
     if cur is not None:
         yield cur
         return
     ctx = RunContext(new_run_id(kind), kind, label)
     token = _CTX.set(ctx)
+    t_token = None
+    if current_trace() is None:
+        t_token = _TRACE.set(begin_trace())
     emit("run", action="start", kind=kind, label=label)
     try:
         yield ctx
@@ -166,6 +307,8 @@ def run_scope(kind: str, label: str = ""):
         _CTX.reset(token)
         emit("run", action="end", kind=kind, label=label,
              run_id=ctx.run_id)
+        if t_token is not None:
+            _TRACE.reset(t_token)
 
 
 # --- the sink ----------------------------------------------------------
@@ -176,6 +319,8 @@ _sink = None  # None = disabled: emit() is a single attribute check
 _sink_owned = False  # guarded-by: _sink_lock
 _sink_lock = threading.Lock()
 _n_emitted = 0  # guarded-by: _sink_lock
+#: Active telemetry-dir sharding: {"dir": <dir>, "shard": <shard path>}.
+_telemetry: Optional[dict] = None  # guarded-by: _sink_lock
 _process_index: Optional[int] = None
 
 
@@ -198,19 +343,40 @@ def _resolve_process_index() -> int:
     return 0 if idx is None else idx
 
 
+def telemetry_dir() -> Optional[str]:
+    """The per-process telemetry shard root, when sharding is on."""
+    return env_str(TELEMETRY_DIR_ENV)
+
+
 def configure(path: Optional[str] = None) -> Optional[str]:
-    """(Re)wire the sink: explicit ``path``, else ``TPUML_EVENT_LOG``,
-    else disabled. ``"stderr"`` streams to stderr; anything else appends
-    to that file. Returns the active destination (None = disabled)."""
-    global _sink, _sink_owned
+    """(Re)wire the sink: explicit ``path``, else a per-process shard
+    under ``TPUML_TELEMETRY_DIR``, else ``TPUML_EVENT_LOG``, else
+    disabled. The telemetry dir outranks the single-file knob because N
+    gang members interleaving one file is exactly what shards exist to
+    avoid. ``"stderr"`` streams to stderr; anything else appends to that
+    file. Also re-reads the env trace carrier, so a freshly spawned
+    member picks up its launcher's trace. Returns the active destination
+    (None = disabled)."""
+    global _sink, _sink_owned, _telemetry, _env_trace
+    _env_trace = extract_env()
+    shard_opened = None
     with _sink_lock:
         if _sink is not None and _sink_owned:
             try:
                 _sink.close()
             except OSError:  # pragma: no cover - best-effort close
                 pass
-        _sink, _sink_owned = None, False
-        dest = path if path is not None else env_str(EVENT_LOG_ENV)
+        _sink, _sink_owned, _telemetry = None, False, None
+        dest = path
+        if dest is None:
+            tdir = telemetry_dir()
+            if tdir:
+                dest = os.path.join(
+                    os.path.abspath(tdir), f"events-{os.getpid()}.jsonl"
+                )
+                _telemetry = {"dir": os.path.abspath(tdir), "shard": dest}
+            else:
+                dest = env_str(EVENT_LOG_ENV)
         if not dest:
             return None
         if dest == "stderr":
@@ -220,7 +386,10 @@ def configure(path: Optional[str] = None) -> Optional[str]:
             os.makedirs(parent, exist_ok=True)
             _sink = open(dest, "a", buffering=1)
             _sink_owned = True
-        return dest
+        shard_opened = dest if _telemetry is not None else None
+    if shard_opened is not None:
+        emit("telemetry", action="shard_open", path=shard_opened)
+    return dest
 
 
 def enabled() -> bool:
@@ -241,6 +410,7 @@ def emit(etype: str, **fields) -> None:
         return
     global _n_emitted
     ctx = _CTX.get()
+    tc = current_trace()
     rec = {
         "event": etype,
         "ts": time.time(),
@@ -248,6 +418,7 @@ def emit(etype: str, **fields) -> None:
         "pid": os.getpid(),
         "process": _resolve_process_index(),
         "run_id": ctx.run_id if ctx is not None else None,
+        "trace": tc.trace_id if tc is not None else None,
     }
     rec.update(fields)
     line = json.dumps(rec, default=str)
@@ -262,6 +433,48 @@ def emit(etype: str, **fields) -> None:
         _n_emitted += 1
 
 
+def flush_telemetry() -> Optional[str]:
+    """Write this process's telemetry manifest (pid, process index, trace
+    roots, shard names) plus a metrics snapshot under the active
+    telemetry dir. atexit does this automatically; long-lived launchers
+    and tests call it to publish shards before the process ends. Returns
+    the manifest path (None when sharding is off)."""
+    with _sink_lock:
+        tele = dict(_telemetry) if _telemetry is not None else None
+        emitted = _n_emitted
+        roots = sorted(_trace_roots)
+    if tele is None:
+        return None
+    from spark_rapids_ml_tpu.observability.metrics import dump_snapshot
+
+    pid = os.getpid()
+    metrics_path = os.path.join(tele["dir"], f"metrics-{pid}.json")
+    try:
+        dump_snapshot(metrics_path)
+    except OSError:  # pragma: no cover - best-effort snapshot
+        metrics_path = None
+    manifest = {
+        "pid": pid,
+        "process": _resolve_process_index(),
+        "shard": os.path.basename(tele["shard"]),
+        "metrics": os.path.basename(metrics_path) if metrics_path else None,
+        "trace_roots": roots,
+        "emitted": emitted,
+        # One (wall, mono) sample at a single instant — the merger's
+        # cross-process clock-alignment anchor.
+        "ts": time.time(),
+        "mono": time.monotonic(),
+    }
+    path = os.path.join(tele["dir"], f"manifest-{pid}.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(manifest, f, indent=2)
+            f.write("\n")
+    except OSError:  # pragma: no cover - best-effort manifest
+        return None
+    return path
+
+
 def _close_at_exit() -> None:  # pragma: no cover - interpreter teardown
     global _sink, _sink_owned
     with _sink_lock:
@@ -273,5 +486,15 @@ def _close_at_exit() -> None:  # pragma: no cover - interpreter teardown
         _sink, _sink_owned = None, False
 
 
+def _flush_at_exit() -> None:  # pragma: no cover - interpreter teardown
+    try:
+        flush_telemetry()
+    except Exception:
+        pass
+
+
 atexit.register(_close_at_exit)
+# LIFO: the manifest flush (registered later) runs BEFORE the sink close,
+# so the recorded emit count is final.
+atexit.register(_flush_at_exit)
 configure()

@@ -20,6 +20,7 @@ process per interval makes the difference observable BEFORE then:
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import threading
 import time
 from typing import Optional
@@ -57,6 +58,7 @@ class GangHeartbeat:
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._registered = False
 
     def age_seconds(self) -> float:
         return time.monotonic() - self._last
@@ -78,14 +80,20 @@ class GangHeartbeat:
         gauge(
             AGE_GAUGE, "seconds since this process's last gang heartbeat"
         ).set_function(self.age_seconds, process=str(self.process_id))
+        self._registered = True
         self.beat()  # beat 1 lands immediately: liveness from t=0
 
         def _loop():
             while not self._stop.wait(self.interval):
                 self.beat()
 
+        # The beat thread runs under a COPY of the caller's context, so
+        # every beat carries the member's run_id and trace id — not just
+        # the first one (which lands from the calling thread above).
+        ctx = contextvars.copy_context()
         self._thread = threading.Thread(
-            target=_loop, name=f"tpuml-heartbeat-{self.process_id}", daemon=True
+            target=ctx.run, args=(_loop,),
+            name=f"tpuml-heartbeat-{self.process_id}", daemon=True,
         )
         self._thread.start()
         return self
@@ -95,6 +103,11 @@ class GangHeartbeat:
         if self._thread is not None:
             self._thread.join(timeout=self.interval + 1.0)
             self._thread = None
+        if self._registered:
+            # A finished member must not keep reporting an ever-growing
+            # age into merged gang snapshots: retire the series.
+            gauge(AGE_GAUGE).remove(process=str(self.process_id))
+            self._registered = False
 
 
 @contextlib.contextmanager

@@ -132,6 +132,15 @@ class Gauge(_Metric):
             self._series.pop(key, None)
             self._functions[key] = fn
 
+    def remove(self, **labels) -> None:
+        """Drop one series (and any callable behind it) — how a finished
+        gang member retires its heartbeat-age gauge instead of reporting
+        an ever-growing age into every later snapshot."""
+        key = _label_key(labels)
+        with self._lock:
+            self._series.pop(key, None)
+            self._functions.pop(key, None)
+
     def value(self, **labels) -> Union[int, float]:
         key = _label_key(labels)
         with self._lock:

@@ -293,3 +293,57 @@ def serving_report() -> dict:
         out["request_latency_ms"] = _batcher._latency_hist().value()
         out["batch_fill"] = _batcher._fill_hist().value()
     return out
+
+
+# --- the gang-wide report ----------------------------------------------
+
+
+def gang_report(telemetry_dir: Optional[str] = None) -> dict:
+    """The whole-gang section: per-member telemetry shards under
+    ``telemetry_dir`` (default: the active ``TPUML_TELEMETRY_DIR``)
+    merged into one view — summed counters, merged histograms, max
+    gauges — with the per-member breakdown kept alongside, plus one
+    entry per assembled trace (span count, member processes, critical
+    path). This is what a driver prints after a barrier gang fit to see
+    all N members at once."""
+    from spark_rapids_ml_tpu.observability.events import telemetry_dir as _tdir
+    from spark_rapids_ml_tpu.observability.trace import assemble
+
+    tdir = telemetry_dir if telemetry_dir is not None else _tdir()
+    if not tdir:
+        raise ValueError(
+            "gang_report needs a telemetry dir (pass one or set "
+            "TPUML_TELEMETRY_DIR)"
+        )
+    merged = assemble(tdir)
+    members = []
+    by_pid = {m.get("pid"): m for m in merged["manifests"]}
+    for cell in merged["metrics"]["members"]:
+        snap = cell["snapshot"]
+        pid = None
+        # metrics-<pid>.json — recover the member identity from the name.
+        stem = cell["file"].rsplit(".", 1)[0]
+        if "-" in stem:
+            try:
+                pid = int(stem.rsplit("-", 1)[1])
+            except ValueError:
+                pid = None
+        manifest = by_pid.get(pid, {})
+        members.append(
+            {
+                "pid": pid,
+                "process": manifest.get("process"),
+                "trace_roots": manifest.get("trace_roots", []),
+                "emitted": manifest.get("emitted"),
+                "counters": snap.get("counters", {}),
+                "gauges": snap.get("gauges", {}),
+            }
+        )
+    return {
+        "dir": tdir,
+        "members": members,
+        "merged": merged["metrics"]["merged"],
+        "traces": merged["traces"],
+        "problems": merged["problems"] + merged["orphan_problems"],
+        "warnings": merged["warnings"],
+    }

@@ -159,7 +159,13 @@ def initialize(
             heartbeat_timeout_seconds=heartbeat_timeout_seconds,
         )
 
-    default_policy().run(_bring_up, name="distributed.initialize")
+    from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+    # One named span around the whole bring-up (the retry policy nests
+    # its per-attempt ranges inside), so a merged gang trace shows each
+    # member's coordination-service connect on the critical path.
+    with TraceRange("distributed bring-up", TraceColor.BLUE):
+        default_policy().run(_bring_up, name="distributed.initialize")
     _initialized = True
     _init_record = {
         "coordinator_address": coordinator_address,
@@ -182,6 +188,7 @@ def initialize(
         action="initialize",
         coordinator=coordinator_address,
         num_processes=num_processes,
+        process_id=process_id,
     )
 
 

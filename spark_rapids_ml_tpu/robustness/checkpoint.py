@@ -57,7 +57,11 @@ from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
-from spark_rapids_ml_tpu.observability.events import emit
+from spark_rapids_ml_tpu.observability.events import (
+    current_trace_context,
+    emit,
+    trace_scope,
+)
 from spark_rapids_ml_tpu.robustness.faults import InjectedFault, fault_point
 from spark_rapids_ml_tpu.utils.envknobs import env_int, env_str
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
@@ -279,13 +283,21 @@ class FitCheckpointer:
         The writer runs under a COPY of the caller's context, so the
         ambient run scope rides along: the write's span and its
         ``checkpoint`` event carry the fit's ``run_id`` even though they
-        land from another thread."""
+        land from another thread. The trace hand-off is snapshotted
+        EXPLICITLY (events.current_trace_context): the copied contextvar
+        only knows the trace root, while the snapshot carries the solver
+        span open at save time, so the write span parents to the segment
+        that produced the state."""
         leaves, _ = _tree_flatten(state)
         self.wait()
+        tc = current_trace_context()
         ctx = contextvars.copy_context()
-        t = threading.Thread(
-            target=ctx.run, args=(self._write, step, leaves), daemon=True
-        )
+
+        def _run():
+            with trace_scope(tc):
+                self._write(step, leaves)
+
+        t = threading.Thread(target=ctx.run, args=(_run,), daemon=True)
         t.start()
         self._pending = t
 

@@ -114,6 +114,10 @@ class Request:
     enqueue_mono: float = 0.0
     deadline: Optional[float] = None  # absolute monotonic seconds
     timeout_ms: float = 0.0
+    # In-memory trace carrier (events.TraceContext): the submitter's
+    # trace rides to the dispatcher thread, so enqueue, dispatch and
+    # completion all join one distributed trace per request.
+    trace: Optional[Any] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and (now or time.monotonic()) > self.deadline

@@ -30,7 +30,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.observability.events import emit
+from spark_rapids_ml_tpu.observability.events import emit, trace_scope
 from spark_rapids_ml_tpu.observability.metrics import histogram
 from spark_rapids_ml_tpu.serving.admission import (
     AdmissionQueue,
@@ -179,10 +179,12 @@ class MicroBatcher:
         self._queue.release(req)
         waited_ms = (now - req.enqueue_mono) * 1e3
         bump_counter("serving.deadline.expired")
-        emit(
-            "serving", action="timeout", model=req.key[0], version=req.key[1],
-            rows=req.n, run_id=req.run_id, waited_ms=round(waited_ms, 3),
-        )
+        with trace_scope(req.trace):
+            emit(
+                "serving", action="timeout", model=req.key[0],
+                version=req.key[1], rows=req.n, run_id=req.run_id,
+                waited_ms=round(waited_ms, 3),
+            )
         if req.future.set_running_or_notify_cancel():
             req.future.set_exception(
                 DeadlineExceeded(req.key[0], waited_ms, req.timeout_ms)
@@ -205,22 +207,31 @@ class MicroBatcher:
         bump_counter("serving.batch.dispatch")
         bump_counter("serving.batch.rows_total", total)
         _fill_hist().observe(total / self.max_batch)
-        emit(
-            "serving", action="dispatch", model=name, version=version,
-            rows=total, requests=len(batch),
-            run_ids=[r.run_id for r in batch],
-        )
+        # Trace attribution on the dispatcher thread: the batch-level
+        # dispatch event and the one shared execution span land in the
+        # FIRST request's trace (a coalesced batch has one execution but
+        # N traces); per-request events join each request's own trace via
+        # its carrier, so every trace tree stays orphan-free.
+        with trace_scope(batch[0].trace):
+            emit(
+                "serving", action="dispatch", model=name, version=version,
+                rows=total, requests=len(batch),
+                run_ids=[r.run_id for r in batch],
+            )
         try:
-            with TraceRange(f"serve batch {name}", TraceColor.GREEN):
-                outs = execute_with_fallback(sig, x)
+            with trace_scope(batch[0].trace):
+                with TraceRange(f"serve batch {name}", TraceColor.GREEN):
+                    outs = execute_with_fallback(sig, x)
         except BaseException as exc:  # noqa: BLE001 — fault isolation per batch
             for req in batch:
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_exception(exc)
-                emit(
-                    "serving", action="error", model=name, version=version,
-                    run_id=req.run_id, exc=type(exc).__name__,
-                )
+                with trace_scope(req.trace):
+                    emit(
+                        "serving", action="error", model=name,
+                        version=version, run_id=req.run_id,
+                        exc=type(exc).__name__,
+                    )
             bump_counter("serving.batch.errors")
         else:
             now = time.monotonic()
@@ -238,11 +249,12 @@ class MicroBatcher:
                 _latency_hist().observe(latency_ms)
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_result(sliced)
-                emit(
-                    "serving", action="complete", model=name, version=version,
-                    rows=req.n, run_id=req.run_id,
-                    latency_ms=round(latency_ms, 3),
-                )
+                with trace_scope(req.trace):
+                    emit(
+                        "serving", action="complete", model=name,
+                        version=version, rows=req.n, run_id=req.run_id,
+                        latency_ms=round(latency_ms, 3),
+                    )
         finally:
             for req in batch:
                 self._queue.release(req)

@@ -26,7 +26,13 @@ from typing import Any, Iterable, List, Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.core.serving import _compute_dtype, bucket_rows
-from spark_rapids_ml_tpu.observability.events import emit, new_run_id
+from spark_rapids_ml_tpu.observability.events import (
+    begin_trace,
+    current_trace_context,
+    emit,
+    new_run_id,
+    trace_scope,
+)
 from spark_rapids_ml_tpu.observability.metrics import gauge
 from spark_rapids_ml_tpu.serving.admission import (
     AdmissionQueue,
@@ -214,6 +220,13 @@ class ServingRuntime:
             sig.output_spec(bucket, dtype)
         )
         timeout_ms = float(timeout) * 1e3 if timeout is not None else 0.0
+        # The submit→dispatcher-thread hop carries the caller's trace (or
+        # roots a fresh one per request) via the Request itself — the
+        # in-memory trace carrier — so the dispatch and completion events
+        # emitted from the batcher thread join this request's trace.
+        tc = current_trace_context()
+        if tc is None:
+            tc = begin_trace()
         req = Request(
             key=(mv.name, mv.version, int(xh.shape[1]), str(dtype)),
             x=xh,
@@ -223,12 +236,14 @@ class ServingRuntime:
             cost=cost,
             deadline=(_time.monotonic() + timeout) if timeout is not None else None,
             timeout_ms=timeout_ms,
+            trace=tc,
         )
-        emit(
-            "serving", action="enqueue", model=mv.name, version=mv.version,
-            rows=n, run_id=req.run_id, cost_bytes=cost,
-        )
-        self._queue.submit(req)  # raises Overloaded on shed
+        with trace_scope(tc):
+            emit(
+                "serving", action="enqueue", model=mv.name, version=mv.version,
+                rows=n, run_id=req.run_id, cost_bytes=cost,
+            )
+            self._queue.submit(req)  # raises Overloaded on shed
         bump_counter("serving.requests")
         bump_counter("serving.request.rows", n)
         return req.future

@@ -89,64 +89,121 @@ def barrier_gang_run(
     Each gang member declares the ``barrier.attempt`` fault site
     (robustness.faults) right after the launch barrier, so chaos tests
     can kill attempt 0 and assert the relaunch refits bit-identically.
+
+    The whole stage runs as ONE distributed trace: the driver opens (or
+    joins) a trace under a ``barrier gang`` span, and a carrier dict —
+    trace coordinates (``TPUML_TRACE_ID``/``TPUML_TRACE_PARENT``), the
+    telemetry shard dir (``TPUML_TELEMETRY_DIR``) and the checkpoint dir
+    — rides the task closure into every member, which exports it to its
+    environment before compute. Each member's spans therefore carry the
+    driver's trace id and parent to the driver's stage span, and each
+    member process writes its own telemetry shard, so
+    ``tools/tpuml_trace.py`` reassembles the gang fit as one tree.
     """
+    from spark_rapids_ml_tpu.observability import events as _events
+    from spark_rapids_ml_tpu.utils.tracing import (
+        TraceColor,
+        TraceRange,
+        bump_counter,
+    )
 
-    def wrapped(it):
-        from pyspark import BarrierTaskContext
-
-        from spark_rapids_ml_tpu.observability.heartbeat import heartbeat_scope
-        from spark_rapids_ml_tpu.robustness.faults import fault_point
-
+    with _events.run_scope("gang", "barrier_gang_run"), TraceRange(
+        "barrier gang", TraceColor.CYAN
+    ):
+        carrier = _events.inject_env({})
         if checkpoint_dir is not None:
             from spark_rapids_ml_tpu.robustness.checkpoint import DIR_ENV
 
-            os.environ[DIR_ENV] = checkpoint_dir
-        ctx = BarrierTaskContext.get()
-        if ctx is not None:
-            ctx.barrier()
-        fault_point("barrier.attempt")
-        try:
-            member = int(ctx.partitionId()) if ctx is not None else 0
-        except Exception:  # a stub context without partitionId
-            member = 0
-        # Per-member heartbeat stream for the task's whole lifetime
-        # (TPUML_GANG_HEARTBEAT_EVERY; observability/heartbeat.py): a
-        # stuck member's heartbeat age grows while its peers' stay near
-        # zero — visible BEFORE the stage deadline fires.
-        with heartbeat_scope(member, what="barrier"):
-            return task_fn(ctx, it)
+            carrier[DIR_ENV] = checkpoint_dir
+        tdir = _events.telemetry_dir()
+        if tdir is not None:
+            carrier[_events.TELEMETRY_DIR_ENV] = tdir
 
-    def fallback(it):
-        # Degraded (driver-local) execution: no barrier, no gang, ctx=None
-        # — and no barrier.attempt fault site, the gang is what failed.
-        return task_fn(None, it)
+        def wrapped(it):
+            from pyspark import BarrierTaskContext
 
-    if policy is None:
-        # Deliberately NOT the generic TPUML_RETRY_MAX_ATTEMPTS knob: the
-        # scheduler already retries the stage internally, so driver-side
-        # resubmission has its own (default-off) budget.
-        policy = RetryPolicy(
-            max_attempts=env_int(BARRIER_RESUBMITS_ENV, 1, minimum=1)
+            from spark_rapids_ml_tpu.observability import events as _ev
+            from spark_rapids_ml_tpu.observability.heartbeat import (
+                heartbeat_scope,
+            )
+            from spark_rapids_ml_tpu.robustness.faults import fault_point
+
+            # Export the carrier for the TASK'S lifetime only: executor
+            # processes are reused across tasks (and under the stub the
+            # "executor" IS the driver), so a permanent export would leak
+            # this stage's trace into the next job's.
+            saved = {k: os.environ.get(k) for k in carrier}
+            os.environ.update(carrier)
+            try:
+                if not _ev.enabled():
+                    # A fresh executor process: wire its own telemetry
+                    # shard (or event log) and pick up the driver's env
+                    # trace. On the driver-local stub the sink is already
+                    # live and the trace ambient — nothing to rewire.
+                    _ev.configure()
+                ctx = BarrierTaskContext.get()
+                if ctx is not None:
+                    ctx.barrier()
+                fault_point("barrier.attempt")
+                try:
+                    member = int(ctx.partitionId()) if ctx is not None else 0
+                except Exception:  # a stub context without partitionId
+                    member = 0
+                # Per-member heartbeat stream for the task's whole
+                # lifetime (TPUML_GANG_HEARTBEAT_EVERY; observability/
+                # heartbeat.py): a stuck member's heartbeat age grows
+                # while its peers' stay near zero — visible BEFORE the
+                # stage deadline fires.
+                with _ev.trace_scope(
+                    _ev.current_trace() or _ev.extract_env()
+                ):
+                    with heartbeat_scope(member, what="barrier"):
+                        result = task_fn(ctx, it)
+                        if hasattr(result, "__next__"):
+                            # Drain generator tasks INSIDE the scopes: a
+                            # lazily consumed body would otherwise run
+                            # after the carrier is restored and the
+                            # heartbeat stopped. Barrier tasks return
+                            # per-member reductions, so materializing is
+                            # cheap by construction.
+                            result = list(result)
+                        return result
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+        def fallback(it):
+            # Degraded (driver-local) execution: no barrier, no gang,
+            # ctx=None — and no barrier.attempt fault site, the gang is
+            # what failed.
+            return task_fn(None, it)
+
+        if policy is None:
+            # Deliberately NOT the generic TPUML_RETRY_MAX_ATTEMPTS knob:
+            # the scheduler already retries the stage internally, so
+            # driver-side resubmission has its own (default-off) budget.
+            policy = RetryPolicy(
+                max_attempts=env_int(BARRIER_RESUBMITS_ENV, 1, minimum=1)
+            )
+
+        def _on_resubmit(attempt, exc):
+            bump_counter("gang.resubmit")
+            _events.emit("barrier", action="resubmit", attempt=attempt,
+                         error=type(exc).__name__)
+
+        return run_degradable(
+            lambda: policy.run(
+                lambda: rdd.barrier().mapPartitions(wrapped).collect(),
+                name="barrier.stage",
+                on_retry=_on_resubmit,
+            ),
+            lambda: rdd.mapPartitions(fallback).collect(),
+            what="barrier gang fit",
+            site="barrier.attempt",
         )
-
-    from spark_rapids_ml_tpu.observability.events import emit
-    from spark_rapids_ml_tpu.utils.tracing import bump_counter
-
-    def _on_resubmit(attempt, exc):
-        bump_counter("gang.resubmit")
-        emit("barrier", action="resubmit", attempt=attempt,
-             error=type(exc).__name__)
-
-    return run_degradable(
-        lambda: policy.run(
-            lambda: rdd.barrier().mapPartitions(wrapped).collect(),
-            name="barrier.stage",
-            on_retry=_on_resubmit,
-        ),
-        lambda: rdd.mapPartitions(fallback).collect(),
-        what="barrier gang fit",
-        site="barrier.attempt",
-    )
 
 
 def gang_coordinates(ctx, port: int = DEFAULT_COORDINATOR_PORT) -> dict:

@@ -104,6 +104,16 @@ KNOBS: Dict[str, Knob] = _knob_table(
     Knob("TPUML_GANG_HEARTBEAT_EVERY", "float", "observability",
          "seconds between gang heartbeat records (0 disables)",
          default=5.0),
+    # distributed tracing & telemetry shards
+    Knob("TPUML_TELEMETRY_DIR", "str", "observability",
+         "per-process telemetry shards (events-<pid>.jsonl + metrics + "
+         "manifest) land here; outranks TPUML_EVENT_LOG"),
+    Knob("TPUML_TRACE_ID", "str", "observability",
+         "trace-context carrier: the trace id a launcher injected into "
+         "this process (inject_env/extract_env)"),
+    Knob("TPUML_TRACE_PARENT", "str", "observability",
+         "trace-context carrier: the launcher span id this process's "
+         "root spans parent to"),
     # serving-path program cache
     Knob("TPUML_SERVING_CACHE_SIZE", "int", "serving",
          "bound on the AOT executable LRU (entries per process)",

@@ -25,6 +25,7 @@ name working and remains the one import the instrumented layers use:
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -35,6 +36,7 @@ import jax
 
 from spark_rapids_ml_tpu.observability.events import (
     current_run as _current_run,
+    current_trace as _current_trace,
     emit as _emit,
     enabled as _log_enabled,
 )
@@ -96,7 +98,15 @@ def clear_events() -> None:
 # --- the RAII range ---
 
 _span_ids = itertools.count(1)
+# Globally-unique span ids: a per-process prefix (pid + random epoch, so
+# a recycled pid cannot collide across a long telemetry run) + a local
+# counter. Cross-process trace assembly resolves parents by these ids.
+_SPAN_EPOCH = f"{os.getpid():x}-{os.urandom(2).hex()}"
 _span_stack = threading.local()
+
+
+def _new_span_id() -> str:
+    return f"{_SPAN_EPOCH}-{next(_span_ids):x}"
 
 
 def _stack() -> list:
@@ -104,6 +114,13 @@ def _stack() -> list:
     if s is None:
         s = _span_stack.s = []
     return s
+
+
+def current_span_id() -> Optional[str]:
+    """This thread's innermost open span id — the parent a cross-thread
+    or cross-process child should adopt (events.current_trace_context)."""
+    s = getattr(_span_stack, "s", None)
+    return s[-1] if s else None
 
 
 class TraceRange:
@@ -136,9 +153,17 @@ class TraceRange:
 
     def __enter__(self) -> "TraceRange":
         stack = _stack()
-        self.parent_id = stack[-1] if stack else None
+        if stack:
+            self.parent_id = stack[-1]
+        else:
+            # Thread/process entry point: parent to the ambient trace's
+            # hand-off span (set by trace_scope or the env carrier), so a
+            # dispatcher thread's or gang member's root spans attach to
+            # the submitting span in the merged trace tree.
+            tc = _current_trace()
+            self.parent_id = tc.span_id if tc is not None else None
         self.depth = len(stack)
-        self.span_id = next(_span_ids)
+        self.span_id = _new_span_id()
         stack.append(self.span_id)
         self._start = time.perf_counter()
         self._annotation.__enter__()

@@ -49,10 +49,10 @@ _PREV_LOG = env_str(events.EVENT_LOG_ENV)
 
 
 def _restore_sink():
-    # "" disables explicitly (configure(None) would re-read the possibly
-    # monkeypatched env); then re-wire whatever the session started with
-    # (CI runs the whole suite under a global TPUML_EVENT_LOG).
-    events.configure(_PREV_LOG if _PREV_LOG else "")
+    # Re-wire whatever the session started with: the explicit path when
+    # TPUML_EVENT_LOG was set, else re-resolve from env so a session-wide
+    # TPUML_TELEMETRY_DIR shard resumes (CI runs tier-1 under one).
+    events.configure(_PREV_LOG if _PREV_LOG else None)
 
 
 @pytest.fixture
@@ -377,15 +377,19 @@ class TestHeartbeat:
         with heartbeat_scope(process_id=3, interval=0.02) as hb:
             time.sleep(0.12)
             assert hb.age_seconds() < 1.0
+            # Live member: the age gauge reads as a CURRENT age.
+            g = default_registry.gauge("gang.heartbeat.age_seconds")
+            assert g.value(process="3") >= 0.0
+            snap = default_registry.snapshot()
+            assert 'gang.heartbeat.age_seconds{process="3"}' in snap["gauges"]
         recs = [r for r in _records(event_log) if r["event"] == "heartbeat"]
         assert len(recs) >= 3
         seqs = [r["seq"] for r in recs]
         assert seqs == sorted(seqs) and seqs[0] == 1
         assert all(r["interval"] == 0.02 for r in recs)
-        g = default_registry.gauge("gang.heartbeat.age_seconds")
-        assert g.value(process="3") >= 0.0
+        # Finished member: the series is retired, not left to grow.
         snap = default_registry.snapshot()
-        assert 'gang.heartbeat.age_seconds{process="3"}' in snap["gauges"]
+        assert 'gang.heartbeat.age_seconds{process="3"}' not in snap["gauges"]
 
     def test_zero_interval_disables_thread(self, no_event_log):
         hb = GangHeartbeat(process_id=1, interval=0).start()

@@ -336,6 +336,45 @@ class TestObservabilityDrift:
             "event-unknown-type", "event-missing-field", "jax-host-sync",
         }
 
+    def test_telemetry_dir_raw_read_rule(self, tmp_path, mini_repo):
+        findings = lint_src(mini_repo, '''
+            """f"""
+            import os
+
+            TELEMETRY_DIR_ENV = "TPUML_TELEMETRY_DIR"
+
+
+            def g():
+                a = os.environ.get("TPUML_TELEMETRY_DIR")      # HAZARD
+                b = os.environ["TPUML_TELEMETRY_DIR"]          # HAZARD
+                c = os.getenv(TELEMETRY_DIR_ENV)               # HAZARD
+                os.environ["TPUML_TELEMETRY_DIR"] = "/x"       # write: fine
+                return a, b, c
+        ''', root=mini_repo)
+        hits = [f for f in findings if f.rule == "telemetry-dir-raw-read"]
+        assert len(hits) == 3
+        assert all(f.severity == "error" for f in hits)
+
+    def test_telemetry_dir_accessor_and_other_knobs_clean(
+        self, tmp_path, mini_repo
+    ):
+        findings = lint_src(mini_repo, '''
+            """The envknobs accessor path and OTHER knob reads are not
+            this rule's business (knob-raw-environ owns those)."""
+            import os
+
+            from spark_rapids_ml_tpu.utils.envknobs import env_str
+
+
+            def g():
+                ok = env_str("TPUML_TELEMETRY_DIR")
+                other = os.environ.get("TPUML_GOOD_KNOB")
+                return ok, other
+        ''', root=mini_repo)
+        assert "telemetry-dir-raw-read" not in rules_of(findings)
+        # the sibling family still flags the other raw read
+        assert "knob-raw-environ" in rules_of(findings)
+
     def test_metric_name_rule(self, tmp_path, mini_repo):
         findings = lint_src(mini_repo, '''
             """f"""

@@ -1,0 +1,516 @@
+"""Gang-wide distributed tracing (ISSUE 7): cross-process trace
+propagation, per-member telemetry shards, and one merged view.
+
+Three join scenarios are the contract:
+
+  - a STUB-SPARK barrier gang fit — driver + members in one process,
+    shards + manifests assemble into a single-trace_id tree with no
+    orphan spans (``tools/tpuml_trace.py --validate --strict`` is the
+    oracle);
+  - a 16-THREAD serving burst — every request's submit→dispatcher-thread
+    hop joins one per-request trace via the in-memory carrier, again
+    orphan-free;
+  - the ACCEPTANCE case: a REAL multiprocess gang fit (2 OS processes,
+    jax.distributed) whose per-process shards merge into exactly one
+    trace — one trace_id across all members, every span's parent
+    resolvable, critical path reported, Chrome trace-event JSON renders,
+    and merged counter totals equal to the per-member sums.
+
+Satellites ride along: the heartbeat gauge retires when a gang member
+finishes, and the RF host-label hole (negative label under a declared
+numClasses) raises instead of wrapping.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.observability import events
+from spark_rapids_ml_tpu.observability import trace as tracelib
+from spark_rapids_ml_tpu.observability.metrics import default_registry
+from spark_rapids_ml_tpu.observability.report import gang_report
+from spark_rapids_ml_tpu.utils import tracing
+from spark_rapids_ml_tpu.utils.envknobs import env_str
+
+REPO = Path(__file__).resolve().parents[1]
+TRACE_CLI = REPO / "tools" / "tpuml_trace.py"
+
+_PREV_LOG = env_str(events.EVENT_LOG_ENV)
+
+
+def _restore_sink():
+    events.configure(_PREV_LOG if _PREV_LOG else None)
+
+
+@pytest.fixture
+def telemetry(tmp_path):
+    """A fresh per-test telemetry dir wired as the active (shard) sink;
+    teardown restores whatever the session runs under."""
+    d = str(tmp_path / "telemetry")
+    prev = env_str(events.TELEMETRY_DIR_ENV)
+    os.environ[events.TELEMETRY_DIR_ENV] = d
+    events.configure()
+    try:
+        yield Path(d)
+    finally:
+        if prev is None:
+            os.environ.pop(events.TELEMETRY_DIR_ENV, None)
+        else:
+            os.environ[events.TELEMETRY_DIR_ENV] = prev
+        _restore_sink()
+
+
+_STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)), "pyspark_stub")
+
+
+@pytest.fixture
+def stub_spark():
+    """The pyspark stub installed as ``pyspark`` (the contract-suite
+    arrangement — see tests/test_chaos.py)."""
+    saved = {n: m for n, m in sys.modules.items() if n.startswith("pyspark")}
+    for n in list(saved):
+        del sys.modules[n]
+    sys.path.insert(0, _STUB)
+    try:
+        from pyspark.sql import SparkSession
+
+        yield SparkSession.builder.master("local[2]").getOrCreate()
+    finally:
+        sys.path.remove(_STUB)
+        for n in [n for n in sys.modules if n.startswith("pyspark")]:
+            del sys.modules[n]
+        sys.modules.update(saved)
+
+
+def _validate_cli(telemetry_dir, *extra):
+    """Run tools/tpuml_trace.py --validate --strict over a dir."""
+    return subprocess.run(
+        [sys.executable, str(TRACE_CLI), str(telemetry_dir),
+         "--validate", "--strict", *extra],
+        capture_output=True, text=True, cwd=str(REPO),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+# --- the trace-context primitives ---------------------------------------
+
+
+class TestTraceContext:
+    def test_run_scope_roots_one_trace(self, telemetry):
+        with events.run_scope("job", "root") as ctx:
+            tc = events.current_trace()
+            assert tc is not None
+            with events.run_scope("fit", "nested"):
+                assert events.current_trace().trace_id == tc.trace_id
+            events.emit("fault", action="arm")
+        assert events.current_trace() is None
+        shard = next(Path(telemetry).glob("events-*.jsonl"))
+        recs = [json.loads(l) for l in open(shard) if l.strip()]
+        traced = [r for r in recs if r["run_id"] == ctx.run_id]
+        assert traced and {r["trace"] for r in traced} == {tc.trace_id}
+
+    def test_span_ids_globally_unique_strings(self, telemetry):
+        with events.run_scope("job", "spans"):
+            with tracing.TraceRange("outer"):
+                with tracing.TraceRange("inner"):
+                    pass
+        shard = next(Path(telemetry).glob("events-*.jsonl"))
+        spans = [
+            json.loads(l) for l in open(shard)
+            if l.strip() and '"span"' in l
+        ]
+        spans = [r for r in spans if r["event"] == "span"]
+        assert len(spans) == 2
+        inner, outer = spans[0], spans[1]  # inner exits first
+        assert isinstance(inner["span"], str) and isinstance(outer["span"], str)
+        assert inner["span"].startswith(f"{os.getpid():x}-")
+        assert inner["parent"] == outer["span"]
+        assert outer["parent"] is None
+
+    def test_inject_extract_roundtrip(self, monkeypatch):
+        with events.run_scope("job", "inject"):
+            with tracing.TraceRange("launch"):
+                carrier = events.inject_env({})
+                tc = events.current_trace()
+                assert carrier[events.TRACE_ID_ENV] == tc.trace_id
+                assert (
+                    carrier[events.TRACE_PARENT_ENV]
+                    == tracing.current_span_id()
+                )
+        for k, v in carrier.items():
+            monkeypatch.setenv(k, v)
+        got = events.extract_env()
+        assert got.trace_id == tc.trace_id
+        assert got.span_id == carrier[events.TRACE_PARENT_ENV]
+
+    def test_inject_without_ambient_begins_trace(self):
+        carrier = events.inject_env({})
+        assert carrier[events.TRACE_ID_ENV]
+        assert events.TRACE_PARENT_ENV not in carrier
+
+    def test_env_trace_joins_spawned_process_records(
+        self, telemetry, monkeypatch
+    ):
+        monkeypatch.setenv(events.TRACE_ID_ENV, "feedfacefeedface")
+        events.configure()  # the spawned-member path: re-read the carrier
+        events.emit("fault", action="arm")
+        monkeypatch.delenv(events.TRACE_ID_ENV)
+        events.configure()
+        shard = next(Path(telemetry).glob("events-*.jsonl"))
+        recs = [json.loads(l) for l in open(shard) if l.strip()]
+        faults = [r for r in recs if r["event"] == "fault"]
+        assert faults and faults[-1]["trace"] == "feedfacefeedface"
+
+    def test_trace_scope_carries_across_threads(self, telemetry):
+        seen = {}
+
+        def dispatcher(tc):
+            with events.trace_scope(tc):
+                with tracing.TraceRange("remote work"):
+                    pass
+                seen["trace"] = events.current_trace().trace_id
+
+        with events.run_scope("job", "hop"):
+            with tracing.TraceRange("submit"):
+                tc = events.current_trace_context()
+                t = threading.Thread(target=dispatcher, args=(tc,))
+                t.start()
+                t.join()
+            assert seen["trace"] == events.current_trace().trace_id
+        shard = next(Path(telemetry).glob("events-*.jsonl"))
+        spans = [
+            json.loads(l) for l in open(shard) if l.strip()
+        ]
+        spans = [r for r in spans if r["event"] == "span"]
+        remote = next(s for s in spans if s["name"] == "remote work")
+        submit = next(s for s in spans if s["name"] == "submit")
+        # The remote thread's root span parents to the SUBMITTING span.
+        assert remote["parent"] == submit["span"]
+
+
+# --- shards + manifests -------------------------------------------------
+
+
+class TestTelemetryShards:
+    def test_shard_manifest_and_metrics_snapshot(self, telemetry):
+        with events.run_scope("job", "shards") as ctx:
+            tracing.bump_counter("tracetest.shard.counter", 3)
+            with tracing.TraceRange("work"):
+                pass
+            trace_id = events.current_trace().trace_id
+        manifest_path = events.flush_telemetry()
+        assert manifest_path is not None
+        manifest = json.load(open(manifest_path))
+        assert manifest["pid"] == os.getpid()
+        assert manifest["shard"] == f"events-{os.getpid()}.jsonl"
+        assert trace_id in manifest["trace_roots"]
+        assert manifest["emitted"] >= 3
+        metrics = json.load(
+            open(Path(telemetry) / f"metrics-{os.getpid()}.json")
+        )
+        assert metrics["counters"]["tracetest.shard.counter"] == 3
+        # Every shard record (shard_open included) schema-validates.
+        shard = Path(telemetry) / manifest["shard"]
+        recs = [json.loads(l) for l in open(shard) if l.strip()]
+        assert [p for r in recs for p in events.validate_record(r)] == []
+        assert recs[0]["event"] == "telemetry"
+        assert ctx.run_id in {r["run_id"] for r in recs}
+
+    def test_telemetry_dir_outranks_event_log(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(events.EVENT_LOG_ENV, str(tmp_path / "one.jsonl"))
+        monkeypatch.setenv(events.TELEMETRY_DIR_ENV, str(tmp_path / "shards"))
+        try:
+            dest = events.configure()
+            assert dest == str(
+                tmp_path / "shards" / f"events-{os.getpid()}.jsonl"
+            )
+        finally:
+            monkeypatch.delenv(events.EVENT_LOG_ENV)
+            monkeypatch.delenv(events.TELEMETRY_DIR_ENV)
+            _restore_sink()
+
+    def test_validate_flags_malformed_shard(self, telemetry):
+        events.emit("fault", action="arm")
+        events.flush_telemetry()
+        shard = next(Path(telemetry).glob("events-*.jsonl"))
+        with open(shard, "a") as f:
+            f.write('{"event": "span"}\nnot json\n')
+        merged = tracelib.assemble(str(telemetry))
+        assert len(merged["problems"]) >= 2
+        r = _validate_cli(telemetry)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "INVALID" in r.stderr
+
+
+# --- the stub-spark barrier gang ---------------------------------------
+
+
+class TestStubGangTrace:
+    def test_gang_fit_assembles_into_one_trace(
+        self, telemetry, stub_spark, monkeypatch
+    ):
+        monkeypatch.setenv("TPUML_GANG_HEARTBEAT_EVERY", "0.02")
+        from spark_rapids_ml_tpu.spark.barrier import barrier_gang_run
+
+        df = stub_spark.createDataFrame(
+            [(float(i),) for i in range(8)], ["v"], numPartitions=2
+        )
+
+        def task(ctx, it):
+            with tracing.TraceRange("member compute"):
+                time.sleep(0.05)
+                return [sum(r.v for r in it)]
+
+        out = barrier_gang_run(df.rdd, task)
+        assert sum(out) == sum(range(8))
+        events.flush_telemetry()
+
+        merged = tracelib.assemble(str(telemetry))
+        assert merged["problems"] == []
+        assert merged["orphan_problems"] == []
+        # ONE trace joins the driver stage span and both members' work.
+        assert len(merged["traces"]) == 1
+        (cell,) = merged["traces"].values()
+        assert cell["orphans"] == []
+        assert cell["spans"] >= 3  # barrier gang + 2 member computes
+        names = {
+            s["name"]
+            for s in merged["trace_cells"][cell["trace_id"]]["spans"]
+        }
+        assert {"barrier gang", "member compute"} <= names
+        assert cell["critical_path"], "critical path must be reported"
+        # Heartbeats from both members joined the same trace.
+        beats = [
+            r
+            for r in merged["trace_cells"][cell["trace_id"]]["events"]
+            if r["event"] == "heartbeat"
+        ]
+        assert {r["process"] for r in beats} == {0, 1}
+        # The CLI oracle agrees, strictly.
+        r = _validate_cli(telemetry)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_completed_gang_leaves_no_stale_heartbeat_gauges(
+        self, telemetry, stub_spark, monkeypatch
+    ):
+        monkeypatch.setenv("TPUML_GANG_HEARTBEAT_EVERY", "0.02")
+        from spark_rapids_ml_tpu.spark.barrier import barrier_gang_run
+
+        df = stub_spark.createDataFrame(
+            [(float(i),) for i in range(4)], ["v"], numPartitions=2
+        )
+        barrier_gang_run(df.rdd, lambda ctx, it: [sum(r.v for r in it)])
+        stale = [
+            name
+            for name in default_registry.snapshot()["gauges"]
+            if name.startswith("gang.heartbeat.age_seconds")
+        ]
+        assert stale == [], f"finished members left gauges: {stale}"
+
+
+# --- the 16-thread serving burst ---------------------------------------
+
+
+class TestServingBurstTrace:
+    def test_burst_traces_join_across_dispatcher_hop(self, telemetry):
+        from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+        from spark_rapids_ml_tpu.serving import ServingRuntime
+
+        d = 6
+        rng = np.random.default_rng(3)
+        model = KMeansModel(
+            "trace-km", rng.integers(-8, 8, size=(3, d)).astype(np.float64)
+        )
+        n_threads = 16
+        results = [None] * n_threads
+
+        with ServingRuntime(max_delay_ms=20.0) as rt:
+            rt.register("km", model)
+
+            def client(i):
+                x = rng.integers(-8, 8, size=(1, d)).astype(np.float64)
+                results[i] = rt.submit("km", x).result(timeout=30)
+
+            threads = [
+                threading.Thread(target=client, args=(i,))
+                for i in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert all(r is not None for r in results)
+        events.flush_telemetry()
+
+        merged = tracelib.assemble(str(telemetry))
+        assert merged["problems"] == []
+        assert merged["orphan_problems"] == []
+        # One trace per request, each a tree with no orphan spans.
+        serving_traces = {
+            r["trace"]
+            for cell in merged["trace_cells"].values()
+            for r in cell["events"]
+            if r["event"] == "serving" and r.get("action") == "enqueue"
+        }
+        assert len(serving_traces) == n_threads
+        # Each request's enqueue and complete share ITS trace — the
+        # submit → dispatcher-thread hop joined via the request carrier.
+        by_run = {}
+        for cell in merged["trace_cells"].values():
+            for r in cell["events"]:
+                if r["event"] == "serving" and r.get("run_id"):
+                    by_run.setdefault(r["run_id"], set()).add(r["trace"])
+        completed = [
+            rid for rid, traces in by_run.items() if len(traces) != 1
+        ]
+        assert completed == [], f"requests spanning >1 trace: {completed}"
+        r = _validate_cli(telemetry)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+# --- the acceptance case: a REAL multiprocess gang ----------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestMultiprocessGangTrace:
+    def test_two_process_gang_fit_merges_to_one_trace(self, tmp_path):
+        """ISSUE 7 acceptance: a >=2-process gang fit yields shards that
+        merge into exactly one trace — one trace_id across all members,
+        every span parent resolvable, critical path reported, Chrome
+        JSON renders, merged counters == per-member sums."""
+        tdir = tmp_path / "telemetry"
+        n_proc = 2
+        port = _free_port()
+        carrier = events.inject_env({})
+        procs = []
+        for pid in range(n_proc):
+            env = {
+                **os.environ,
+                **carrier,
+                "JAX_PLATFORMS": "cpu",
+                "JAX_ENABLE_X64": "1",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "TPUML_COORDINATOR": f"127.0.0.1:{port}",
+                "TPUML_NUM_PROCESSES": str(n_proc),
+                "TPUML_PROCESS_ID": str(pid),
+                "TPUML_TELEMETRY_DIR": str(tdir),
+                "TPUML_TEST_ROWS": "403",
+            }
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        str(REPO / "tests" / "multiproc_pca_worker.py"),
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    env=env,
+                    cwd=str(REPO),
+                )
+            )
+        outs = [p.communicate(timeout=300) for p in procs]
+        for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {pid} failed:\n{err[-3000:]}"
+
+        merged = tracelib.assemble(str(tdir))
+        assert merged["problems"] == [], merged["problems"]
+        assert merged["orphan_problems"] == [], merged["orphan_problems"]
+        assert len(merged["manifests"]) == n_proc
+
+        # Exactly ONE trace, spanning every member process.
+        assert len(merged["traces"]) == 1
+        (cell,) = merged["traces"].values()
+        assert cell["trace_id"] == carrier[events.TRACE_ID_ENV]
+        assert cell["processes"] == [0, 1]
+        assert len(cell["pids"]) == n_proc
+        assert cell["spans"] >= 2 and cell["orphans"] == []
+        assert cell["critical_path"], "critical path must be reported"
+
+        # Chrome trace-event JSON renders, one row per member process.
+        chrome = tracelib.chrome_trace(merged["records"])
+        span_events = [
+            e for e in chrome["traceEvents"] if e.get("ph") == "X"
+        ]
+        assert span_events
+        assert {e["pid"] for e in span_events} == {
+            m["pid"] for m in merged["manifests"]
+        }
+
+        # Merged counter totals equal the per-member sums.
+        members = merged["metrics"]["members"]
+        assert len(members) == n_proc
+        summed = {}
+        for m in members:
+            for k, v in m["snapshot"]["counters"].items():
+                summed[k] = summed.get(k, 0) + v
+        assert merged["metrics"]["merged"]["counters"] == summed
+        assert any(v > 0 for v in summed.values())
+        # Both members retried through the shared bring-up policy.
+        assert (
+            summed.get("retry.distributed.initialize.attempts", 0) == n_proc
+        )
+
+        # gang_report carries the per-member breakdown + merged view.
+        rep = gang_report(str(tdir))
+        assert {m["process"] for m in rep["members"]} == {0, 1}
+        assert rep["merged"]["counters"] == summed
+        assert rep["problems"] == []
+
+        # The CLI is the oracle: strict validation + both renders.
+        r = _validate_cli(
+            tdir,
+            "--out", str(tmp_path / "trace.json"),
+            "--metrics-out", str(tmp_path / "metrics.json"),
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        rendered = json.load(open(tmp_path / "trace.json"))
+        assert rendered["traceEvents"]
+        merged_metrics = json.load(open(tmp_path / "metrics.json"))
+        assert merged_metrics["counters"] == summed
+
+
+# --- satellite: RF host-label validation under setNumClasses ------------
+
+
+class TestRFHostLabelValidation:
+    def test_negative_host_label_raises_with_declared_classes(self, rng):
+        from spark_rapids_ml_tpu.models.random_forest import (
+            RandomForestClassifier,
+        )
+
+        x = rng.normal(size=(32, 4))
+        y = rng.integers(0, 3, size=32).astype(np.float64)
+        y[7] = -1.0  # pre-fix: silently wrapped into the LAST class column
+        est = RandomForestClassifier().setNumTrees(3).setNumClasses(3)
+        with pytest.raises(ValueError, match=">= 0"):
+            est.fit((x, y))
+
+    def test_valid_host_labels_still_fit_with_declared_classes(self, rng):
+        from spark_rapids_ml_tpu.models.random_forest import (
+            RandomForestClassifier,
+        )
+
+        x = rng.normal(size=(48, 4))
+        y = rng.integers(0, 3, size=48).astype(np.float64)
+        model = (
+            RandomForestClassifier()
+            .setNumTrees(3)
+            .setNumClasses(3)
+            .fit((x, y))
+        )
+        assert model.numClasses == 3
+        assert model.predict(x[:5]).shape == (5,)

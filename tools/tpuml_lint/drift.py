@@ -11,7 +11,13 @@ gates, dashboards) trust two contracts the type system cannot see:
   - every literal metric name at a ``counter()`` / ``gauge()`` /
     ``histogram()`` / ``bump_counter()`` callsite follows the dotted
     naming rule ``subsystem.metric[.detail]`` (``metric-name``), so the
-    Prometheus exposition stays uniform.
+    Prometheus exposition stays uniform;
+  - the telemetry-dir knob is only ever READ through ``utils/envknobs``
+    (``telemetry-dir-raw-read``): shard wiring must stay uniform — a
+    layer that resolves ``TPUML_TELEMETRY_DIR`` on its own can disagree
+    with ``events.configure`` about where shards land, and a gang whose
+    members shard into two places is two gangs to the merger. (Writes
+    are allowed: the barrier launcher EXPORTS the dir to members.)
 
 Callsites are matched through import bindings (``from ...events import
 emit``, ``import ... as``), so a local function that happens to be
@@ -28,10 +34,14 @@ from typing import List
 
 from tools.tpuml_lint.engine import ModuleContext, RepoContext
 from tools.tpuml_lint.findings import Finding
+from tools.tpuml_lint.knobs import _environ_read_key
 
 _EVENTS_MOD = "spark_rapids_ml_tpu.observability.events"
 _METRICS_MOD = "spark_rapids_ml_tpu.observability.metrics"
 _TRACING_MOD = "spark_rapids_ml_tpu.utils.tracing"
+
+_TELEMETRY_KNOB = "TPUML_TELEMETRY_DIR"
+_TELEMETRY_CONSTANT = "TELEMETRY_DIR_ENV"
 
 _METRIC_NAME = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
 
@@ -83,10 +93,47 @@ def _metric_call(node: ast.Call, module: ModuleContext) -> bool:
     return False
 
 
+def _telemetry_read_key(node: ast.AST, module: ModuleContext):
+    """The key expression when ``node`` reads the environment (either
+    call form or a ``Load``-context subscript), else None."""
+    if isinstance(node, ast.Call):
+        return _environ_read_key(node)
+    if (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.ctx, ast.Load)
+        and isinstance(node.value, ast.Attribute)
+        and node.value.attr == "environ"
+        and isinstance(node.value.value, ast.Name)
+        and node.value.value.id == "os"
+    ):
+        return node.slice
+    return None
+
+
+def _is_telemetry_knob(key: ast.AST, module: ModuleContext) -> bool:
+    if module.resolve_str(key) == _TELEMETRY_KNOB:
+        return True
+    if isinstance(key, ast.Name) and key.id == _TELEMETRY_CONSTANT:
+        return True
+    return (
+        isinstance(key, ast.Attribute) and key.attr == _TELEMETRY_CONSTANT
+    )
+
+
 def check(module: ModuleContext, repo: RepoContext) -> List[Finding]:
     findings: List[Finding] = []
     rel = module.rel
     for node in ast.walk(module.tree):
+        if rel != RepoContext.ENVKNOBS_REL:
+            key = _telemetry_read_key(node, module)
+            if key is not None and _is_telemetry_knob(key, module):
+                findings.append(Finding(
+                    rel, node.lineno, node.col_offset,
+                    "telemetry-dir-raw-read",
+                    f"raw os.environ read of {_TELEMETRY_KNOB} — resolve "
+                    "the shard dir through utils/envknobs (events."
+                    "telemetry_dir) so every layer shards to one place",
+                ))
         if not isinstance(node, ast.Call):
             continue
         if _emit_call(node, module) and repo.event_schema is not None:

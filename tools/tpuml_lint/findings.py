@@ -78,6 +78,10 @@ RULES: Dict[str, Rule] = {
         Rule("metric-name", "drift", ERROR,
              "metric names are lowercase dotted (subsystem.metric[.detail]) "
              "so the Prometheus exposition and dashboards stay uniform"),
+        Rule("telemetry-dir-raw-read", "drift", ERROR,
+             "TPUML_TELEMETRY_DIR reads must go through utils/envknobs "
+             "(events.telemetry_dir): a layer resolving the shard dir on "
+             "its own can split one gang's shards across two places"),
     )
 }
 

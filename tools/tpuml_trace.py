@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Assemble per-process telemetry shards into one distributed trace.
+
+A run under ``TPUML_TELEMETRY_DIR=<dir>`` leaves one event-log shard,
+one metrics snapshot and one manifest per process. This CLI merges them
+(``spark_rapids_ml_tpu/observability/trace.py``): every record is
+schema-validated, every process is put on one mono-aligned clock, spans
+are joined into per-trace trees across process boundaries, the critical
+path per trace is computed, counters/histograms/gauges are merged
+gang-wide, and the whole thing can be rendered as Chrome/Perfetto
+trace-event JSON.
+
+Examples::
+
+    python tools/tpuml_trace.py /tmp/telemetry
+    python tools/tpuml_trace.py /tmp/telemetry --out trace.json   # Perfetto
+    python tools/tpuml_trace.py /tmp/telemetry --validate         # CI gate
+    python tools/tpuml_trace.py /tmp/telemetry --validate --strict
+    python tools/tpuml_trace.py /tmp/telemetry --metrics-out merged.json
+
+``--validate`` exits non-zero on malformed shards/records; ``--strict``
+additionally fails on orphan spans (a span whose parent resolves to no
+shard — the cross-process-join oracle the gang tests assert with). A
+shard with no manifest — a member killed before its atexit flush — is
+reported as a WARNING, never a failure: that shard is exactly the
+evidence a post-mortem needs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _import_trace_lib():
+    """The shared assembly library — importable both with the package
+    installed and when this script runs straight from a checkout.
+
+    The READER must not become a member: importing the package wires the
+    event sink from the environment, and an inherited TPUML_TELEMETRY_DIR
+    would make this process drop its own (manifest-less) shard into the
+    very dir it is assembling. Empty reads as unset, so blank it first."""
+    os.environ["TPUML_TELEMETRY_DIR"] = ""
+    os.environ["TPUML_EVENT_LOG"] = ""
+    try:
+        from spark_rapids_ml_tpu.observability import trace
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from spark_rapids_ml_tpu.observability import trace
+    return trace
+
+
+def _render_text(merged: dict) -> str:
+    lines = [
+        f"{merged['record_count']} records from "
+        f"{len(merged['manifests'])} manifest(s) under {merged['dir']}"
+    ]
+    for m in merged["manifests"]:
+        lines.append(
+            f"  member pid={m.get('pid')} process={m.get('process')} "
+            f"shard={m.get('shard')} emitted={m.get('emitted')} "
+            f"trace_roots={len(m.get('trace_roots', []))}"
+        )
+    for tid, cell in sorted(merged["traces"].items(), key=lambda kv: str(kv[0])):
+        lines.append(
+            f"trace {tid}  spans={cell['spans']} events={cell['events']} "
+            f"roots={cell['roots']} orphans={len(cell['orphans'])} "
+            f"processes={cell['processes']}"
+        )
+        cp = cell["critical_path"]
+        if cp:
+            lines.append("  critical path:")
+            for hop in cp:
+                dur = hop.get("dur")
+                dur_s = f"{dur * 1e3:9.2f} ms" if dur is not None else "        ?"
+                lines.append(
+                    f"    {dur_s}  {hop.get('name')}  "
+                    f"(process {hop.get('process')})"
+                )
+    counters = {
+        k: v for k, v in sorted(merged["metrics"]["merged"]["counters"].items())
+        if v
+    }
+    if counters:
+        lines.append("merged counters:")
+        for k, v in counters.items():
+            lines.append(f"  {k} = {v}")
+    for p in merged["problems"]:
+        lines.append(f"PROBLEM {p}")
+    for p in merged["warnings"]:
+        lines.append(f"WARNING {p}")
+    for p in merged["orphan_problems"]:
+        lines.append(f"ORPHAN {p}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("dir", help="the TPUML_TELEMETRY_DIR to assemble")
+    parser.add_argument("--out", default=None,
+                        help="write Chrome/Perfetto trace-event JSON here")
+    parser.add_argument("--metrics-out", default=None,
+                        help="write the merged metrics snapshot here")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--validate", action="store_true",
+                        help="exit 1 on malformed shards/records")
+    parser.add_argument("--strict", action="store_true",
+                        help="with --validate, also fail on orphan spans")
+
+    args = parser.parse_args(argv)
+    trace = _import_trace_lib()
+
+    merged = trace.assemble(args.dir)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(trace.chrome_trace(merged["records"]), f)
+            f.write("\n")
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(merged["metrics"]["merged"], f, indent=2, default=str)
+            f.write("\n")
+
+    if args.format == "json":
+        out = {
+            k: merged[k]
+            for k in ("dir", "record_count", "manifests", "traces",
+                      "problems", "warnings", "orphan_problems")
+        }
+        out["merged_metrics"] = merged["metrics"]["merged"]
+        print(json.dumps(out, indent=2, default=str))
+    else:
+        print(_render_text(merged))
+
+    if args.validate:
+        failures = list(merged["problems"])
+        if args.strict:
+            failures += merged["orphan_problems"]
+        if failures:
+            for p in failures:
+                print(f"INVALID {p}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
