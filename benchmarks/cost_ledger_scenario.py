@@ -1,0 +1,67 @@
+"""Deterministic fit+serve scenario for the cost-ledger CI gate.
+
+Runs a fixed KMeans workload — a segmented (checkpointed) fit plus a
+batched serving session across three row buckets — under
+``TPUML_COST_LEDGER=1`` so the resulting ledger document is stable
+call-for-call: same programs, same invocation counts, same analyzed
+flops/bytes for a given jax version. CI dumps the ledger
+(``TPUML_COST_LEDGER_DUMP``), validates it with ``tpuml_prof
+--validate``, and diffs it against the committed
+``benchmarks/cost_baseline.json`` with a generous ``--max-regress``
+bound (XLA's analyzed totals may drift a little across jax releases;
+2× flops is a real regression, 1.1× is a version bump).
+
+Regenerate the baseline after an INTENDED cost change::
+
+    JAX_PLATFORMS=cpu TPUML_COST_LEDGER=1 \
+      TPUML_COST_LEDGER_DUMP=benchmarks/cost_baseline.json \
+      python benchmarks/cost_ledger_scenario.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+# Runnable straight from a checkout: python benchmarks/cost_ledger_scenario.py
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    os.environ.setdefault("TPUML_COST_LEDGER", "1")
+    # Segmented fit: 5 iterations per jitted segment, so the solver
+    # driver chokepoint contributes `segment`-kind entries.
+    os.environ.setdefault("TPUML_CHECKPOINT_EVERY", "5")
+    os.environ.setdefault("TPUML_CHECKPOINT_DIR", "/tmp/tpuml-cost-ck")
+
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.observability import costs
+
+    costs.configure()
+    assert costs.active() is not None, "ledger must be armed for this scenario"
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(512, 16)).astype(np.float32)
+    model = KMeans().setK(4).setSeed(3).setMaxIter(20).fit(x)
+
+    # Batched serving across three distinct row buckets, warm-path
+    # repeats included so invocation counters exceed compile counters.
+    for _ in range(3):
+        for n in (5, 40, 300):
+            model.predict(x[:n])
+
+    doc = costs.ledger_snapshot()
+    problems = costs.validate_ledger(doc)
+    assert not problems, problems
+    kinds = {e["kind"] for e in doc["entries"]}
+    assert "aot" in kinds and "segment" in kinds, sorted(kinds)
+    print(
+        f"cost-ledger scenario: {len(doc['entries'])} programs, "
+        f"kinds={sorted(kinds)}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -214,6 +214,61 @@ def resolve_persisted_class(class_path: str):
     return obj
 
 
+#: Spark JVM class simple names -> this package's import paths, for
+#: loading directories written by UPSTREAM Spark: its metadata names JVM
+#: classes (org.apache.spark.ml.feature.PCAModel) and its composite
+#: writers (Pipeline, CrossValidator) record no python import path at
+#: all — the nested component's own metadata "class" is the only type
+#: information on disk.
+_SPARK_CLASS_ALIASES: Dict[str, str] = {
+    "PCA": "spark_rapids_ml_tpu.feature.PCA",
+    "PCAModel": "spark_rapids_ml_tpu.feature.PCAModel",
+    "KMeans": "spark_rapids_ml_tpu.clustering.KMeans",
+    "KMeansModel": "spark_rapids_ml_tpu.clustering.KMeansModel",
+    "LogisticRegression": "spark_rapids_ml_tpu.classification.LogisticRegression",
+    "LogisticRegressionModel":
+        "spark_rapids_ml_tpu.classification.LogisticRegressionModel",
+    "LinearRegression": "spark_rapids_ml_tpu.regression.LinearRegression",
+    "LinearRegressionModel":
+        "spark_rapids_ml_tpu.regression.LinearRegressionModel",
+    "RandomForestClassifier":
+        "spark_rapids_ml_tpu.classification.RandomForestClassifier",
+    "RandomForestClassificationModel":
+        "spark_rapids_ml_tpu.classification.RandomForestClassificationModel",
+    "RandomForestRegressor":
+        "spark_rapids_ml_tpu.regression.RandomForestRegressor",
+    "RandomForestRegressionModel":
+        "spark_rapids_ml_tpu.regression.RandomForestRegressionModel",
+    "Pipeline": "spark_rapids_ml_tpu.pipeline.Pipeline",
+    "PipelineModel": "spark_rapids_ml_tpu.pipeline.PipelineModel",
+    "CrossValidatorModel": "spark_rapids_ml_tpu.tuning.CrossValidatorModel",
+    "TrainValidationSplitModel":
+        "spark_rapids_ml_tpu.tuning.TrainValidationSplitModel",
+}
+
+
+def resolve_component_class(path: str):
+    """The loader class for a NESTED model directory (a pipeline stage,
+    a validator's ``bestModel``) whose owner recorded no python import
+    path — i.e. a directory written by upstream Spark. Reads the
+    component's own metadata ``class`` and maps the JVM simple name via
+    :data:`_SPARK_CLASS_ALIASES`; python class paths (this package's own
+    writes) still resolve through the registered-package gate."""
+    metadata = load_metadata(path)
+    class_path = metadata.get("class", "")
+    root = class_path.split(".", 1)[0]
+    if root in _LOADABLE_PACKAGES:
+        return resolve_persisted_class(class_path)
+    simple = class_path.rsplit(".", 1)[-1]
+    alias = _SPARK_CLASS_ALIASES.get(simple)
+    if alias is None:
+        raise ValueError(
+            f"no loader for Spark class {class_path!r} (component at "
+            f"{path}): known aliases are {sorted(_SPARK_CLASS_ALIASES)}"
+        )
+    return resolve_persisted_class(alias)
+
+
 def get_and_set_params(instance, metadata: Dict[str, Any]) -> None:
     """metadata.getAndSetParams equivalent (RapidsPCA.scala:251)."""
     for name, value in metadata.get("defaultParamMap", {}).items():

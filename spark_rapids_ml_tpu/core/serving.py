@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import warnings
 import weakref
 from collections import OrderedDict
@@ -65,6 +66,7 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.observability import costs as _costs
 from spark_rapids_ml_tpu.observability.events import emit, run_scope
 from spark_rapids_ml_tpu.observability.metrics import ROW_BUCKETS, histogram
 from spark_rapids_ml_tpu.observability.metrics import gauge as _gauge
@@ -176,6 +178,12 @@ def _reset_compile_cache_wiring_for_tests() -> None:
 _LOCK = threading.RLock()
 _PROGRAMS: "OrderedDict[tuple, Any]" = OrderedDict()  # guarded-by: _LOCK
 _STATS = {"hits": 0, "misses": 0, "evictions": 0, "compiles": 0}  # guarded-by: _LOCK
+# Cost-ledger bookkeeping (populated ONLY while the ledger is enabled):
+# cache key -> ledger entry key, and the keys the LRU evicted — so the
+# retrace watchdog can tell an eviction refill from a genuine retrace.
+_LEDGER_KEYS: Dict[tuple, str] = {}  # guarded-by: _LOCK
+_EVICTED_KEYS: set = set()  # guarded-by: _LOCK
+_MAX_EVICTED_KEYS = 4096
 
 
 def _capacity() -> int:
@@ -206,10 +214,17 @@ def clear_program_cache() -> None:
     with _LOCK:
         _PROGRAMS.clear()
         _JIT_FALLBACKS.clear()
+        _LEDGER_KEYS.clear()
+        _EVICTED_KEYS.clear()
         for k in _STATS:
             _STATS[k] = 0
         _publish_cache_size(len(_PROGRAMS))
         models = list(_DEVICE_CACHED_MODELS)
+    ledger = _costs.active()
+    if ledger is not None:
+        # A cache reset is a reconfiguration boundary: the recompiles
+        # that refill it must not read as retrace storms.
+        ledger.reset_families()
     for model in models:
         invalidate_device_caches(model)
 
@@ -272,8 +287,17 @@ def _args_specs_and_key(args: tuple):
     return jax.tree_util.tree_unflatten(treedef, specs), key
 
 
-def _get_program(fn: Callable, x_spec, args: tuple, static: dict, donate: bool):
-    """The cached AOT executable for (fn, static, specs, donation)."""
+def _get_program(
+    fn: Callable,
+    x_spec,
+    args: tuple,
+    static: dict,
+    donate: bool,
+    name: Optional[str] = None,
+):
+    """The cached AOT executable for (fn, static, specs, donation), as
+    ``(exe, ledger_key)`` — ``ledger_key`` is the cost-ledger handle for
+    invocation accounting, None whenever the ledger is disabled."""
     import jax
 
     arg_specs, args_key = _args_specs_and_key(args)
@@ -284,6 +308,7 @@ def _get_program(fn: Callable, x_spec, args: tuple, static: dict, donate: bool):
         args_key,
         donate,
     )
+    ledger = _costs.active()
     with _LOCK:
         exe = _PROGRAMS.get(key)
         if exe is not None:
@@ -291,8 +316,9 @@ def _get_program(fn: Callable, x_spec, args: tuple, static: dict, donate: bool):
             _STATS["hits"] += 1
             bump_counter("serving.cache.hit")
             emit("serving", action="hit", kernel=getattr(fn, "__name__", str(fn)))
-            return exe
+            return exe, (_LEDGER_KEYS.get(key) if ledger is not None else None)
         _STATS["misses"] += 1
+        was_evicted = ledger is not None and key in _EVICTED_KEYS
         bump_counter("serving.cache.miss")
         emit("serving", action="miss", kernel=getattr(fn, "__name__", str(fn)))
 
@@ -301,6 +327,7 @@ def _get_program(fn: Callable, x_spec, args: tuple, static: dict, donate: bool):
         static_argnames=tuple(static) or None,
         donate_argnums=(0,) if donate else (),
     )
+    compile_t0 = time.perf_counter()
     with TraceRange("serving compile", TraceColor.YELLOW):
         with warnings.catch_warnings(record=True) as caught:
             # A donated scratch whose bytes no output can alias is a
@@ -314,19 +341,43 @@ def _get_program(fn: Callable, x_spec, args: tuple, static: dict, donate: bool):
                 warnings.warn_explicit(
                     w.message, w.category, w.filename, w.lineno
                 )
+    lkey = None
+    if ledger is not None:
+        # Classify the compile (retrace watchdog) + capture XLA's cost
+        # and memory analyses — the chokepoint the ledger exists for.
+        lkey = _costs.record_aot(
+            fn,
+            name=name or getattr(fn, "__name__", str(fn)),
+            static=static,
+            x_spec=x_spec,
+            args=args,
+            compiled=exe,
+            compile_seconds=time.perf_counter() - compile_t0,
+            evicted=was_evicted,
+        )
     with _LOCK:
         _STATS["compiles"] += 1
         bump_counter("serving.compile")
         emit("serving", action="compile", kernel=getattr(fn, "__name__", str(fn)))
         if key not in _PROGRAMS:
             _PROGRAMS[key] = exe
+            if lkey is not None:
+                _LEDGER_KEYS[key] = lkey
+                _EVICTED_KEYS.discard(key)
             while len(_PROGRAMS) > _capacity():
-                _PROGRAMS.popitem(last=False)
+                old_key, _ = _PROGRAMS.popitem(last=False)
+                if ledger is not None:
+                    if len(_EVICTED_KEYS) >= _MAX_EVICTED_KEYS:
+                        _EVICTED_KEYS.clear()
+                    _EVICTED_KEYS.add(old_key)
+                    _LEDGER_KEYS.pop(old_key, None)
                 _STATS["evictions"] += 1
                 bump_counter("serving.cache.evict")
                 emit("serving", action="evict")
             _publish_cache_size(len(_PROGRAMS))
-        return _PROGRAMS[key]
+        return _PROGRAMS[key], (
+            _LEDGER_KEYS.get(key) if ledger is not None else None
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -460,9 +511,20 @@ def _serve_rows_impl(
         # executables reject live shardings they were not compiled for.
         # jax's own jit cache still amortizes compiles per exact shape.
         bump_counter("serving.fallback")
-        with TraceRange(f"serve {name}", TraceColor.GREEN):
-            outs = _jit_fallback(fn, static)(x, *args, **static)
         n = int(np.shape(x)[0])
+        jitted = _jit_fallback(fn, static)
+        ledger = _costs.active()
+        with TraceRange(f"serve {name}", TraceColor.GREEN):
+            if ledger is not None:
+                lkey = _costs.record_fallback(
+                    fn, name=name, static=static, args=(x, *args),
+                    lower=lambda: jitted.lower(x, *args, **static),
+                )
+                t0 = time.perf_counter()
+                outs = jitted(x, *args, **static)
+                ledger.note_invocation(lkey, time.perf_counter() - t0, rows=n)
+            else:
+                outs = jitted(x, *args, **static)
         _observe_batch(n)
         return _slice_outputs(outs, n, n, to_host)
 
@@ -502,9 +564,16 @@ def _serve_rows_impl(
 
     use_donate = (_donation_enabled() if donate is None else donate) and owned
     spec = jax.ShapeDtypeStruct((bucket, d), dtype)
-    exe = _get_program(fn, spec, args, static, donate=use_donate)
+    exe, lkey = _get_program(fn, spec, args, static, donate=use_donate, name=name)
     with TraceRange(f"serve {name}", TraceColor.GREEN):
-        outs = exe(x_pad, *args)
+        if lkey is not None:
+            t0 = time.perf_counter()
+            outs = exe(x_pad, *args)
+            ledger = _costs.active()
+            if ledger is not None:
+                ledger.note_invocation(lkey, time.perf_counter() - t0, rows=n)
+        else:
+            outs = exe(x_pad, *args)
     return _slice_outputs(outs, bucket, n, to_host)
 
 
@@ -560,19 +629,40 @@ def serve_stream(
         pad_host[:n] = x_host
         with TraceRange(f"serve {name} H2D", TraceColor.CYAN):
             x_pad = jax.device_put(pad_host)
+        ledger = _costs.active()
         with TraceRange(f"serve {name}", TraceColor.GREEN):
             if fallback is not None:  # mesh-sharded weights (see serve_rows)
                 bump_counter("serving.fallback")
-                outs = fallback(x_pad, *args, **static)
+                if ledger is not None:
+                    lkey = _costs.record_fallback(
+                        fn, name=name, static=static, args=(x_pad, *args),
+                        lower=lambda: fallback.lower(x_pad, *args, **static),
+                    )
+                    t0 = time.perf_counter()
+                    outs = fallback(x_pad, *args, **static)
+                    ledger.note_invocation(
+                        lkey, time.perf_counter() - t0, rows=n
+                    )
+                else:
+                    outs = fallback(x_pad, *args, **static)
             else:
-                exe = _get_program(
+                exe, lkey = _get_program(
                     fn,
                     jax.ShapeDtypeStruct((bucket, d), blk_dtype),
                     args,
                     static,
                     donate=_donation_enabled(),
+                    name=name,
                 )
-                outs = exe(x_pad, *args)  # async dispatch
+                if lkey is not None:
+                    t0 = time.perf_counter()
+                    outs = exe(x_pad, *args)  # async dispatch
+                    if ledger is not None:
+                        ledger.note_invocation(
+                            lkey, time.perf_counter() - t0, rows=n
+                        )
+                else:
+                    outs = exe(x_pad, *args)  # async dispatch
         bump_counter("serving.stream.blocks")
         if pending is not None:
             # Sync the PREVIOUS block only after this block's transfer

@@ -776,6 +776,15 @@ def _tree_to_nodedata(f: Forest, t: int, classification: bool) -> list:
     Variance triplet [count, sum, sumSq] with sumSq reconstructed EXACTLY
     from the stored node impurity (var = sumSq/w - mean^2). Leaves carry
     Spark's sentinels: gain -1, children -1, split (-1, [], -1).
+
+    APPROXIMATION (docs/PARITY.md "Known deviations"): Spark's
+    ``rawCount`` is the UNWEIGHTED instance count at the node; the heap
+    arrays keep only the weighted node weight, so ``rawCount`` is
+    written as ``round(node_weight)``. With no ``weightCol`` (weights
+    all 1.0) the two are identical; under fractional row weights the
+    stored rawCount is the rounded weighted count, not the row count.
+    Predictions are unaffected (nothing reads rawCount back); only the
+    persisted field's meaning deviates.
     """
     feature = np.asarray(f.feature[t])
     thr = np.asarray(f.threshold[t], dtype=np.float64)

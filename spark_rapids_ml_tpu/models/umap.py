@@ -15,7 +15,16 @@ synchronous-epoch SGD layout optimization — one jitted program per stage
 (:mod:`ops.umap`). ``transform`` places new points by membership-weighted
 interpolation of their training neighbors' coordinates, then refines with
 attraction-only epochs against the FIXED training embedding (cuML's
-transform semantics, batch-parallel)."""
+transform semantics, batch-parallel).
+
+DELIBERATE DIVERGENCE (docs/PARITY.md "Known deviations"): the default
+``negativePoolSize=256`` draws each epoch's repulsion negatives from one
+shared 256-point pool instead of the reference's fresh per-edge negative
+samples — the pooled scheme keeps the SGD epoch a single dense jitted
+program (no per-edge gather storms on the MXU). Embedding geometry is
+equivalent in practice but not sample-for-sample identical to
+umap-learn/cuML; ``setNegativePoolSize(0)`` restores the reference
+per-edge sampling scheme exactly."""
 
 from __future__ import annotations
 

@@ -23,6 +23,12 @@ seed into a subsystem every layer reports into:
     distinguishable from a slow one before the stage deadline fires.
   - :mod:`profiling` — ``TPUML_PROFILE_DIR`` wraps a fit/transform in a
     ``jax.profiler`` trace session.
+  - :mod:`costs`    — the program cost ledger (``TPUML_COST_LEDGER``):
+    XLA ``cost_analysis``/``memory_analysis`` per compiled program at
+    every compile chokepoint, invocation/wall counters, the retrace
+    watchdog, the HBM watermark sampler, and measured admission
+    pricing. ``tools/tpuml_prof.py`` renders/validates/diffs the
+    resulting documents.
 
 ``utils/tracing.py`` remains the compatibility surface (TraceRange,
 bump_counter, ...) and forwards here.
@@ -66,4 +72,14 @@ from spark_rapids_ml_tpu.observability.heartbeat import (  # noqa: F401
 from spark_rapids_ml_tpu.observability.profiling import (  # noqa: F401
     PROFILE_DIR_ENV,
     maybe_profile,
+)
+from spark_rapids_ml_tpu.observability.costs import (  # noqa: F401
+    COST_LEDGER_ENV,
+    HbmSampler,
+    Ledger,
+    ProgramCost,
+    RetraceStormWarning,
+    ledger_snapshot,
+    merge_ledger_docs,
+    validate_ledger,
 )

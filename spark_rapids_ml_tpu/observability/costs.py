@@ -1,0 +1,1092 @@
+"""Program cost ledger — XLA cost/memory attribution for compiled programs.
+
+PRs 4 and 7 made the system's *behavior* observable (metrics, events,
+gang traces); this module explains its *cost*. Every compile chokepoint
+— the AOT program cache in ``core/serving.py``, its plain-jit sharded
+fallback, and the segmented solver drivers in ``ops/`` — reports the
+program it just built, and the ledger captures what XLA itself says the
+program costs: ``compiled.cost_analysis()`` (flops, transcendentals,
+bytes accessed) and ``compiled.memory_analysis()`` (argument / output /
+temp / alias / generated-code bytes), with a graceful ``unavailable``
+marker on backends that report neither ("Memory Safe Computations with
+XLA Compiler", PAPERS.md: memory must be *measured* to be controlled).
+Each entry then accumulates run-time truth — invocations, wall seconds,
+rows served — so reports can render a roofline-style achieved-vs-
+analyzed picture per program (arithmetic intensity from the analysis,
+achieved FLOP/s from the wall clock, utilization against the
+``TPUML_PEAK_FLOPS`` / ``TPUML_PEAK_BYTES_PER_SEC`` device ceilings
+when the operator declares them).
+
+On top of the ledger:
+
+  - a **retrace watchdog**: every compile is classified as
+    ``new_program`` / ``new_bucket`` / ``eviction_refill`` /
+    ``retrace`` (same kernel + static config compiling a shape INSIDE
+    an existing bucket — the shape-bucketing contract was bypassed).
+    Retraces bump ``compile.retrace`` and, at ``TPUML_RETRACE_STORM``
+    per program family, raise one structured
+    :class:`RetraceStormWarning` naming the family — the storm a
+    wandering batch size causes is visible before it eats the fit.
+  - an **HBM watermark sampler** (:class:`HbmSampler`): an opt-in
+    daemon thread (``TPUML_HBM_SAMPLE_EVERY_MS``) publishing
+    ``device.memory.in_use`` / ``device.memory.peak_bytes`` gauges
+    continuously instead of only at report time; the sample history
+    lets ``fit_report()`` attribute peak growth to the enclosing span
+    (:func:`attribute_hbm_growth`).
+  - **measured admission pricing**: once a serving program has
+    compiled, :func:`measured_request_bytes` answers with its ledgered
+    ``temp + output`` bytes — what the program actually makes XLA
+    allocate beyond its resident inputs — and ``serving/admission``
+    prefers that over the declared-spec estimate.
+
+Everything is OFF by default: with ``TPUML_COST_LEDGER`` unset,
+:func:`active` is one module-global ``None`` check and the compile/serve
+hot paths allocate nothing (the established overhead discipline).
+Ledger shards ride the PR 7 ``TPUML_TELEMETRY_DIR`` mechanism
+(``costs-<pid>.json`` beside the event shard) so gang members merge
+into one cost view (:func:`merge_ledger_docs`: counters sum,
+watermarks max). ``TPUML_COST_LEDGER_DUMP=<path>`` writes the snapshot
+at interpreter exit for single-process runs; ``tools/tpuml_prof.py``
+renders, validates, and diffs the resulting documents.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import threading
+import time
+import warnings
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.observability.events import emit
+from spark_rapids_ml_tpu.observability.metrics import default_registry, gauge
+from spark_rapids_ml_tpu.utils.envknobs import (
+    env_choice,
+    env_float,
+    env_int,
+    env_str,
+)
+
+COST_LEDGER_ENV = "TPUML_COST_LEDGER"
+COST_DUMP_ENV = "TPUML_COST_LEDGER_DUMP"
+HBM_SAMPLE_ENV = "TPUML_HBM_SAMPLE_EVERY_MS"
+RETRACE_STORM_ENV = "TPUML_RETRACE_STORM"
+PEAK_FLOPS_ENV = "TPUML_PEAK_FLOPS"
+PEAK_BYTES_ENV = "TPUML_PEAK_BYTES_PER_SEC"
+
+#: Ledger document schema version (bump on incompatible change).
+LEDGER_VERSION = 1
+
+#: Default retraces per program family before the storm warning fires.
+DEFAULT_RETRACE_STORM = 3
+
+#: Program kinds the chokepoints report.
+KIND_AOT = "aot"            # bucketed AOT executable (core/serving)
+KIND_FALLBACK = "fallback"  # plain-jit sharded fallback (cost from the
+                            # lowering only; never compiled twice)
+KIND_SEGMENT = "segment"    # segmented solver program (ops/ drivers)
+
+
+class RetraceStormWarning(UserWarning):
+    """One program family keeps recompiling for shapes its existing
+    buckets already cover — the shape-bucketing contract is being
+    bypassed and compiles are eating the run."""
+
+
+def _is_row_bucket(rows: int) -> bool:
+    """Whether ``rows`` is a value ``core.serving.bucket_rows`` can
+    return (a power of two >= the minimum bucket) — duplicated here
+    instead of imported because core.serving imports this module.
+    A compile at any OTHER row count means bucketing was bypassed."""
+    return rows >= 8 and (rows & (rows - 1)) == 0
+
+
+def _memory_fields(mem) -> Dict[str, int]:
+    """The CompiledMemoryStats fields the ledger keeps, as plain ints."""
+    return {
+        "argument_bytes": int(mem.argument_size_in_bytes),
+        "output_bytes": int(mem.output_size_in_bytes),
+        "temp_bytes": int(mem.temp_size_in_bytes),
+        "alias_bytes": int(mem.alias_size_in_bytes),
+        "generated_code_bytes": int(mem.generated_code_size_in_bytes),
+    }
+
+
+def _cost_dict(stage) -> Optional[dict]:
+    """``cost_analysis()`` of a Lowered/Compiled as one flat dict, or
+    None when the backend doesn't report (some jaxlibs return a
+    one-element list, some a dict, some raise)."""
+    try:
+        ca = stage.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else None
+
+
+@dataclass
+class ProgramCost:
+    """One compiled program's analyzed cost + cumulative run counters."""
+
+    key: str
+    family: str        # serving name / solver name ("kmeans.predict")
+    kind: str          # KIND_AOT | KIND_FALLBACK | KIND_SEGMENT
+    static: str        # rendered static config
+    spec: str          # rendered input spec ("128x16:float32")
+    rows: Optional[int]
+    classification: str  # the watchdog's verdict for the FIRST compile
+    flops: Optional[float] = None
+    transcendentals: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    #: Which analyses the backend did NOT provide ("cost_analysis",
+    #: "memory_analysis") — the explicit marker the acceptance criteria
+    #: require instead of silently-absent fields.
+    unavailable: List[str] = field(default_factory=list)
+    compiles: int = 0
+    compile_seconds: float = 0.0
+    invocations: int = 0
+    wall_seconds: float = 0.0
+    rows_served: int = 0
+
+    def measured_request_bytes(self) -> Optional[int]:
+        """temp + output bytes — the program's measured incremental
+        device footprint per execution (inputs are either resident
+        weights or donated scratch whose bytes XLA may reuse)."""
+        if self.temp_bytes is None or self.output_bytes is None:
+            return None
+        return int(self.temp_bytes) + int(self.output_bytes)
+
+    def to_json(self) -> dict:
+        return {
+            "key": self.key,
+            "family": self.family,
+            "kind": self.kind,
+            "static": self.static,
+            "spec": self.spec,
+            "rows": self.rows,
+            "classification": self.classification,
+            "flops": self.flops,
+            "transcendentals": self.transcendentals,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "unavailable": list(self.unavailable),
+            "compiles": self.compiles,
+            "compile_seconds": self.compile_seconds,
+            "invocations": self.invocations,
+            "wall_seconds": self.wall_seconds,
+            "rows_served": self.rows_served,
+        }
+
+
+#: Fields every serialized ledger entry must carry (validation truth
+#: shared by tests and ``tools/tpuml_prof.py``).
+ENTRY_FIELDS = frozenset(
+    {
+        "key", "family", "kind", "static", "spec", "rows", "classification",
+        "flops", "bytes_accessed", "unavailable", "compiles",
+        "compile_seconds", "invocations", "wall_seconds",
+    }
+)
+
+
+class Ledger:
+    """The per-process cost ledger: programs by stable key, watermarks,
+    retrace families. All mutation is under one lock; the serving hot
+    path touches it only when the ledger is enabled."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, ProgramCost] = {}  # guarded-by: _lock
+        # (fn id, static, rows, d, dtype, args key) -> entry key — the
+        # admission controller's measured-pricing index.
+        self._request_index: Dict[tuple, str] = {}  # guarded-by: _lock
+        # (family identity minus rows) -> {"rows": set, "retraces": n}
+        self._families: Dict[tuple, dict] = {}  # guarded-by: _lock
+        self._watermarks: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
+        self._retraces = 0  # guarded-by: _lock
+
+    # --- recording -----------------------------------------------------
+
+    def record(
+        self,
+        key: str,
+        *,
+        family: str,
+        kind: str,
+        static: str,
+        spec: str,
+        rows: Optional[int],
+        classification: str,
+        stage: Any = None,
+        compiled: Any = None,
+        compile_seconds: float = 0.0,
+        index_key: Optional[tuple] = None,
+    ) -> str:
+        """Upsert one program: analyzed cost from ``stage`` (a Lowered
+        or Compiled), memory from ``compiled`` when the program was
+        actually AOT-compiled. Idempotent per key — a recompile (cache
+        eviction refill, a retrace) bumps ``compiles`` on the same
+        entry."""
+        cost = _cost_dict(stage if stage is not None else compiled)
+        mem = None
+        if compiled is not None:
+            try:
+                mem = compiled.memory_analysis()
+            except Exception:
+                mem = None
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = ProgramCost(
+                    key=key, family=family, kind=kind, static=static,
+                    spec=spec, rows=rows, classification=classification,
+                )
+                self._entries[key] = entry
+            entry.compiles += 1
+            entry.compile_seconds += float(compile_seconds)
+            if cost is not None:
+                entry.flops = float(cost.get("flops", 0.0))
+                entry.transcendentals = float(cost.get("transcendentals", 0.0))
+                entry.bytes_accessed = float(cost.get("bytes accessed", 0.0))
+            elif "cost_analysis" not in entry.unavailable:
+                entry.unavailable.append("cost_analysis")
+            if mem is not None:
+                for f, v in _memory_fields(mem).items():
+                    setattr(entry, f, v)
+            elif "memory_analysis" not in entry.unavailable:
+                entry.unavailable.append("memory_analysis")
+            if index_key is not None and entry.measured_request_bytes() is not None:
+                self._request_index[index_key] = key
+        return key
+
+    def note_invocation(self, key: str, seconds: float, rows: int = 0) -> None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return
+            entry.invocations += 1
+            entry.wall_seconds += float(seconds)
+            entry.rows_served += int(rows)
+
+    # --- the retrace watchdog ------------------------------------------
+
+    def classify(
+        self,
+        family_key: tuple,
+        family_name: str,
+        rows: Optional[int],
+        *,
+        evicted: bool,
+        bucketed: bool,
+    ) -> str:
+        """Classify one compile event and run the storm watchdog.
+
+        ``family_key`` is the program identity MINUS the row count (so
+        two row buckets of one kernel are one family); ``bucketed``
+        says whether this kind participates in the shape-bucket
+        contract (AOT serving programs do; segment/fallback programs
+        legitimately compile one program per dataset shape)."""
+        storm = env_int(RETRACE_STORM_ENV, DEFAULT_RETRACE_STORM, minimum=1)
+        with self._lock:
+            fam = self._families.get(family_key)
+            if fam is None:
+                fam = self._families[family_key] = {"rows": set(), "retraces": 0}
+                cls = "new_program"
+            elif evicted:
+                cls = "eviction_refill"
+            elif bucketed and rows is not None and (
+                rows in fam["rows"] or not _is_row_bucket(rows)
+            ):
+                # Either this exact bucket compiled before (and was not
+                # evicted), or the row count is not a bucket value at
+                # all — a shape that should have rounded up into an
+                # existing program. Both mean bucketing was bypassed.
+                cls = "retrace"
+                fam["retraces"] += 1
+                self._retraces += 1
+            else:
+                cls = "new_bucket" if bucketed else "new_program"
+            if rows is not None:
+                fam["rows"].add(rows)
+            retraces = fam["retraces"]
+        default_registry.counter(f"compile.{cls}").inc()
+        emit("compile", classification=cls, kernel=family_name, rows=rows)
+        if cls == "retrace" and retraces == storm:
+            warnings.warn(
+                RetraceStormWarning(
+                    f"program family {family_name!r} has recompiled "
+                    f"{retraces} times for shapes inside its existing row "
+                    f"buckets — shape bucketing is being bypassed "
+                    f"({RETRACE_STORM_ENV}={storm})"
+                ),
+                stacklevel=3,
+            )
+        return cls
+
+    def reset_families(self) -> None:
+        """Forget the watchdog's family/bucket history — called when the
+        serving program cache is CLEARED (a reconfiguration boundary):
+        the recompiles that follow are expected refills of a fresh
+        cache, not retraces. Entries and their counters are kept."""
+        with self._lock:
+            self._families.clear()
+
+    # --- watermarks ----------------------------------------------------
+
+    def observe_watermark(self, device: str, in_use: int, peak: int) -> None:
+        with self._lock:
+            cell = self._watermarks.setdefault(
+                device, {"in_use": 0, "peak_bytes": 0}
+            )
+            cell["in_use"] = max(cell["in_use"], int(in_use))
+            cell["peak_bytes"] = max(cell["peak_bytes"], int(peak))
+
+    # --- views ---------------------------------------------------------
+
+    def measured_bytes(self, index_key: tuple) -> Optional[int]:
+        with self._lock:
+            key = self._request_index.get(index_key)
+            if key is None:
+                return None
+            entry = self._entries.get(key)
+        return entry.measured_request_bytes() if entry is not None else None
+
+    def entries(self) -> List[ProgramCost]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def invocation_snapshot(self) -> Dict[str, Tuple[int, float, int]]:
+        """{key: (invocations, wall_seconds, rows_served)} — the marks a
+        RunRecorder diffs to attribute ledger traffic to one run."""
+        with self._lock:
+            return {
+                k: (e.invocations, e.wall_seconds, e.rows_served)
+                for k, e in self._entries.items()
+            }
+
+    def snapshot(self) -> dict:
+        import os
+
+        with self._lock:
+            entries = [e.to_json() for e in self._entries.values()]
+            watermarks = {k: dict(v) for k, v in self._watermarks.items()}
+            families: Dict[str, int] = {}
+            for fkey, fam in self._families.items():
+                if fam["retraces"]:
+                    name = str(fkey[-1])  # family keys end with the name
+                    families[name] = families.get(name, 0) + fam["retraces"]
+            retraces = {"total": self._retraces, "families": families}
+        return {
+            "version": LEDGER_VERSION,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "entries": entries,
+            "watermarks": watermarks,
+            "retraces": retraces,
+            "peaks": device_peaks(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# module state: the one-None-check discipline
+# ---------------------------------------------------------------------------
+
+_LEDGER: Optional[Ledger] = None  # None = disabled: active() is one read
+_SAMPLER: Optional["HbmSampler"] = None
+_config_lock = threading.Lock()
+
+
+def active() -> Optional[Ledger]:
+    """The live ledger, or None when ``TPUML_COST_LEDGER`` is off — the
+    single check every chokepoint makes before touching anything."""
+    return _LEDGER
+
+
+def configure(enable: Optional[bool] = None) -> Optional[Ledger]:
+    """(Re)wire the ledger from ``TPUML_COST_LEDGER`` (or an explicit
+    ``enable``), and start/stop the HBM sampler per
+    ``TPUML_HBM_SAMPLE_EVERY_MS``. Idempotent; returns the active
+    ledger (None = disabled). Enabling twice keeps the existing ledger."""
+    global _LEDGER, _SAMPLER
+    with _config_lock:
+        if enable is None:
+            enable = env_choice(COST_LEDGER_ENV, ("0", "1"), "0") == "1"
+        if enable:
+            if _LEDGER is None:
+                _LEDGER = Ledger()
+        else:
+            _LEDGER = None
+        period = env_float(HBM_SAMPLE_ENV, 0.0, minimum=0.0)
+        if _LEDGER is not None and period and period > 0:
+            if _SAMPLER is None or not _SAMPLER.alive():
+                _SAMPLER = HbmSampler(period_ms=period)
+                _SAMPLER.start()
+        elif _SAMPLER is not None:
+            _SAMPLER.stop()
+            _SAMPLER = None
+        return _LEDGER
+
+
+def reset_for_tests() -> None:
+    """Drop the ledger, sampler, and the chokepoint-side program/key
+    caches, then re-read the knobs (test isolation)."""
+    global _LEDGER, _SAMPLER
+    with _config_lock:
+        if _SAMPLER is not None:
+            _SAMPLER.stop()
+            _SAMPLER = None
+        _LEDGER = None
+    with _fallback_lock:
+        _FALLBACK_KEYS.clear()
+    with _segment_lock:
+        _SEGMENT_EXES.clear()
+    configure()
+
+
+# ---------------------------------------------------------------------------
+# keys — stable across processes so gang shards merge
+# ---------------------------------------------------------------------------
+
+
+def _fn_name(fn: Callable) -> str:
+    return f"{getattr(fn, '__module__', '?')}.{getattr(fn, '__qualname__', repr(fn))}"
+
+
+def _static_repr(static: dict) -> str:
+    return ",".join(f"{k}={v!r}" for k, v in sorted(static.items()))
+
+
+def _leaf_aval(leaf) -> tuple:
+    shape = tuple(np.shape(leaf))
+    dtype = getattr(leaf, "dtype", None)
+    return (shape, str(dtype) if dtype is not None else type(leaf).__name__)
+
+
+def args_aval_key(args: tuple) -> tuple:
+    """Hashable (treedef-string, leaf avals) identity of an argument
+    pytree — the shard-stable stand-in for jax's own jit cache key."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (str(treedef), tuple(_leaf_aval(l) for l in leaves))
+
+
+def _avals_render(avals: tuple) -> str:
+    return ";".join(
+        "x".join(str(s) for s in shape) + f":{dt}" for shape, dt in avals[1]
+    )
+
+
+def ledger_key(
+    name: str, kind: str, static: str, spec: str, args_key: tuple
+) -> str:
+    """Deterministic entry key: human prefix + stable digest of the full
+    identity (same program in two gang members = same key, so shard
+    merging sums the right cells)."""
+    import hashlib
+
+    ident = f"{name}|{kind}|{static}|{spec}|{args_key!r}"
+    digest = hashlib.sha1(ident.encode()).hexdigest()[:10]
+    return f"{name}|{kind}|{spec}|{digest}"
+
+
+# ---------------------------------------------------------------------------
+# chokepoint helpers
+# ---------------------------------------------------------------------------
+
+
+def record_aot(
+    fn: Callable,
+    *,
+    name: str,
+    static: dict,
+    x_spec,
+    args: tuple,
+    compiled,
+    compile_seconds: float,
+    evicted: bool,
+) -> str:
+    """One bucketed AOT serving program (core/serving._get_program)."""
+    led = _LEDGER
+    if led is None:  # caller already checked; belt and braces
+        return ""
+    rows = int(x_spec.shape[0]) if len(x_spec.shape) else None
+    d = int(x_spec.shape[1]) if len(x_spec.shape) > 1 else 0
+    dtype = str(x_spec.dtype)
+    akey = args_aval_key(args)
+    static_r = _static_repr(static)
+    spec = "x".join(str(s) for s in x_spec.shape) + f":{dtype}"
+    family_key = (id(fn), static_r, d, dtype, akey, name)
+    cls = led.classify(family_key, name, rows, evicted=evicted, bucketed=True)
+    key = ledger_key(name, KIND_AOT, static_r, spec, akey)
+    return led.record(
+        key,
+        family=name,
+        kind=KIND_AOT,
+        static=static_r,
+        spec=spec,
+        rows=rows,
+        classification=cls,
+        compiled=compiled,
+        compile_seconds=compile_seconds,
+        index_key=(id(fn), static_r, rows, d, dtype, akey),
+    )
+
+
+#: (fn, static, aval key) -> ledger key for already-recorded fallback
+#: lowerings — one cost analysis per distinct shape, mirroring jit's
+#: own cache so the recording path never re-traces a warm shape.
+_FALLBACK_KEYS: Dict[tuple, str] = {}  # guarded-by: _fallback_lock
+_fallback_lock = threading.Lock()
+
+
+def record_fallback(
+    fn: Callable,
+    *,
+    name: str,
+    static: dict,
+    args: tuple,
+    lower: Callable[[], Any],
+) -> str:
+    """One plain-jit fallback program: cost analysis comes from the
+    LOWERING (``lower()`` thunk, called once per distinct shape) —
+    never a second XLA compile; memory analysis is marked unavailable
+    (the executable lives inside jit's cache, out of reach)."""
+    led = _LEDGER
+    if led is None:
+        return ""
+    akey = args_aval_key(args)
+    static_r = _static_repr(static)
+    cache_key = (id(fn), static_r, akey)
+    with _fallback_lock:
+        key = _FALLBACK_KEYS.get(cache_key)
+    if key is not None:
+        return key
+    rows = None
+    if args:
+        shape = np.shape(args[0])
+        rows = int(shape[0]) if shape else None
+    spec = _avals_render(akey)
+    family_key = (id(fn), static_r, akey, name)
+    cls = led.classify(family_key, name, rows, evicted=False, bucketed=False)
+    key = ledger_key(name, KIND_FALLBACK, static_r, spec, akey)
+    t0 = time.perf_counter()
+    try:
+        lowered = lower()
+    except Exception:
+        lowered = None
+    led.record(
+        key,
+        family=name,
+        kind=KIND_FALLBACK,
+        static=static_r,
+        spec=spec,
+        rows=rows,
+        classification=cls,
+        stage=lowered,
+        compile_seconds=time.perf_counter() - t0,
+    )
+    with _fallback_lock:
+        _FALLBACK_KEYS[cache_key] = key
+    return key
+
+
+#: (fn, static, aval key) -> (AOT executable, ledger key) for the
+#: segmented solver drivers — the ledger's own program cache, used
+#: ONLY when the ledger is enabled.
+_SEGMENT_EXES: Dict[tuple, tuple] = {}  # guarded-by: _segment_lock
+_segment_lock = threading.Lock()
+
+
+def _any_multi_device(tree) -> bool:
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                if len(sharding.device_set) > 1:
+                    return True
+            except AttributeError:
+                pass
+    return False
+
+
+def ledgered_call(fn: Callable, args: tuple, *, static: dict, name: str):
+    """Run a jitted solver-segment program, ledgered.
+
+    Disabled (the default): exactly ``fn(*args, **static)`` — the plain
+    jitted call, zero extra work, zero extra compiles. Enabled: the
+    segment is lowered + compiled ONCE per (fn, static, avals) through
+    jax's AOT path, its cost/memory analyses land in the ledger, and
+    every segment executes through that recorded executable (the same
+    XLA program the plain path would run — bit-identical outputs).
+    Mesh-sharded segment state keeps the plain jitted call (strict AOT
+    executables and live shardings don't mix) and is ledgered from the
+    lowering alone."""
+    led = _LEDGER
+    if led is None:
+        return fn(*args, **static)
+    if _any_multi_device(args):
+        key = record_fallback(
+            fn, name=name, static=static, args=args,
+            lower=lambda: fn.lower(*args, **static),
+        )
+        t0 = time.perf_counter()
+        out = fn(*args, **static)
+        led.note_invocation(key, time.perf_counter() - t0)
+        return out
+    akey = args_aval_key(args)
+    static_r = _static_repr(static)
+    cache_key = (id(fn), static_r, akey)
+    with _segment_lock:
+        cell = _SEGMENT_EXES.get(cache_key)
+    if cell is None:
+        spec = _avals_render(akey)
+        family_key = (id(fn), static_r, name)
+        rows0 = None
+        if args:
+            shape = np.shape(args[0])
+            rows0 = int(shape[0]) if shape else None
+        cls = led.classify(
+            family_key, name, rows0, evicted=False, bucketed=False
+        )
+        t0 = time.perf_counter()
+        exe = fn.lower(*args, **static).compile()
+        dt = time.perf_counter() - t0
+        key = ledger_key(name, KIND_SEGMENT, static_r, spec, akey)
+        led.record(
+            key,
+            family=name,
+            kind=KIND_SEGMENT,
+            static=static_r,
+            spec=spec,
+            rows=rows0,
+            classification=cls,
+            compiled=exe,
+            compile_seconds=dt,
+        )
+        with _segment_lock:
+            cell = _SEGMENT_EXES.setdefault(cache_key, (exe, key))
+    exe, key = cell
+    t0 = time.perf_counter()
+    out = exe(*args)
+    led.note_invocation(key, time.perf_counter() - t0)
+    return out
+
+
+def measured_request_bytes(
+    fn: Callable, static: dict, rows: int, d: int, dtype, args: tuple
+) -> Optional[int]:
+    """The ledgered ``temp + output`` bytes of the serving program for
+    this (kernel, static, bucket, features, dtype, weights) — or None
+    when the program has not compiled yet (or the backend reported no
+    memory analysis), in which case admission keeps the declared-spec
+    estimate."""
+    led = _LEDGER
+    if led is None:
+        return None
+    index_key = (
+        id(fn), _static_repr(static), int(rows), int(d), str(np.dtype(dtype)),
+        args_aval_key(args),
+    )
+    return led.measured_bytes(index_key)
+
+
+# ---------------------------------------------------------------------------
+# HBM watermark sampler
+# ---------------------------------------------------------------------------
+
+
+def _default_hbm_stats() -> Dict[str, Dict[str, int]]:
+    """{device id: {"bytes_in_use", "peak_bytes_in_use"}} for local
+    devices that report memory stats (TPU/GPU do; CPU returns {})."""
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out[str(getattr(dev, "id", len(out)))] = {
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(
+                stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+            ),
+        }
+    return out
+
+
+class HbmSampler:
+    """Opt-in daemon thread sampling device memory every ``period_ms``:
+    publishes the ``device.memory.in_use`` / ``device.memory.peak_bytes``
+    gauges continuously, feeds the ledger watermarks, and keeps a
+    bounded history of (perf_counter ts, totals) samples for span
+    attribution in fit reports. ``stats_fn`` is the test seam."""
+
+    MAX_SAMPLES = 4096
+
+    def __init__(
+        self,
+        period_ms: float,
+        stats_fn: Optional[Callable[[], Dict[str, Dict[str, int]]]] = None,
+    ):
+        self.period_s = max(float(period_ms), 1.0) / 1e3
+        self.stats_fn = stats_fn or _default_hbm_stats
+        self.samples: "deque[tuple]" = deque(maxlen=self.MAX_SAMPLES)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample_once(self) -> Optional[tuple]:
+        """Take one sample now (also the unit the thread loops on)."""
+        try:
+            stats = self.stats_fn()
+        except Exception:
+            return None
+        if not stats:
+            return None
+        in_use = sum(s.get("bytes_in_use", 0) for s in stats.values())
+        peak = sum(s.get("peak_bytes_in_use", 0) for s in stats.values())
+        led = _LEDGER
+        for dev, s in stats.items():
+            gauge("device.memory.in_use", "sampled device bytes in use").set(
+                s.get("bytes_in_use", 0), device=dev
+            )
+            gauge("device.memory.peak_bytes", "sampled device peak bytes").set(
+                s.get("peak_bytes_in_use", 0), device=dev
+            )
+            if led is not None:
+                led.observe_watermark(
+                    dev, s.get("bytes_in_use", 0), s.get("peak_bytes_in_use", 0)
+                )
+        cell = (time.perf_counter(), in_use, peak)
+        self.samples.append(cell)
+        return cell
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.sample_once()
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="tpuml-hbm-sampler", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def window(self, t0: float, t1: float) -> List[tuple]:
+        """Samples with perf_counter timestamps inside [t0, t1]."""
+        return [s for s in list(self.samples) if t0 <= s[0] <= t1]
+
+
+def sampler() -> Optional[HbmSampler]:
+    return _SAMPLER
+
+
+def attribute_hbm_growth(samples: List[tuple], spans: List[dict]) -> dict:
+    """Attribute peak-watermark growth between consecutive samples to
+    the deepest span whose [start, end] covers the later sample — the
+    fit-report delta that says WHICH stage grew device memory. Returns
+    {"peak_start", "peak_end", "delta", "by_span"} (empty dict when
+    fewer than two samples landed in the window)."""
+    if len(samples) < 2:
+        return {}
+    by_span: Dict[str, int] = {}
+    for (t_a, _, p_a), (t_b, _, p_b) in zip(samples, samples[1:]):
+        delta = p_b - p_a
+        if delta <= 0:
+            continue
+        best = None
+        for s in spans:
+            if s["start"] <= t_b <= s["end"]:
+                if best is None or s["depth"] > best["depth"]:
+                    best = s
+        name = best["name"] if best is not None else "<unattributed>"
+        by_span[name] = by_span.get(name, 0) + delta
+    return {
+        "peak_start": samples[0][2],
+        "peak_end": samples[-1][2],
+        "delta": samples[-1][2] - samples[0][2],
+        "by_span": by_span,
+    }
+
+
+# ---------------------------------------------------------------------------
+# roofline arithmetic + report rows
+# ---------------------------------------------------------------------------
+
+
+def device_peaks() -> Dict[str, Optional[float]]:
+    """Operator-declared device ceilings for utilization estimates
+    (``TPUML_PEAK_FLOPS`` / ``TPUML_PEAK_BYTES_PER_SEC``; None = not
+    declared — reports then show achieved rates + intensity only)."""
+    return {
+        "flops_per_sec": env_float(PEAK_FLOPS_ENV),
+        "bytes_per_sec": env_float(PEAK_BYTES_ENV),
+    }
+
+
+def roofline_row(entry_json: dict) -> dict:
+    """One entry's achieved-vs-analyzed view: analyzed flops/bytes per
+    invocation, achieved FLOP/s and bytes/s from the cumulative wall,
+    arithmetic intensity, and utilization fractions when the device
+    peaks are declared (the min of the two bounds is the roofline)."""
+    inv = entry_json.get("invocations") or 0
+    wall = entry_json.get("wall_seconds") or 0.0
+    flops = entry_json.get("flops")
+    byts = entry_json.get("bytes_accessed")
+    out = {
+        "key": entry_json.get("key"),
+        "family": entry_json.get("family"),
+        "kind": entry_json.get("kind"),
+        "invocations": inv,
+        "wall_seconds": wall,
+        "flops": flops,
+        "bytes_accessed": byts,
+        "intensity": (flops / byts) if flops and byts else None,
+        "achieved_flops_per_sec": None,
+        "achieved_bytes_per_sec": None,
+        "utilization": None,
+    }
+    if inv and wall > 0:
+        if flops is not None:
+            out["achieved_flops_per_sec"] = flops * inv / wall
+        if byts is not None:
+            out["achieved_bytes_per_sec"] = byts * inv / wall
+    peaks = device_peaks()
+    bounds = []
+    if peaks["flops_per_sec"] and out["achieved_flops_per_sec"] is not None:
+        bounds.append(out["achieved_flops_per_sec"] / peaks["flops_per_sec"])
+    if peaks["bytes_per_sec"] and out["achieved_bytes_per_sec"] is not None:
+        bounds.append(out["achieved_bytes_per_sec"] / peaks["bytes_per_sec"])
+    if bounds:
+        out["utilization"] = max(bounds)
+    return out
+
+
+def run_delta(base: Dict[str, Tuple[int, float, int]]) -> List[dict]:
+    """Per-program ledger traffic SINCE ``base`` (an
+    ``invocation_snapshot()`` taken at run start): the "where the FLOPs
+    and bytes went" rows a fit/transform report renders. Each row is a
+    :func:`roofline_row` over the run's invocation/wall delta, so the
+    achieved rates describe THIS run, not the process lifetime. Programs
+    untouched by the run are omitted; programs compiled during the run
+    appear even with zero completed invocations."""
+    led = _LEDGER
+    if led is None:
+        return []
+    rows: List[dict] = []
+    for e in led.entries():
+        inv0, wall0, rows0 = base.get(e.key, (0, 0.0, 0))
+        d_inv = e.invocations - inv0
+        if d_inv <= 0 and e.key in base:
+            continue
+        ej = e.to_json()
+        ej["invocations"] = d_inv
+        ej["wall_seconds"] = e.wall_seconds - wall0
+        row = roofline_row(ej)
+        row["rows_served"] = e.rows_served - rows0
+        row["spec"] = ej["spec"]
+        row["unavailable"] = ej["unavailable"]
+        rows.append(row)
+    rows.sort(key=lambda r: -(r.get("wall_seconds") or 0.0))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# serialization, validation, merging
+# ---------------------------------------------------------------------------
+
+
+def ledger_snapshot() -> Optional[dict]:
+    """The active ledger as a JSON-ready document (None when disabled)."""
+    led = _LEDGER
+    return led.snapshot() if led is not None else None
+
+
+def dump_ledger(path: str) -> Optional[str]:
+    """Write the active ledger document to ``path`` (None when the
+    ledger is disabled — nothing is written)."""
+    doc = ledger_snapshot()
+    if doc is None:
+        return None
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, default=str)
+        f.write("\n")
+    return path
+
+
+def validate_ledger(doc: Any) -> List[str]:
+    """Problems with one decoded ledger document (empty list = valid).
+    The one validator tests, CI, and ``tpuml_prof --validate`` share."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"ledger is {type(doc).__name__}, not an object"]
+    if doc.get("version") != LEDGER_VERSION:
+        problems.append(
+            f"version {doc.get('version')!r} != supported {LEDGER_VERSION}"
+        )
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        return problems + ["'entries' missing or not a list"]
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            problems.append(f"entry {i}: not an object")
+            continue
+        for f in ENTRY_FIELDS:
+            if f not in e:
+                problems.append(f"entry {i} ({e.get('key')}): missing {f!r}")
+        if e.get("flops") is None and "cost_analysis" not in (
+            e.get("unavailable") or []
+        ):
+            problems.append(
+                f"entry {i} ({e.get('key')}): no flops and no "
+                "'cost_analysis' unavailable marker"
+            )
+        if e.get("temp_bytes") is None and "memory_analysis" not in (
+            e.get("unavailable") or []
+        ):
+            problems.append(
+                f"entry {i} ({e.get('key')}): no memory fields and no "
+                "'memory_analysis' unavailable marker"
+            )
+    if not isinstance(doc.get("watermarks", {}), dict):
+        problems.append("'watermarks' is not an object")
+    return problems
+
+
+#: Entry fields summed across shards / processes at merge time.
+_SUM_FIELDS = (
+    "compiles", "compile_seconds", "invocations", "wall_seconds",
+    "rows_served",
+)
+
+
+def merge_ledger_docs(docs: List[dict]) -> dict:
+    """One cost view from N per-process ledger documents: entries join
+    on their stable key (run counters SUM; analyzed cost fields must
+    agree and the first non-None wins), watermarks take the per-device
+    MAX, retrace totals sum."""
+    entries: Dict[str, dict] = {}
+    watermarks: Dict[str, Dict[str, int]] = {}
+    retraces = {"total": 0, "families": {}}
+    for doc in docs:
+        for e in doc.get("entries", []):
+            key = e.get("key")
+            cell = entries.get(key)
+            if cell is None:
+                entries[key] = dict(e)
+                continue
+            for f in _SUM_FIELDS:
+                cell[f] = (cell.get(f) or 0) + (e.get(f) or 0)
+            for f in (
+                "flops", "transcendentals", "bytes_accessed",
+                "argument_bytes", "output_bytes", "temp_bytes",
+                "alias_bytes", "generated_code_bytes",
+            ):
+                if cell.get(f) is None:
+                    cell[f] = e.get(f)
+        for dev, cell in (doc.get("watermarks") or {}).items():
+            merged = watermarks.setdefault(dev, {"in_use": 0, "peak_bytes": 0})
+            for f in ("in_use", "peak_bytes"):
+                merged[f] = max(merged[f], int(cell.get(f, 0)))
+        r = doc.get("retraces") or {}
+        retraces["total"] += int(r.get("total", 0))
+        for fam, n in (r.get("families") or {}).items():
+            retraces["families"][fam] = retraces["families"].get(fam, 0) + n
+    return {
+        "version": LEDGER_VERSION,
+        "ts": time.time(),
+        "merged_from": len(docs),
+        "entries": sorted(
+            entries.values(), key=lambda e: -(e.get("wall_seconds") or 0)
+        ),
+        "watermarks": watermarks,
+        "retraces": retraces,
+        "peaks": device_peaks(),
+    }
+
+
+def load_ledger_dir(path: str) -> List[dict]:
+    """Decode every ``costs-*.json`` shard under a telemetry dir."""
+    import glob
+    import os
+
+    docs = []
+    for p in sorted(glob.glob(os.path.join(path, "costs-*.json"))):
+        with open(p) as f:
+            docs.append(json.load(f))
+    return docs
+
+
+def family_rollup(doc: dict) -> Dict[str, dict]:
+    """Per-family totals over a ledger document: programs, compiles,
+    invocations, total analyzed flops/bytes (× invocations), wall."""
+    out: Dict[str, dict] = {}
+    for e in doc.get("entries", []):
+        cell = out.setdefault(
+            e.get("family") or "?",
+            {
+                "programs": 0, "compiles": 0, "compile_seconds": 0.0,
+                "invocations": 0, "wall_seconds": 0.0, "rows_served": 0,
+                "total_flops": 0.0, "total_bytes": 0.0, "unavailable": 0,
+            },
+        )
+        cell["programs"] += 1
+        cell["compiles"] += e.get("compiles") or 0
+        cell["compile_seconds"] += e.get("compile_seconds") or 0.0
+        cell["invocations"] += e.get("invocations") or 0
+        cell["wall_seconds"] += e.get("wall_seconds") or 0.0
+        cell["rows_served"] += e.get("rows_served") or 0
+        inv = e.get("invocations") or 0
+        if e.get("flops") is not None:
+            cell["total_flops"] += e["flops"] * inv
+        if e.get("bytes_accessed") is not None:
+            cell["total_bytes"] += e["bytes_accessed"] * inv
+        if e.get("unavailable"):
+            cell["unavailable"] += 1
+    return out
+
+
+def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess
+    path = env_str(COST_DUMP_ENV)
+    if path and _LEDGER is not None:
+        try:
+            dump_ledger(path)
+        except OSError:
+            pass
+
+
+atexit.register(_dump_at_exit)
+configure()

@@ -93,6 +93,7 @@ SCHEMA: Dict[str, frozenset] = {
     "heartbeat": frozenset({"seq", "interval"}),
     "barrier": frozenset({"action", "attempt"}),
     "serving": frozenset({"action"}),
+    "compile": frozenset({"classification", "kernel"}),
     "report": frozenset({"kind", "summary"}),
     "profile": frozenset({"action", "dir"}),
     "distributed": frozenset({"action"}),
@@ -453,11 +454,25 @@ def flush_telemetry() -> Optional[str]:
         dump_snapshot(metrics_path)
     except OSError:  # pragma: no cover - best-effort snapshot
         metrics_path = None
+    # The cost-ledger shard rides the same dir (costs-<pid>.json) so a
+    # gang's per-member ledgers merge into one cost view (gang_report /
+    # tpuml_prof); written only when TPUML_COST_LEDGER is armed.
+    costs_path = None
+    try:
+        from spark_rapids_ml_tpu.observability import costs as _costs
+
+        if _costs.active() is not None:
+            costs_path = _costs.dump_ledger(
+                os.path.join(tele["dir"], f"costs-{pid}.json")
+            )
+    except Exception:  # pragma: no cover - best-effort shard
+        costs_path = None
     manifest = {
         "pid": pid,
         "process": _resolve_process_index(),
         "shard": os.path.basename(tele["shard"]),
         "metrics": os.path.basename(metrics_path) if metrics_path else None,
+        "costs": os.path.basename(costs_path) if costs_path else None,
         "trace_roots": roots,
         "emitted": emitted,
         # One (wall, mono) sample at a single instant — the merger's

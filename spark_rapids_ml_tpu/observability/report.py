@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from spark_rapids_ml_tpu.observability import costs as _costs
 from spark_rapids_ml_tpu.observability import events
 from spark_rapids_ml_tpu.observability.metrics import default_registry, gauge
 from spark_rapids_ml_tpu.observability.profiling import maybe_profile
@@ -115,6 +116,8 @@ class RunReport:
         counters: Dict[str, float],
         device_memory: Dict[str, Dict[str, int]],
         ok: bool = True,
+        costs: Optional[List[dict]] = None,
+        hbm: Optional[dict] = None,
     ):
         self.run_id = run_id
         self.kind = kind
@@ -124,6 +127,14 @@ class RunReport:
         self.counters = counters
         self.device_memory = device_memory
         self.ok = ok
+        #: Per-program cost-ledger rows for THIS run (costs.run_delta):
+        #: analyzed flops/bytes, invocation/wall deltas, achieved rates,
+        #: roofline utilization when device peaks are declared. Empty
+        #: when TPUML_COST_LEDGER is off.
+        self.costs = costs or []
+        #: HBM watermark growth attributed to spans (costs.
+        #: attribute_hbm_growth); empty without the sampler.
+        self.hbm = hbm or {}
 
     def stage_tree(self) -> List[dict]:
         return build_stage_tree(self.spans)
@@ -142,8 +153,13 @@ class RunReport:
             k: v for k, v in self.counters.items() if k.startswith("checkpoint.")
         }
 
+    def cost_table(self) -> List[dict]:
+        """The run's per-program flops/bytes attribution (empty when the
+        cost ledger is off) — see ``observability/costs.py``."""
+        return self.costs
+
     def summary(self) -> dict:
-        return {
+        out = {
             "run_id": self.run_id,
             "kind": self.kind,
             "label": self.label,
@@ -155,6 +171,11 @@ class RunReport:
             "checkpoint": self.checkpoint_activity(),
             "device_memory": self.device_memory,
         }
+        if self.costs:
+            out["costs"] = self.costs
+        if self.hbm:
+            out["hbm"] = self.hbm
+        return out
 
     def _render_tree(self, nodes: List[dict], indent: int, lines: List[str]) -> None:
         for n in nodes:
@@ -184,6 +205,36 @@ class RunReport:
                 lines.append(
                     f"  device {dev}: {stats['bytes_in_use']} bytes in use"
                 )
+        if self.costs:
+            lines.append("  where the FLOPs and bytes went:")
+            lines.append(
+                f"    {'program':<40s} {'kind':<8s} {'calls':>6s} "
+                f"{'flops/call':>12s} {'bytes/call':>12s} {'wall ms':>9s} "
+                f"{'GFLOP/s':>8s} {'util':>6s}"
+            )
+            for row in self.costs:
+                flops = row.get("flops")
+                byts = row.get("bytes_accessed")
+                rate = row.get("achieved_flops_per_sec")
+                util = row.get("utilization")
+                lines.append(
+                    f"    {str(row.get('family'))[:40]:<40s} "
+                    f"{str(row.get('kind')):<8s} "
+                    f"{row.get('invocations', 0):>6d} "
+                    f"{(f'{flops:.3g}' if flops is not None else 'n/a'):>12s} "
+                    f"{(f'{byts:.3g}' if byts is not None else 'n/a'):>12s} "
+                    f"{(row.get('wall_seconds') or 0.0) * 1e3:>9.2f} "
+                    f"{(f'{rate / 1e9:.2f}' if rate else '-'):>8s} "
+                    f"{(f'{util:.1%}' if util is not None else '-'):>6s}"
+                )
+        if self.hbm.get("by_span"):
+            lines.append(
+                f"  HBM peak growth: {self.hbm.get('delta', 0)} bytes"
+            )
+            for span_name, grew in sorted(
+                self.hbm["by_span"].items(), key=lambda kv: -kv[1]
+            ):
+                lines.append(f"    {span_name:<40s} +{grew} bytes")
         return "\n".join(lines)
 
 
@@ -209,7 +260,12 @@ class RunRecorder:
         self._ctx = self._scope.__enter__()
         self._span_start = self._ctx.span_count()
         self._t0 = time.monotonic()
+        self._t0_perf = time.perf_counter()
         self._counters0 = default_registry.counters_snapshot()
+        ledger = _costs.active()
+        self._ledger0 = (
+            ledger.invocation_snapshot() if ledger is not None else None
+        )
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -223,6 +279,14 @@ class RunRecorder:
                 if k.startswith(_REPORT_PREFIXES)
                 and v != self._counters0.get(k, 0)
             }
+            cost_rows: List[dict] = []
+            hbm: dict = {}
+            if self._ledger0 is not None and _costs.active() is not None:
+                cost_rows = _costs.run_delta(self._ledger0)
+                smp = _costs.sampler()
+                if smp is not None:
+                    window = smp.window(self._t0_perf, time.perf_counter())
+                    hbm = _costs.attribute_hbm_growth(window, spans)
             self.report = RunReport(
                 run_id=self._ctx.run_id,
                 kind=self.kind,
@@ -232,6 +296,8 @@ class RunRecorder:
                 counters=delta,
                 device_memory=device_memory_stats(),
                 ok=exc_type is None,
+                costs=cost_rows,
+                hbm=hbm,
             )
             if events.enabled():
                 events.emit("counters", counters=delta, kind=self.kind,
@@ -279,6 +345,12 @@ def serving_report() -> dict:
         "counters": counters,
         "batch_rows": hist,
     }
+    ledger_doc = _costs.ledger_snapshot()
+    if ledger_doc is not None:
+        # The steady-state "where the FLOPs and bytes went" section:
+        # the full per-program ledger plus its per-family rollup.
+        out["costs"] = ledger_doc
+        out["cost_rollup"] = _costs.family_rollup(ledger_doc)
     try:
         from spark_rapids_ml_tpu.serving import batcher as _batcher
         from spark_rapids_ml_tpu.serving.server import runtime_snapshots
@@ -339,7 +411,7 @@ def gang_report(telemetry_dir: Optional[str] = None) -> dict:
                 "gauges": snap.get("gauges", {}),
             }
         )
-    return {
+    out = {
         "dir": tdir,
         "members": members,
         "merged": merged["metrics"]["merged"],
@@ -347,3 +419,12 @@ def gang_report(telemetry_dir: Optional[str] = None) -> dict:
         "problems": merged["problems"] + merged["orphan_problems"],
         "warnings": merged["warnings"],
     }
+    cost_docs = _costs.load_ledger_dir(tdir)
+    if cost_docs:
+        # Per-member cost shards merged into ONE gang cost view:
+        # run counters sum, HBM watermarks take the per-device max.
+        out["costs"] = {
+            "members": len(cost_docs),
+            "merged": _costs.merge_ledger_docs(cost_docs),
+        }
+    return out

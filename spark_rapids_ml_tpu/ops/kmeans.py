@@ -269,6 +269,7 @@ def lloyd_resumable(
     )
     import time
 
+    from spark_rapids_ml_tpu.observability.costs import ledgered_call
     from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
     from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
@@ -300,10 +301,13 @@ def lloyd_resumable(
             break
         seg_t0 = time.perf_counter()
         with TraceRange("segment kmeans.lloyd", TraceColor.PURPLE):
-            state = _lloyd_segment(
-                x, mask, *state, tol,
-                max_iter=max_iter, every=checkpointer.every,
-                precision=precision, cosine=cosine, block_rows=block_rows,
+            state = ledgered_call(
+                _lloyd_segment, (x, mask, *state, tol),
+                static=dict(
+                    max_iter=max_iter, every=checkpointer.every,
+                    precision=precision, cosine=cosine, block_rows=block_rows,
+                ),
+                name="kmeans.lloyd.segment",
             )
             bump_counter("checkpoint.segments")
             # int() blocks on the segment's device work, so the range —

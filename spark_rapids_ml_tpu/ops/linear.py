@@ -280,6 +280,7 @@ def solve_elastic_net_resumable(
     )
     import time
 
+    from spark_rapids_ml_tpu.observability.costs import ledgered_call
     from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
     from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
@@ -305,9 +306,10 @@ def solve_elastic_net_resumable(
             break
         seg_t0 = time.perf_counter()
         with TraceRange("segment linear.enet", TraceColor.PURPLE):
-            carry = _enet_segment(
-                a_quad, b_lin, l1, lip, tol, *carry,
-                max_iter=max_iter, every=checkpointer.every,
+            carry = ledgered_call(
+                _enet_segment, (a_quad, b_lin, l1, lip, tol, *carry),
+                static=dict(max_iter=max_iter, every=checkpointer.every),
+                name="linear.enet.segment",
             )
             bump_counter("checkpoint.segments")
             bump_counter("checkpoint.solver_iters", int(carry[3]) - it)

@@ -345,6 +345,7 @@ def fit_logistic_resumable(
     )
     import time
 
+    from spark_rapids_ml_tpu.observability.costs import ledgered_call
     from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
     from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
@@ -403,11 +404,15 @@ def fit_logistic_resumable(
             break
         seg_t0 = time.perf_counter()
         with TraceRange("segment logistic.lbfgs", TraceColor.PURPLE):
-            params, opt_state, it_a, gn_a = _lbfgs_segment(
-                x, y_target, mask, offset, scale, n,
-                reg_param, tol, carry[0], carry[1], carry[2], carry[3],
-                c=c, fit_intercept=fit_intercept, max_iter=max_iter,
-                every=checkpointer.every, precision=precision,
+            params, opt_state, it_a, gn_a = ledgered_call(
+                _lbfgs_segment,
+                (x, y_target, mask, offset, scale, n,
+                 reg_param, tol, carry[0], carry[1], carry[2], carry[3]),
+                static=dict(
+                    c=c, fit_intercept=fit_intercept, max_iter=max_iter,
+                    every=checkpointer.every, precision=precision,
+                ),
+                name="logistic.lbfgs.segment",
             )
             carry = (params, opt_state, it_a, gn_a)
             bump_counter("checkpoint.segments")

@@ -338,6 +338,7 @@ def optimize_layout_resumable(
     from spark_rapids_ml_tpu.robustness.checkpoint import segment_boundary
     import time
 
+    from spark_rapids_ml_tpu.observability.costs import ledgered_call
     from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
     from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
@@ -351,11 +352,15 @@ def optimize_layout_resumable(
         stop = min(start + checkpointer.every, n_epochs)
         seg_t0 = time.perf_counter()
         with TraceRange("segment umap.layout", TraceColor.PURPLE):
-            y, kd = _layout_segment(
-                y, kd, jnp.asarray(start), jnp.asarray(stop), graph,
-                learning_rate, repulsion, a, b, target,
-                n_epochs=n_epochs, neg_rate=neg_rate, neg_pool=neg_pool,
-                move_other=move_other,
+            y, kd = ledgered_call(
+                _layout_segment,
+                (y, kd, jnp.asarray(start), jnp.asarray(stop), graph,
+                 learning_rate, repulsion, a, b, target),
+                static=dict(
+                    n_epochs=n_epochs, neg_rate=neg_rate, neg_pool=neg_pool,
+                    move_other=move_other,
+                ),
+                name="umap.layout.segment",
             )
             ep = jnp.asarray(stop)
             bump_counter("checkpoint.segments")

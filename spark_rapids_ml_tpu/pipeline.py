@@ -16,6 +16,7 @@ from spark_rapids_ml_tpu.core.estimator import Estimator, Model, Transformer
 from spark_rapids_ml_tpu.core.persistence import (
     MLReadable,
     load_metadata,
+    resolve_component_class,
     resolve_persisted_class,
     save_metadata,
 )
@@ -44,14 +45,24 @@ def save_stages(owner, path: str, stages: List[Any], class_name: str) -> None:
 
 
 def load_stages(path: str, expected_class: str):
-    """Load (metadata, stages) written by :func:`save_stages`."""
+    """Load (metadata, stages) written by :func:`save_stages` — or by
+    upstream Spark's ``Pipeline.SharedReadWrite``, whose metadata puts
+    ``stageUids`` inside ``paramMap`` and records NO python class paths
+    (each stage directory's own metadata ``class`` — a JVM name — is the
+    only type information; ``resolve_component_class`` maps it)."""
     metadata = load_metadata(path, expected_class=expected_class)
+    uids = metadata.get("stageUids")
+    if uids is None:
+        uids = metadata.get("paramMap", {}).get("stageUids", [])
+    classes = metadata.get("stageClasses")
     stages: List[Any] = []
-    for i, (uid, class_path) in enumerate(
-        zip(metadata.get("stageUids", []), metadata.get("stageClasses", []))
-    ):
-        klass = resolve_persisted_class(class_path)
-        stages.append(klass.load(os.path.join(path, "stages", f"{i}_{uid}")))
+    for i, uid in enumerate(uids):
+        stage_path = os.path.join(path, "stages", f"{i}_{uid}")
+        if classes:
+            klass = resolve_persisted_class(classes[i])
+        else:
+            klass = resolve_component_class(stage_path)
+        stages.append(klass.load(stage_path))
     return metadata, stages
 
 

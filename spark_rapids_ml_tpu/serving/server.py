@@ -26,6 +26,7 @@ from typing import Any, Iterable, List, Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.core.serving import _compute_dtype, bucket_rows
+from spark_rapids_ml_tpu.observability import costs as _costs
 from spark_rapids_ml_tpu.observability.events import (
     begin_trace,
     current_trace_context,
@@ -216,9 +217,22 @@ class ServingRuntime:
         xh = np.ascontiguousarray(xh, dtype=dtype)
         n = int(xh.shape[0])
         bucket = bucket_rows(max(n, 1))
-        cost = bucket * sig.n_features * dtype.itemsize + spec_bytes(
-            sig.output_spec(bucket, dtype)
+        # Admission pricing: once the bucket's program has compiled under
+        # the cost ledger, its MEASURED temp+output bytes (what XLA
+        # actually allocates per execution) replace the declared-spec
+        # estimate — the observation→budget loop of "Memory Safe
+        # Computations with XLA" closed with measurements.
+        measured = _costs.measured_request_bytes(
+            sig.kernel, sig.static, bucket, sig.n_features, dtype, sig.weights
         )
+        if measured is not None:
+            cost = measured
+            bump_counter("serving.admission.measured")
+        else:
+            cost = bucket * sig.n_features * dtype.itemsize + spec_bytes(
+                sig.output_spec(bucket, dtype)
+            )
+            bump_counter("serving.admission.declared")
         timeout_ms = float(timeout) * 1e3 if timeout is not None else 0.0
         # The submit→dispatcher-thread hop carries the caller's trace (or
         # roots a fresh one per request) via the Request itself — the

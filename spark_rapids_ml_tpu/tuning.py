@@ -22,6 +22,7 @@ from spark_rapids_ml_tpu.core.estimator import Estimator, Model
 from spark_rapids_ml_tpu.core.params import Param, Params, toFloat, toInt
 from spark_rapids_ml_tpu.core.persistence import (
     load_metadata,
+    resolve_component_class,
     resolve_persisted_class,
     save_metadata,
 )
@@ -39,9 +40,18 @@ def _save_best_model(owner, path: str, class_name: str, extra: dict) -> None:
 
 
 def _load_best_model(path: str, expected_class: str):
+    """(metadata, bestModel) — ``bestModelClass`` when our writer
+    recorded it; an upstream-Spark directory has no such key, so the
+    bestModel subdirectory's own metadata class (a JVM name) picks the
+    loader instead (``resolve_component_class``)."""
     metadata = load_metadata(path, expected_class=expected_class)
-    klass = resolve_persisted_class(metadata["bestModelClass"])
-    return metadata, klass.load(os.path.join(path, "bestModel"))
+    best_path = os.path.join(path, "bestModel")
+    class_path = metadata.get("bestModelClass")
+    if class_path:
+        klass = resolve_persisted_class(class_path)
+    else:
+        klass = resolve_component_class(best_path)
+    return metadata, klass.load(best_path)
 
 
 class ParamGridBuilder:

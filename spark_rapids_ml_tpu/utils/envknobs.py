@@ -114,6 +114,22 @@ KNOBS: Dict[str, Knob] = _knob_table(
     Knob("TPUML_TRACE_PARENT", "str", "observability",
          "trace-context carrier: the launcher span id this process's "
          "root spans parent to"),
+    # program cost ledger & profiling
+    Knob("TPUML_COST_LEDGER", "choice", "observability",
+         "1 records XLA cost/memory analyses for every compiled program",
+         default="0", choices=("0", "1")),
+    Knob("TPUML_COST_LEDGER_DUMP", "str", "observability",
+         "write the cost-ledger JSON document here at interpreter exit"),
+    Knob("TPUML_HBM_SAMPLE_EVERY_MS", "float", "observability",
+         "HBM watermark sampler period in ms (0 = off; needs the ledger)",
+         default=0.0),
+    Knob("TPUML_RETRACE_STORM", "int", "observability",
+         "unexpected retraces per program family before the storm warning",
+         default=3),
+    Knob("TPUML_PEAK_FLOPS", "float", "observability",
+         "declared device peak FLOP/s for roofline utilization estimates"),
+    Knob("TPUML_PEAK_BYTES_PER_SEC", "float", "observability",
+         "declared device peak HBM bytes/s for roofline utilization"),
     # serving-path program cache
     Knob("TPUML_SERVING_CACHE_SIZE", "int", "serving",
          "bound on the AOT executable LRU (entries per process)",

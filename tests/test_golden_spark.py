@@ -561,6 +561,195 @@ class TestLoadSparkWrittenForests:
         np.testing.assert_allclose(model.predictProbability(x), expect, atol=1e-6)
 
 
+class TestCompositeGoldenLayouts:
+    """Upstream Spark's COMPOSITE writers (Pipeline.SharedReadWrite,
+    CrossValidatorModel) record no python class paths: ``stageUids``
+    lives inside ``paramMap``, stage type information exists only as
+    each nested directory's own JVM metadata class, and the winning
+    model sits bare under ``bestModel/``. Directories byte-constructed
+    in that exact shape must load here (ROADMAP item 5c)."""
+
+    def _golden_pca_stage(self, path, pc, ev, uid="PCAModel_stage0"):
+        os.makedirs(path)
+        _write_spark_metadata(
+            path, "org.apache.spark.ml.feature.PCAModel", uid, {"k": pc.shape[1]}
+        )
+        schema = pa.schema(
+            [("pc", _SPARK_MATRIX), ("explainedVariance", _SPARK_VECTOR)]
+        )
+        _write_spark_parquet(
+            path,
+            schema,
+            [{"pc": _matrix_struct(pc), "explainedVariance": _vector_struct(ev)}],
+            "{}",
+        )
+
+    def _golden_linreg_stage(self, path, coef, intercept, uid="LinearRegressionModel_stage1"):
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.regression.LinearRegressionModel",
+            uid,
+            {},
+        )
+        schema = pa.schema(
+            [("intercept", pa.float64()), ("coefficients", _SPARK_VECTOR)]
+        )
+        _write_spark_parquet(
+            path,
+            schema,
+            [{"intercept": float(intercept), "coefficients": _vector_struct(coef)}],
+            "{}",
+        )
+
+    def test_pipeline_model_golden(self, tmp_path, rng):
+        """A Spark-written PipelineModel dir — paramMap.stageUids, no
+        stageClasses, JVM class names in the stage metadata — loads and
+        transforms end to end."""
+        from spark_rapids_ml_tpu.pipeline import PipelineModel
+
+        pc = rng.normal(size=(5, 2))
+        ev = np.array([0.7, 0.2])
+        coef = rng.normal(size=2)
+        path = str(tmp_path / "spark_pipeline")
+        os.makedirs(path)
+        uids = ["PCAModel_stage0", "LinearRegressionModel_stage1"]
+        # Spark's SharedReadWrite: stageUids INSIDE paramMap, nothing else.
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.PipelineModel",
+            "PipelineModel_golden",
+            {"stageUids": uids},
+        )
+        self._golden_pca_stage(
+            os.path.join(path, "stages", f"0_{uids[0]}"), pc, ev, uid=uids[0]
+        )
+        self._golden_linreg_stage(
+            os.path.join(path, "stages", f"1_{uids[1]}"), coef, 1.5, uid=uids[1]
+        )
+
+        model = PipelineModel.load(path)
+        assert len(model.stages) == 2
+        x = rng.normal(size=(8, 5))
+        out = np.asarray(model.transform(x))
+        # PCA projection then the linear head, exactly as Spark composes.
+        np.testing.assert_allclose(out, x @ pc @ coef + 1.5, atol=1e-6)
+
+    def test_pipeline_model_roundtrip_ours(self, tmp_path, rng):
+        """Our own writer's layout keeps loading too (stageClasses path),
+        and the written metadata carries the stage bookkeeping Spark's
+        reader keys on."""
+        from spark_rapids_ml_tpu.pipeline import PipelineModel
+        from spark_rapids_ml_tpu.regression import LinearRegression
+
+        x = rng.normal(size=(60, 5))
+        pca_model = PCA().setK(3).fit(x)
+        y = np.asarray(pca_model.transform(x)) @ rng.normal(size=3) + 2.0
+        lr_model = LinearRegression().fit((np.asarray(pca_model.transform(x)), y))
+        model = PipelineModel(None, [pca_model, lr_model])
+        path = str(tmp_path / "ours_pipeline")
+        model.write.overwrite().save(path)
+        with open(os.path.join(path, "metadata", "part-00000")) as f:
+            meta = json.loads(f.readline())
+        assert meta["stageUids"] == [s.uid for s in model.stages]
+        assert len(meta["stageClasses"]) == 2
+
+        loaded = PipelineModel.load(path)
+        np.testing.assert_allclose(
+            np.asarray(loaded.transform(x)), np.asarray(model.transform(x)),
+            atol=1e-6,
+        )
+
+    def test_cross_validator_model_golden(self, tmp_path, rng):
+        """A Spark-written CrossValidatorModel dir — avgMetrics in the
+        metadata, the winner bare under bestModel/ with only its JVM
+        class — loads with metrics intact and a servable bestModel."""
+        from spark_rapids_ml_tpu.tuning import CrossValidatorModel
+
+        coef = rng.normal(size=4)
+        path = str(tmp_path / "spark_cv")
+        os.makedirs(path)
+        _write_spark_metadata(
+            path,
+            "org.apache.spark.ml.tuning.CrossValidatorModel",
+            "CrossValidatorModel_golden",
+            {"numFolds": 3},
+        )
+        # avgMetrics land top-level (Spark's extraMetadata), not in paramMap.
+        meta_file = os.path.join(path, "metadata", "part-00000")
+        with open(meta_file) as f:
+            meta = json.loads(f.readline())
+        meta["avgMetrics"] = [0.81, 0.93, 0.77]
+        meta["bestIndex"] = 1
+        with open(meta_file, "w") as f:
+            f.write(json.dumps(meta) + "\n")
+
+        best = os.path.join(path, "bestModel")
+        os.makedirs(best)
+        _write_spark_metadata(
+            best,
+            "org.apache.spark.ml.classification.LogisticRegressionModel",
+            "LogisticRegressionModel_best",
+            {"threshold": 0.5},
+        )
+        schema = pa.schema(
+            [
+                ("numClasses", pa.int32()),
+                ("numFeatures", pa.int32()),
+                ("interceptVector", _SPARK_VECTOR),
+                ("coefficientMatrix", _SPARK_MATRIX),
+                ("isMultinomial", pa.bool_()),
+            ]
+        )
+        _write_spark_parquet(
+            best,
+            schema,
+            [
+                {
+                    "numClasses": 2,
+                    "numFeatures": 4,
+                    "interceptVector": _vector_struct([0.25]),
+                    "coefficientMatrix": _matrix_struct(coef[None, :]),
+                    "isMultinomial": False,
+                }
+            ],
+            "{}",
+        )
+
+        model = CrossValidatorModel.load(path)
+        assert model.avgMetrics == [0.81, 0.93, 0.77]
+        assert model.bestIndex == 1
+        np.testing.assert_allclose(model.bestModel.coefficients, coef)
+        x = rng.normal(size=(6, 4))
+        expect = 1.0 / (1.0 + np.exp(-(x @ coef + 0.25)))
+        np.testing.assert_allclose(
+            model.bestModel.predictProbability(x)[:, 1], expect, atol=1e-6
+        )
+
+    def test_cross_validator_model_roundtrip_ours(self, tmp_path, rng):
+        """write -> load through our own layout: metrics, bestIndex, and
+        bit-equal bestModel predictions survive."""
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+        from spark_rapids_ml_tpu.tuning import CrossValidatorModel
+
+        x = rng.normal(size=(80, 3))
+        y = (x[:, 0] > 0).astype(float)
+        best = LogisticRegression().setMaxIter(40).fit((x, y))
+        model = CrossValidatorModel(
+            None, best, avgMetrics=[0.5, 0.9], bestIndex=1
+        )
+        path = str(tmp_path / "ours_cv")
+        model.write.overwrite().save(path)
+        loaded = CrossValidatorModel.load(path)
+        assert loaded.avgMetrics == [0.5, 0.9]
+        assert loaded.bestIndex == 1
+        np.testing.assert_allclose(
+            loaded.bestModel.predictProbability(x),
+            best.predictProbability(x),
+            atol=1e-8,
+        )
+
+
 class TestWrittenFormatIsSparkShaped:
     """The reverse direction: what this framework writes must be exactly
     the structural schema Spark's readers parse."""

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Render, validate, merge, and diff spark-tpu-ml program cost ledgers.
+
+A ledger document is what ``TPUML_COST_LEDGER=1`` captures
+(``observability/costs.py``): per compiled program, XLA's
+``cost_analysis`` / ``memory_analysis`` plus cumulative invocation and
+wall counters. Sources: a single JSON file (``TPUML_COST_LEDGER_DUMP``)
+or a telemetry directory of per-process ``costs-<pid>.json`` shards
+(``TPUML_TELEMETRY_DIR``), which are merged first (counters sum, HBM
+watermarks max).
+
+Modes::
+
+    tpuml_prof.py LEDGER                 # top-K programs + family rollup
+    tpuml_prof.py LEDGER --sort flops    # order by flops|bytes|wall
+    tpuml_prof.py LEDGER --validate      # schema gate: exit 1 on problems
+    tpuml_prof.py --diff OLD NEW --max-regress 25
+                                         # CI perf gate: exit 1 when a
+                                         # family's total flops or bytes
+                                         # grew more than 25%
+
+``--diff`` compares per-family TOTALS (analyzed flops/bytes × run
+invocations) so it gates what the workload actually executed, not just
+what got compiled; wall seconds are reported but never gated (they
+measure the machine, not the program). Families that appear or
+disappear are reported as notes, not failures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+
+def _import_costs():
+    """The ledger helpers — importable both with the package installed
+    and when this script runs straight from a checkout."""
+    try:
+        from spark_rapids_ml_tpu.observability import costs
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from spark_rapids_ml_tpu.observability import costs
+    return costs
+
+
+def load_ledger(path: str) -> Tuple[dict, List[str]]:
+    """Decode a ledger source: a JSON document, or a directory of
+    ``costs-*.json`` shards (merged). Returns (doc, problems)."""
+    costs = _import_costs()
+    if os.path.isdir(path):
+        docs = costs.load_ledger_dir(path)
+        if not docs:
+            return {}, [f"no costs-*.json shards under {path}"]
+        problems: List[str] = []
+        for i, doc in enumerate(docs):
+            problems.extend(f"shard {i}: {p}" for p in costs.validate_ledger(doc))
+        return costs.merge_ledger_docs(docs), problems
+    with open(path) as f:
+        doc = json.load(f)
+    return doc, costs.validate_ledger(doc)
+
+
+_SORT_FIELDS = {
+    "flops": lambda e: (e.get("flops") or 0.0) * (e.get("invocations") or 0),
+    "bytes": lambda e: (e.get("bytes_accessed") or 0.0)
+    * (e.get("invocations") or 0),
+    "wall": lambda e: e.get("wall_seconds") or 0.0,
+}
+
+
+def render(doc: dict, sort: str = "wall", top: int = 20) -> str:
+    """Human dump: top-K programs by the sort key + per-family rollup,
+    watermarks, and retrace-watchdog summary."""
+    costs = _import_costs()
+    key_fn = _SORT_FIELDS[sort]
+    entries = sorted(doc.get("entries", []), key=key_fn, reverse=True)
+    lines = [
+        f"{len(entries)} programs"
+        + (f" (merged from {doc['merged_from']} shards)"
+           if doc.get("merged_from") else ""),
+        f"top {min(top, len(entries))} by {sort}:",
+        f"  {'program':<46s} {'kind':<8s} {'calls':>6s} {'flops/call':>11s} "
+        f"{'bytes/call':>11s} {'wall s':>8s} {'compile s':>9s}",
+    ]
+    for e in entries[:top]:
+        flops = e.get("flops")
+        byts = e.get("bytes_accessed")
+        marker = " !" + ",".join(e["unavailable"]) if e.get("unavailable") else ""
+        lines.append(
+            f"  {str(e.get('key'))[:46]:<46s} {str(e.get('kind')):<8s} "
+            f"{e.get('invocations', 0):>6d} "
+            f"{(f'{flops:.3g}' if flops is not None else 'n/a'):>11s} "
+            f"{(f'{byts:.3g}' if byts is not None else 'n/a'):>11s} "
+            f"{e.get('wall_seconds', 0.0):>8.3f} "
+            f"{e.get('compile_seconds', 0.0):>9.3f}{marker}"
+        )
+    rollup = costs.family_rollup(doc)
+    if rollup:
+        lines.append("per-family rollup:")
+        lines.append(
+            f"  {'family':<28s} {'progs':>5s} {'compiles':>8s} {'calls':>7s} "
+            f"{'total flops':>12s} {'total bytes':>12s} {'wall s':>8s}"
+        )
+        for fam, cell in sorted(
+            rollup.items(), key=lambda kv: -kv[1]["wall_seconds"]
+        ):
+            lines.append(
+                f"  {fam[:28]:<28s} {cell['programs']:>5d} "
+                f"{cell['compiles']:>8d} {cell['invocations']:>7d} "
+                f"{cell['total_flops']:>12.4g} {cell['total_bytes']:>12.4g} "
+                f"{cell['wall_seconds']:>8.3f}"
+            )
+    watermarks = doc.get("watermarks") or {}
+    for dev, cell in sorted(watermarks.items()):
+        lines.append(
+            f"device {dev}: peak {cell.get('peak_bytes', 0)} bytes, "
+            f"in-use watermark {cell.get('in_use', 0)} bytes"
+        )
+    retraces = doc.get("retraces") or {}
+    if retraces.get("total"):
+        lines.append(f"RETRACES: {retraces['total']} unexpected recompiles")
+        for fam, n in sorted((retraces.get("families") or {}).items()):
+            lines.append(f"  {fam}: {n}")
+    return "\n".join(lines)
+
+
+#: Family-rollup dimensions the diff GATES (deterministic program
+#: analyses × workload invocations); wall time is report-only.
+GATED_DIMS = ("total_flops", "total_bytes")
+
+
+def diff_ledgers(
+    old_doc: dict, new_doc: dict, max_regress_pct: float
+) -> Tuple[List[str], List[str]]:
+    """Compare per-family totals. Returns (regressions, notes):
+    ``regressions`` non-empty means the gate fails."""
+    costs = _import_costs()
+    old = costs.family_rollup(old_doc)
+    new = costs.family_rollup(new_doc)
+    regressions: List[str] = []
+    notes: List[str] = []
+    for fam in sorted(set(old) | set(new)):
+        if fam not in old:
+            notes.append(f"new family {fam!r} (no baseline)")
+            continue
+        if fam not in new:
+            notes.append(f"family {fam!r} disappeared")
+            continue
+        for dim in GATED_DIMS:
+            o, n = old[fam][dim], new[fam][dim]
+            if o <= 0:
+                if n > 0:
+                    notes.append(f"{fam}.{dim}: baseline 0, now {n:.4g}")
+                continue
+            growth = (n - o) / o * 100.0
+            if growth > max_regress_pct:
+                regressions.append(
+                    f"{fam}.{dim}: {o:.4g} -> {n:.4g} "
+                    f"(+{growth:.1f}% > {max_regress_pct:g}%)"
+                )
+        o_w, n_w = old[fam]["wall_seconds"], new[fam]["wall_seconds"]
+        if o_w > 0 and n_w > o_w * 2:
+            notes.append(
+                f"{fam}.wall_seconds: {o_w:.3f} -> {n_w:.3f} (not gated)"
+            )
+    return regressions, notes
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "path", nargs="?", default=None,
+        help="ledger JSON file or telemetry dir of costs-<pid>.json shards",
+    )
+    parser.add_argument("--sort", choices=sorted(_SORT_FIELDS), default="wall")
+    parser.add_argument("--top", type=int, default=20)
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument(
+        "--validate", action="store_true",
+        help="exit 1 when the document fails schema validation",
+    )
+    parser.add_argument(
+        "--diff", nargs=2, metavar=("OLD", "NEW"), default=None,
+        help="compare two ledgers' per-family totals",
+    )
+    parser.add_argument(
+        "--max-regress", type=float, default=10.0,
+        help="allowed per-family growth in gated dims, percent (with --diff)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.diff is not None:
+        old_doc, old_problems = load_ledger(args.diff[0])
+        new_doc, new_problems = load_ledger(args.diff[1])
+        for p in old_problems + new_problems:
+            print(f"INVALID {p}", file=sys.stderr)
+        if old_problems or new_problems:
+            return 2
+        regressions, notes = diff_ledgers(old_doc, new_doc, args.max_regress)
+        for n in notes:
+            print(f"note: {n}")
+        for r in regressions:
+            print(f"REGRESSION {r}", file=sys.stderr)
+        if not regressions:
+            print(f"ok: no family regressed more than {args.max_regress:g}%")
+        return 1 if regressions else 0
+
+    if args.path is None:
+        parser.error("a ledger path is required unless --diff is given")
+    doc, problems = load_ledger(args.path)
+    for p in problems:
+        print(f"INVALID {p}", file=sys.stderr)
+    if args.validate and problems:
+        return 1
+    if args.format == "json":
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        print(render(doc, sort=args.sort, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
