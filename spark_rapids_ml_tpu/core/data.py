@@ -25,10 +25,25 @@ from typing import Any, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from spark_rapids_ml_tpu.utils.envknobs import env_int
+
 try:  # scipy is available in the image; gate anyway for safety
     import scipy.sparse as _sp
 except ImportError:  # pragma: no cover
     _sp = None
+
+#: Default rows per block for the streaming-fit readers below (matches the
+#: serving stream block: one block resident on device at a time).
+DEFAULT_FIT_BLOCK_ROWS = 65536
+
+FIT_BLOCK_ROWS_ENV = "TPUML_FIT_BLOCK_ROWS"
+
+
+def fit_block_rows() -> int:
+    """Rows per block for the fit-path block readers (``TPUML_FIT_BLOCK_ROWS``):
+    the block size auto-degraded streaming fits start from, and the default
+    batch size :class:`ArrowBlockReader` reads parquet at."""
+    return env_int(FIT_BLOCK_ROWS_ENV, DEFAULT_FIT_BLOCK_ROWS, minimum=1)
 
 
 class SparseVector:
@@ -453,3 +468,175 @@ def num_features(data: Any) -> int:
             return first.shape[1]
         return len(_row_to_array(first))
     return as_partitions(data)[0].shape[1]
+
+
+def host_rows_shape(data: Any) -> Optional[Tuple[int, int]]:
+    """(n_rows, n_features) of a HOST input without densifying it — the
+    cheap probe the fit memory gate prices from. Returns None when the
+    shape cannot be known without materializing (then admission waves the
+    input through rather than paying the copy it exists to avoid)."""
+    if is_device_array(data):
+        return None  # already resident on device; nothing left to admit
+    if isinstance(data, np.ndarray):
+        if data.ndim == 2:
+            return (int(data.shape[0]), int(data.shape[1]))
+        if data.ndim == 1:
+            return (1, int(data.shape[0]))
+        return None
+    if _sp is not None and _sp.issparse(data):
+        return (int(data.shape[0]), int(data.shape[1]))
+    if isinstance(data, (SparseVector, DenseVector)):
+        return (1, len(data.toArray()))
+    if isinstance(data, (list, tuple)) and data:
+        first = data[0]
+        if _is_block(first):
+            if any(not _is_block(p) for p in data):
+                return None
+            return (
+                int(sum(p.shape[0] for p in data)),
+                int(first.shape[1]),
+            )
+        try:
+            return (len(data), len(_row_to_array(first)))
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+class HostArrayBlockReader:
+    """Re-iterable block view over ONE host matrix — the degradation shim.
+
+    When fit admission finds a host input over the device-memory budget,
+    wrapping it in this reader re-enters the estimators' EXISTING
+    streaming paths unchanged: blocks are row slices (numpy views, no
+    copy), so the only memory cost is the one block resident on device at
+    a time. Satisfies the streaming-source protocol
+    (:func:`is_streaming_source` / :func:`is_reiterable_stream`) and
+    exposes ``dtype`` for :func:`infer_input_dtype` precision probes.
+    """
+
+    def __init__(self, x: Any, block_rows: Optional[int] = None):
+        self._x = np.asarray(x)
+        if self._x.ndim != 2:
+            raise ValueError(
+                f"HostArrayBlockReader needs a 2-D matrix, got {self._x.ndim}-D"
+            )
+        self.block_rows = int(block_rows) if block_rows else fit_block_rows()
+        if self.block_rows < 1:
+            raise ValueError("block_rows must be >= 1")
+
+    @property
+    def dtype(self):
+        return self._x.dtype
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (int(self._x.shape[0]), int(self._x.shape[1]))
+
+    def iter_blocks(self) -> Iterable[np.ndarray]:
+        for i in range(0, self._x.shape[0], self.block_rows):
+            yield self._x[i : i + self.block_rows]
+
+
+class ArrowBlockReader:
+    """Re-iterable block reader over an on-disk parquet dataset — the
+    first-class beyond-HBM fit input.
+
+    Wraps ``pyarrow.dataset`` so a directory of parquet files (or a single
+    file) feeds the streaming fit paths directly: ``fit(ArrowBlockReader(
+    path))`` trains without ever materializing the dataset in host or
+    device memory. Feature ``columns`` default to every column except
+    ``exclude`` (pass the label column there); a single list-typed column
+    (the Spark-style packed vector column) expands to its width. Labels
+    ride along via :meth:`read_column`, which DOES materialize one column
+    — labels are O(n), the 1/d-sized exception to the streaming rule.
+    """
+
+    def __init__(
+        self,
+        source: Any,
+        columns: Optional[Sequence[str]] = None,
+        *,
+        block_rows: Optional[int] = None,
+        dtype: Any = None,
+        exclude: Sequence[str] = (),
+    ):
+        import pyarrow.dataset as pads
+
+        self._ds = (
+            source
+            if isinstance(source, pads.Dataset)
+            else pads.dataset(source, format="parquet")
+        )
+        schema = self._ds.schema
+        if columns is None:
+            columns = [c for c in schema.names if c not in set(exclude)]
+        else:
+            missing = [c for c in columns if c not in schema.names]
+            if missing:
+                raise KeyError(f"no such column(s) in dataset: {missing}")
+        if not columns:
+            raise ValueError("ArrowBlockReader needs at least one feature column")
+        self.columns = list(columns)
+        self.block_rows = int(block_rows) if block_rows else fit_block_rows()
+        if dtype is not None:
+            self._dtype = np.dtype(dtype)
+        else:
+            # Narrow only when EVERY feature column is float32; mixed or
+            # wider schemas keep the float64 reference surface (and the
+            # precision auto-resolution that hangs off the input dtype).
+            import pyarrow as pa
+
+            feats = [schema.field(c).type for c in self.columns]
+
+            def _leaf(t):
+                return t.value_type if pa.types.is_list(t) or pa.types.is_fixed_size_list(t) else t
+
+            all_f32 = all(_leaf(t) == pa.float32() for t in feats)
+            self._dtype = np.dtype(np.float32 if all_f32 else np.float64)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def num_rows(self) -> int:
+        return int(self._ds.count_rows())
+
+    def _column_to_numpy(self, chunk) -> np.ndarray:
+        import pyarrow as pa
+
+        t = chunk.type
+        if pa.types.is_list(t) or pa.types.is_fixed_size_list(t):
+            # Packed vector column: (rows, width) from the flat values.
+            # flatten() (not .values) — a sliced batch shares the parent
+            # buffer and .values would return the WHOLE column again.
+            flat = np.asarray(chunk.flatten())
+            if pa.types.is_list(t):
+                widths = np.asarray(chunk.value_lengths())
+                if widths.size and not np.all(widths == widths[0]):
+                    raise ValueError("ragged list column cannot form a matrix")
+                width = int(widths[0]) if widths.size else 0
+            else:
+                width = t.list_size
+            return flat.reshape(-1, width)
+        return np.asarray(chunk.to_numpy(zero_copy_only=False)).reshape(-1, 1)
+
+    def iter_blocks(self) -> Iterable[np.ndarray]:
+        for batch in self._ds.to_batches(
+            columns=self.columns, batch_size=self.block_rows
+        ):
+            if batch.num_rows == 0:
+                continue
+            cols = [
+                self._column_to_numpy(batch.column(i))
+                for i in range(batch.num_columns)
+            ]
+            block = cols[0] if len(cols) == 1 else np.concatenate(cols, axis=1)
+            yield np.ascontiguousarray(block, dtype=self._dtype)
+
+    def read_column(self, name: str, dtype: Any = np.float64) -> np.ndarray:
+        """One full column as a host array (label extraction)."""
+        if name not in self._ds.schema.names:
+            raise KeyError(f"no such column in dataset: {name!r}")
+        tbl = self._ds.to_table(columns=[name])
+        return np.asarray(tbl.column(0).to_numpy(zero_copy_only=False), dtype=dtype)

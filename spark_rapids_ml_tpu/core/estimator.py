@@ -53,11 +53,24 @@ class Estimator(Params):
         ``model.fit_report()``.
 
         Families implement :meth:`_fit`; estimators that override
-        ``fit`` directly opt out of the instrumentation."""
+        ``fit`` directly opt out of the instrumentation.
+
+        This boundary is also the fit path's OOM safety net: a device
+        ``RESOURCE_EXHAUSTED`` that escaped the per-family recovery
+        (streaming sources the runtime cannot re-block, exotic paths)
+        re-raises as the structured
+        :class:`~spark_rapids_ml_tpu.core.membudget.FitMemoryError` —
+        a raw ``XlaRuntimeError`` never escapes a fit."""
         from spark_rapids_ml_tpu.observability.report import RunRecorder
 
         with RunRecorder("fit", type(self).__name__) as rec:
-            model = self._fit(dataset)
+            try:
+                model = self._fit(dataset)
+            except RuntimeError as exc:
+                from spark_rapids_ml_tpu.core.membudget import reraise_if_oom
+
+                reraise_if_oom(exc, type(self).__name__)
+                raise
         rec.attach(model)
         return model
 

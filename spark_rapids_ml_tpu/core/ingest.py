@@ -40,8 +40,20 @@ import numpy as np
 from spark_rapids_ml_tpu.core.data import as_partitions, is_device_array
 from spark_rapids_ml_tpu.robustness.degrade import cpu_device, run_degradable
 from spark_rapids_ml_tpu.robustness.faults import fault_point
-from spark_rapids_ml_tpu.robustness.retry import default_policy
+from spark_rapids_ml_tpu.robustness.retry import default_policy, is_oom_error
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def _reclaim_between_attempts(attempt: int, exc: BaseException) -> None:
+    """Retry hook for device placement: when the failed attempt was a
+    device OOM (real ``RESOURCE_EXHAUSTED`` or an injected ``:oom``
+    fault), drop every reclaimable cache so the next attempt runs against
+    the device's true free watermark. Non-OOM failures reclaim nothing —
+    a transient placement hiccup must not cold-start the program cache."""
+    if is_oom_error(exc):
+        from spark_rapids_ml_tpu.core.serving import reclaim_device_memory
+
+        reclaim_device_memory()
 
 
 def default_dtype():
@@ -128,7 +140,10 @@ def _prepare_rows_impl(
                 with TraceRange("ingest H2D", TraceColor.CYAN):
                     return jax.device_put(arr, row_sharding(mesh))
 
-            x = default_policy().run(_reshard, name="ingest.device_put")
+            x = default_policy().run(
+                _reshard, name="ingest.device_put",
+                on_retry=_reclaim_between_attempts,
+            )
             mask = (jnp.arange(n + pad_n) < n).astype(m_dtype)
             mask = jax.device_put(mask, NamedSharding(mesh, P(DATA_AXIS)))
         else:
@@ -160,7 +175,10 @@ def _prepare_rows_impl(
         # budget) and TPUML_DEGRADE=cpu, the fit continues on the host
         # CPU device with a structured warning instead of raising.
         x = run_degradable(
-            lambda: default_policy().run(_place, name="ingest.device_put"),
+            lambda: default_policy().run(
+                _place, name="ingest.device_put",
+                on_retry=_reclaim_between_attempts,
+            ),
             lambda: jax.device_put(jnp.asarray(x_host), cpu_device()),
             what="estimator input placement",
             site="ingest.device_put",
@@ -187,6 +205,32 @@ def _combine_weights(mask, weights, n_true: int, m_dtype, mesh):
         )
     w = weights_as_mask(w_host, int(mask.shape[0]), m_dtype, mesh)
     return mask * w
+
+
+def place_array(arr: Any, dtype=None, device=None):
+    """Guarded device placement for an n-sized SIDECAR array that rides
+    alongside :func:`prepare_rows` output (per-row stats, one-hot label
+    blocks): the same ``ingest.device_put`` fault point, retry policy,
+    and OOM cache-reclaim hook as the main row funnel, so no fit-path
+    whole-array upload bypasses the memory-safety chokepoint. Device
+    inputs stay resident (cast in place when asked)."""
+    import jax
+    import jax.numpy as jnp
+
+    if is_device_array(arr):
+        if dtype is not None and arr.dtype != dtype:
+            return arr.astype(dtype)
+        return arr
+    host = np.asarray(arr, dtype=np.dtype(dtype) if dtype is not None else None)
+
+    def _place():
+        fault_point("ingest.device_put")
+        with TraceRange("ingest H2D", TraceColor.CYAN):
+            return jax.device_put(jnp.asarray(host), device)
+
+    return default_policy().run(
+        _place, name="ingest.device_put", on_retry=_reclaim_between_attempts
+    )
 
 
 def matrix_like(x: Any, dtype=None):

@@ -229,6 +229,23 @@ def clear_program_cache() -> None:
         invalidate_device_caches(model)
 
 
+def reclaim_device_memory() -> None:
+    """Best-effort release of every reclaimable device allocation after a
+    ``RESOURCE_EXHAUSTED`` failure: the AOT executable cache (and with it
+    the per-model device-weight copies, via :func:`clear_program_cache`'s
+    sweep), plus jax's own trace/lowering caches. The fit-path OOM
+    recovery calls this between attempts so the retry runs against the
+    device's true free watermark, not one depressed by cold caches."""
+    clear_program_cache()
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:  # pragma: no cover - reclamation is best-effort
+        pass
+    bump_counter("fit.oom.reclaims")
+
+
 #: Attributes holding a model family's device-resident weight copy
 #: (single array / pytree — dropped to None) and dict-shaped caches
 #: (cleared in place). One list so every family retires the same way.

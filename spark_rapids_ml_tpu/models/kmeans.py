@@ -242,6 +242,33 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
         w_host = extract_weights(dataset, self.getWeightCol())
         if is_streaming_source(rows):
             return self._fit_streaming(rows)
+        from spark_rapids_ml_tpu.core import membudget
+
+        # Budgeted admission (core/membudget.py): an over-budget host
+        # input reroutes to the SAME _fit_streaming an explicit streaming
+        # source takes — bit-identical by construction — and a device OOM
+        # mid-fit reclaims caches and takes the same exit.
+        can_stream = w_host is None and self.getBackend() != "fused"
+        guard = membudget.fit_memory_guard(
+            "kmeans", rows, can_stream=can_stream,
+            why_cannot_stream="the streaming KMeans path supports neither "
+                              "weightCol nor backend='fused'",
+            mesh=self.mesh, ledger_families=("kmeans",),
+        )
+        if guard.degrade:
+            return membudget.run_streaming_with_recovery(
+                "kmeans", self._fit_streaming, guard.matrix
+            )
+        fallback = (
+            (lambda: membudget.run_streaming_with_recovery(
+                "kmeans", self._fit_streaming, membudget.host_matrix(rows)))
+            if can_stream and self.mesh is None else None
+        )
+        return membudget.run_fit_with_oom_recovery(
+            "kmeans", lambda: self._fit_in_memory(rows, w_host), fallback
+        )
+
+    def _fit_in_memory(self, rows: Any, w_host) -> "KMeansModel":
         k = self.getK()
         cosine = self.getDistanceMeasure() == "cosine"
         key = jax.random.key(self.getSeed())

@@ -316,6 +316,35 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         x_in, y_in = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         w_host = extract_weights(dataset, self.getWeightCol())
         prec = self._resolved_precision(dataset)
+        from spark_rapids_ml_tpu.core import membudget
+
+        # Budgeted admission (core/membudget.py): an over-budget host
+        # input reroutes through a block reader into the SAME streaming
+        # sufficient-statistics branch above — bit-identical by
+        # construction — and a device OOM mid-fit reclaims caches and
+        # takes the same exit.
+        can_stream = w_host is None
+        guard = membudget.fit_memory_guard(
+            "linear", x_in, can_stream=can_stream,
+            why_cannot_stream="the streaming path does not support weightCol",
+            mesh=self.mesh, ledger_families=("linear", "linreg"),
+        )
+        if guard.degrade:
+            return membudget.run_streaming_with_recovery(
+                "linear", lambda r: self._fit((r, y_in)), guard.matrix
+            )
+        fallback = (
+            (lambda: membudget.run_streaming_with_recovery(
+                "linear", lambda r: self._fit((r, y_in)),
+                membudget.host_matrix(x_in)))
+            if can_stream and self.mesh is None else None
+        )
+        return membudget.run_fit_with_oom_recovery(
+            "linear", lambda: self._fit_in_memory(x_in, y_in, w_host, prec),
+            fallback,
+        )
+
+    def _fit_in_memory(self, x_in, y_in, w_host, prec) -> "LinearRegressionModel":
         if prec == "dd":
             if is_device_array(x_in):
                 # Same stance as PCA: dd operands split on HOST fp64 — a

@@ -259,6 +259,38 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
             return self._fit_streaming(dataset)
         x_in, y_in = _extract_xy(dataset, self.getFeaturesCol(), self.getLabelCol())
         w_host = extract_weights(dataset, self.getWeightCol())
+        from spark_rapids_ml_tpu.core import membudget
+
+        # Budgeted admission (core/membudget.py): an over-budget host
+        # input reroutes to the SAME (reader, y) streaming fit an explicit
+        # streaming source takes — bit-identical by construction — and a
+        # device OOM mid-fit reclaims caches and takes the same exit.
+        can_stream = (
+            w_host is None
+            and not (self.getElasticNetParam() > 0.0 and self.getRegParam() > 0.0)
+            and self._initial_weights is None
+        )
+        guard = membudget.fit_memory_guard(
+            "logistic", x_in, can_stream=can_stream,
+            why_cannot_stream="the streaming path supports neither "
+                              "weightCol, elastic net, nor warm starts",
+            mesh=self.mesh, ledger_families=("logistic",),
+        )
+        if guard.degrade:
+            return membudget.run_streaming_with_recovery(
+                "logistic", lambda r: self._fit((r, y_in)), guard.matrix
+            )
+        fallback = (
+            (lambda: membudget.run_streaming_with_recovery(
+                "logistic", lambda r: self._fit((r, y_in)),
+                membudget.host_matrix(x_in)))
+            if can_stream and self.mesh is None else None
+        )
+        return membudget.run_fit_with_oom_recovery(
+            "logistic", lambda: self._fit_in_memory(x_in, y_in, w_host), fallback
+        )
+
+    def _fit_in_memory(self, x_in, y_in, w_host) -> "LogisticRegressionModel":
         # Device labels validate on device (two scalar readbacks — the
         # class count defines shapes, so a sync is inherent; what never
         # happens is an O(n) pull of the label vector).

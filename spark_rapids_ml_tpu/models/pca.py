@@ -237,13 +237,46 @@ class PCA(_PCAParams, Estimator, MLReadable):
 
     def _fit(self, dataset: Any) -> "PCAModel":
         """RapidsPCA.fit (RapidsPCA.scala:111-125)."""
+        from spark_rapids_ml_tpu.core import membudget
+        from spark_rapids_ml_tpu.core.data import is_streaming_source
+
+        rows = extract_column(dataset, self.getInputCol())
+        # Budgeted admission (core/membudget.py): an over-budget host
+        # input re-enters this fit as a first-class block reader — the
+        # SAME streaming moments/sketch path an explicit reader takes,
+        # bit-identical by construction — and a device OOM mid-fit
+        # reclaims caches and takes the same exit.
+        can_stream = self.getCovarianceBackend() != "pallas"
+        guard = membudget.fit_memory_guard(
+            "pca", rows, can_stream=can_stream,
+            why_cannot_stream="covarianceBackend='pallas' needs the "
+                              "materialized single-device path",
+            mesh=self.mesh, ledger_families=("pca",),
+        )
+        if guard.degrade:
+            return membudget.run_streaming_with_recovery(
+                "pca", self._fit, guard.matrix
+            )
+        fallback = (
+            (lambda: membudget.run_streaming_with_recovery(
+                "pca", self._fit, membudget.host_matrix(rows)))
+            if can_stream and self.mesh is None
+            and not is_streaming_source(rows) else None
+        )
+        return membudget.run_fit_with_oom_recovery(
+            "pca", lambda: self._fit_in_memory(rows, dataset), fallback
+        )
+
+    def _fit_in_memory(self, rows: Any, dataset: Any) -> "PCAModel":
+        """Solver routing + fit for an ADMITTED input: in-memory host or
+        device data, or any streaming source (which the admission gate
+        waves through untouched)."""
         from spark_rapids_ml_tpu.core.data import infer_input_dtype, is_streaming_source
 
         import jax
 
         from spark_rapids_ml_tpu.core.data import is_reiterable_stream
 
-        rows = extract_column(dataset, self.getInputCol())
         solver = self.getSolver()
         streaming = is_streaming_source(rows)
         if solver == "randomized" and streaming and not is_reiterable_stream(rows):
@@ -461,7 +494,12 @@ class PCA(_PCAParams, Estimator, MLReadable):
             # model never depends on placement.
             gpu_id = self.getGpuId()
             device = jax.devices()[gpu_id] if gpu_id >= 0 else jax.devices()[0]
-            x = jax.device_put(jnp.asarray(x_host, dtype=dtype), device)
+            # Guarded placement: the whole-dataset upload goes through the
+            # ingest.device_put chokepoint (fault point, OOM retry + cache
+            # reclaim) instead of a bare device_put.
+            from spark_rapids_ml_tpu.core.ingest import place_array
+
+            x = place_array(x_host, dtype=dtype, device=device)
         comps, ratio, _ = randomized_pca(
             x,
             k,

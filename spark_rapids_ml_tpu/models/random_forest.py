@@ -328,7 +328,25 @@ def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarra
     With a mesh, rows are data-sharded and the per-level histograms merge
     over ICI (:func:`grow_forest_sharded`); quantization and weight sampling
     stay replicated (edges/weights are tiny and seed-deterministic)."""
+    from spark_rapids_ml_tpu.core.ingest import place_array
+    from spark_rapids_ml_tpu.core.membudget import fit_memory_guard
+
     n, d = x.shape
+    # Budgeted admission (core/membudget.py): forest growth has no
+    # streaming rung — the binned matrix must be resident — so an
+    # over-budget input raises the structured FitMemoryError up front
+    # instead of dying inside device_put. row_stats rides along as the
+    # sidecar allocation priced on top of the matrix.
+    fit_memory_guard(
+        "random_forest", x, can_stream=False,
+        why_cannot_stream="RandomForest has no streaming fit (histogram "
+                          "growth needs the binned matrix resident)",
+        mesh=mesh, dtype=np.float32, ledger_families=("rf",),
+        extra_bytes=(
+            0 if is_device_array(row_stats)
+            else np.asarray(row_stats).size * 4
+        ),
+    )
     n_bins = min(params.getMaxBins(), max(2, n))
     m = resolve_feature_subset(
         params.getFeatureSubsetStrategy(), d, params.getNumTrees(), classification
@@ -336,7 +354,10 @@ def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarra
     key = jax.random.key(params.getSeed())
     k_sample, k_feat = jax.random.split(key)
 
-    xj = jnp.asarray(x, dtype=jnp.float32)
+    # Guarded placement: the whole-dataset uploads go through the
+    # ingest.device_put chokepoint (fault point, OOM retry + cache
+    # reclaim) instead of bare jnp.asarray calls.
+    xj = place_array(x, dtype=jnp.float32)
     w = sample_weights(
         k_sample, params.getNumTrees(), n, params.getSubsamplingRate(),
         params.getBootstrap(),
@@ -357,7 +378,7 @@ def _fit_forest(params: _RandomForestParams, x: np.ndarray, row_stats: np.ndarra
         min_info_gain=params.getMinInfoGain(),
         exact_counts=exact,
     )
-    rs = jnp.asarray(row_stats, dtype=jnp.float32)
+    rs = place_array(row_stats, dtype=jnp.float32)
     if mesh is not None:
         edges = quantize_features(xj, n_bins)
         xb = bin_features(xj, edges)

@@ -303,7 +303,19 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
         return self
 
     def _fit(self, dataset: Any) -> "UMAPModel":
+        from spark_rapids_ml_tpu.core.membudget import fit_memory_guard
+
         rows = extract_features(dataset, self.getFeaturesCol())
+        # Budgeted admission (core/membudget.py): UMAP's kNN graph and
+        # epoch SGD need the whole matrix resident — no streaming rung —
+        # so an over-budget input raises the structured FitMemoryError
+        # up front instead of dying inside device_put.
+        fit_memory_guard(
+            "umap", rows, can_stream=False,
+            why_cannot_stream="UMAP has no streaming fit (the kNN graph "
+                              "and epoch SGD need the full matrix resident)",
+            mesh=self.mesh, dtype=np.float32, ledger_families=("umap",),
+        )
         # Device arrays are consumed in place — no host round trip
         # (VERDICT r3 #1); the mesh index upload still wants a host copy,
         # which matrix_like keeps for host sources.
@@ -319,11 +331,12 @@ class UMAP(_UMAPParams, Estimator, MLReadable):
         k_init, k_opt = jax.random.split(key)
 
         with TraceRange("umap fit", TraceColor.PURPLE):
-            x = (
-                x_in.astype(jnp.float32)
-                if device_in
-                else jnp.asarray(x_in, dtype=jnp.float32)
-            )
+            # Guarded placement: the one whole-dataset upload goes through
+            # the ingest.device_put chokepoint (fault point, OOM retry +
+            # cache reclaim) instead of a bare jnp.asarray.
+            from spark_rapids_ml_tpu.core.ingest import place_array
+
+            x = place_array(x_in, dtype=jnp.float32)
             dists, idx = _knn_excluding_self(
                 x, k, self.getMetric(), self.mesh,
                 x_host=None if device_in else x_in,

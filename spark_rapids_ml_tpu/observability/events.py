@@ -93,6 +93,7 @@ SCHEMA: Dict[str, frozenset] = {
     "heartbeat": frozenset({"seq", "interval"}),
     "barrier": frozenset({"action", "attempt"}),
     "serving": frozenset({"action"}),
+    "fit_admission": frozenset({"action", "family"}),
     "compile": frozenset({"classification", "kernel"}),
     "report": frozenset({"kind", "summary"}),
     "profile": frozenset({"action", "dir"}),

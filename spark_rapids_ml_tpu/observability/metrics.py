@@ -362,6 +362,29 @@ def observe_segment_seconds(solver: str, seconds: float) -> None:
     ).observe(seconds, solver=solver)
 
 
+def percentile_from_histogram(hist_value: dict, q: float) -> float:
+    """Linear-interpolated percentile from a fixed-bucket histogram
+    snapshot (``{"buckets": {le: cumulative}, "count": n}``). The +Inf
+    bucket reports its lower edge (the histogram's resolution limit).
+    Shared by the loadgen report and the serving shed-backoff hint
+    (``serving.admission.retry_after_hint_ms``)."""
+    count = hist_value["count"]
+    if count == 0:
+        return float("nan")
+    target = q * count
+    prev_le, prev_cum = 0.0, 0
+    for le, cum in sorted(hist_value["buckets"].items()):
+        if cum >= target:
+            if le == float("inf"):
+                return prev_le
+            if cum == prev_cum:
+                return le
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_le + frac * (le - prev_le)
+        prev_le, prev_cum = le, cum
+    return prev_le
+
+
 def dump_snapshot(path: str, registry: Optional[Registry] = None) -> None:
     """Write a snapshot to ``path`` — Prometheus text if it ends in
     ``.prom``, JSON otherwise."""

@@ -271,6 +271,7 @@ def lloyd_resumable(
 
     from spark_rapids_ml_tpu.observability.costs import ledgered_call
     from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
+    from spark_rapids_ml_tpu.robustness.faults import fault_point
     from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
     n = x.shape[0]
@@ -301,6 +302,7 @@ def lloyd_resumable(
             break
         seg_t0 = time.perf_counter()
         with TraceRange("segment kmeans.lloyd", TraceColor.PURPLE):
+            fault_point("solver.segment")
             state = ledgered_call(
                 _lloyd_segment, (x, mask, *state, tol),
                 static=dict(
@@ -424,6 +426,7 @@ def lloyd_streaming(
     ceiling the reference also had (VERDICT r3 #6).
     """
     from spark_rapids_ml_tpu.core.data import _block_to_dense
+    from spark_rapids_ml_tpu.robustness.faults import fault_point
 
     centers = jnp.asarray(init_centers)
     k, d = centers.shape
@@ -440,6 +443,7 @@ def lloyd_streaming(
             yield xb
 
     def one_pass(cs):
+        fault_point("solver.segment")
         sums = jnp.zeros((k, d), cs.dtype)
         counts = jnp.zeros((k,), cs.dtype)
         cost = jnp.zeros((), cs.dtype)

@@ -282,6 +282,7 @@ def solve_elastic_net_resumable(
 
     from spark_rapids_ml_tpu.observability.costs import ledgered_call
     from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
+    from spark_rapids_ml_tpu.robustness.faults import fault_point
     from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
     a_quad, b_lin, l1, lip, x_mean, y_mean = _enet_prep(
@@ -306,6 +307,7 @@ def solve_elastic_net_resumable(
             break
         seg_t0 = time.perf_counter()
         with TraceRange("segment linear.enet", TraceColor.PURPLE):
+            fault_point("solver.segment")
             carry = ledgered_call(
                 _enet_segment, (a_quad, b_lin, l1, lip, tol, *carry),
                 static=dict(max_iter=max_iter, every=checkpointer.every),
@@ -389,12 +391,15 @@ def normal_eq_stats_streaming(block_pairs, dtype=None, precision: str = "highest
     """
     import numpy as np
 
+    from spark_rapids_ml_tpu.robustness.faults import fault_point
+
     acc = None
     d = None
     for xb, yb in block_pairs:
         if getattr(xb, "shape", (1,))[0] == 0:
             # Empty partitions densify to (0, 0) — no rows, no width info.
             continue
+        fault_point("solver.segment")
         xj = jnp.asarray(np.ascontiguousarray(xb), dtype=dtype)
         yj = jnp.asarray(np.ascontiguousarray(yb), dtype=dtype)
         if d is None:

@@ -347,6 +347,7 @@ def fit_logistic_resumable(
 
     from spark_rapids_ml_tpu.observability.costs import ledgered_call
     from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
+    from spark_rapids_ml_tpu.robustness.faults import fault_point
     from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
     if n_classes < 2:
@@ -404,6 +405,7 @@ def fit_logistic_resumable(
             break
         seg_t0 = time.perf_counter()
         with TraceRange("segment logistic.lbfgs", TraceColor.PURPLE):
+            fault_point("solver.segment")
             params, opt_state, it_a, gn_a = ledgered_call(
                 _lbfgs_segment,
                 (x, y_target, mask, offset, scale, n,
@@ -674,6 +676,9 @@ def fit_logistic_streaming(
     n_b = c if fit_intercept else 0
 
     def fun_grad(theta):
+        from spark_rapids_ml_tpu.robustness.faults import fault_point
+
+        fault_point("solver.segment")
         w = theta[: d * c].reshape(d, c)
         b = theta[d * c :] if fit_intercept else np.zeros(c)
         wj = jnp.asarray(w.astype(np_dtype))

@@ -340,6 +340,7 @@ def optimize_layout_resumable(
 
     from spark_rapids_ml_tpu.observability.costs import ledgered_call
     from spark_rapids_ml_tpu.observability.metrics import observe_segment_seconds
+    from spark_rapids_ml_tpu.robustness.faults import fault_point
     from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
     state = (embedding, jax.random.key_data(key), jnp.asarray(0))
@@ -352,6 +353,7 @@ def optimize_layout_resumable(
         stop = min(start + checkpointer.every, n_epochs)
         seg_t0 = time.perf_counter()
         with TraceRange("segment umap.layout", TraceColor.PURPLE):
+            fault_point("solver.segment")
             y, kd = ledgered_call(
                 _layout_segment,
                 (y, kd, jnp.asarray(start), jnp.asarray(stop), graph,

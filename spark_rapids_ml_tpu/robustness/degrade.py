@@ -113,12 +113,23 @@ def run_degradable(
 
 
 def _record_degradation(what: str, why: str) -> None:
-    """One degradation: the structured warning (unchanged surface), a
+    """One CPU degradation: the structured warning (unchanged surface), a
     ``degrade`` event-log record, and a counter for dashboards."""
+    record_degradation(what, why, "cpu", "the CPU path")
+
+
+def record_degradation(
+    what: str, why: str, fallback: str, fallback_label: Optional[str] = None
+) -> None:
+    """One degradation of any kind — the shared warn + counter + event
+    triple. ``fallback`` is the machine-readable event field (``"cpu"``,
+    ``"streaming"``); ``fallback_label`` the human phrasing for the
+    warning text (defaults to ``fallback``)."""
     from spark_rapids_ml_tpu.utils.tracing import bump_counter
 
     warnings.warn(
-        DegradationWarning(what, why, "the CPU path"), stacklevel=3
+        DegradationWarning(what, why, fallback_label or fallback),
+        stacklevel=4,
     )
     bump_counter("degrade.events")
-    emit("degrade", what=what, why=why, fallback="cpu")
+    emit("degrade", what=what, why=why, fallback=fallback)

@@ -25,6 +25,8 @@ Sites (the complete vocabulary — a spec naming anything else is an error):
                                 (robustness/checkpoint.py)
   - ``checkpoint.segment``      the preemption point between solver
                                 segments (the segmented-fit drivers)
+  - ``solver.segment``          one solver segment / streaming-pass
+                                execution (the fit-path OOM chokepoint)
 
 Schedules are counters, not random draws — the same spec always fails the
 same invocations, so a chaos test is exactly reproducible:
@@ -36,6 +38,11 @@ same invocations, so a chaos test is exactly reproducible:
                          truncated artifact lands at the FINAL path (only
                          ``checkpoint.write`` honors it — the chaos proof
                          that restore rejects corrupt checkpoints)
+  - append ``:oom``      a synthetic ``RESOURCE_EXHAUSTED``: the raised
+                         fault carries the XLA out-of-memory message
+                         marker, so the fit-path OOM recovery (cache
+                         reclaim, block halving, streaming fallback)
+                         classifies injected and real OOMs identically
 
 Specs come from the ``TPUML_FAULTS`` env var (semicolon- or
 comma-separated entries, e.g. ``persistence.write=1;barrier.attempt=2``)
@@ -61,6 +68,7 @@ KNOWN_SITES = frozenset(
         "checkpoint.write",
         "checkpoint.restore",
         "checkpoint.segment",
+        "solver.segment",
     }
 )
 
@@ -77,18 +85,27 @@ class InjectedFault(RuntimeError):
     leaves a truncated artifact at the final path before re-raising."""
 
     def __init__(
-        self, site: str, invocation: int, fatal: bool = False, torn: bool = False
+        self,
+        site: str,
+        invocation: int,
+        fatal: bool = False,
+        torn: bool = False,
+        oom: bool = False,
     ):
         self.site = site
         self.invocation = invocation
         self.fatal = fatal
         self.torn = torn
+        self.oom = oom
         kind = "fatal" if fatal else "transient"
         if torn:
             kind += " torn-write"
-        super().__init__(
-            f"injected {kind} fault at site {site!r} (invocation {invocation})"
-        )
+        msg = f"injected {kind} fault at site {site!r} (invocation {invocation})"
+        if oom:
+            # The real XLA message marker, so message-based OOM
+            # classification treats injected and real OOMs identically.
+            msg = f"RESOURCE_EXHAUSTED: out of memory — {msg}"
+        super().__init__(msg)
 
 
 class Schedule:
@@ -96,19 +113,30 @@ class Schedule:
     of them for ``count=ALWAYS`` — raising fatal, transient, or torn
     faults."""
 
-    def __init__(self, count: int, fatal: bool = False, torn: bool = False):
+    def __init__(
+        self,
+        count: int,
+        fatal: bool = False,
+        torn: bool = False,
+        oom: bool = False,
+    ):
         if count != ALWAYS and count < 0:
             raise ValueError(f"schedule count must be >= 0 or ALWAYS, got {count}")
         self.count = count
         self.fatal = fatal
         self.torn = torn
+        self.oom = oom
 
     def should_fail(self, invocation: int) -> bool:
         return self.count == ALWAYS or invocation < self.count
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         n = "always" if self.count == ALWAYS else str(self.count)
-        flags = (", fatal" if self.fatal else "") + (", torn" if self.torn else "")
+        flags = (
+            (", fatal" if self.fatal else "")
+            + (", torn" if self.torn else "")
+            + (", oom" if self.oom else "")
+        )
         return f"Schedule({n}{flags})"
 
 
@@ -122,7 +150,7 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
         if "=" not in entry:
             raise ValueError(
                 f"malformed fault entry {entry!r}: expected "
-                "site=N | site=always, optionally suffixed :fatal"
+                "site=N | site=always, optionally suffixed :fatal|:torn|:oom"
             )
         site, _, sched = entry.partition("=")
         site = site.strip()
@@ -132,7 +160,7 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
                 f"{sorted(KNOWN_SITES)}"
             )
         sched = sched.strip()
-        fatal = torn = False
+        fatal = torn = oom = False
         while True:
             if sched.endswith(":fatal"):
                 fatal = True
@@ -140,6 +168,9 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
             elif sched.endswith(":torn"):
                 torn = True
                 sched = sched[: -len(":torn")]
+            elif sched.endswith(":oom"):
+                oom = True
+                sched = sched[: -len(":oom")]
             else:
                 break
         if sched == "always":
@@ -156,7 +187,7 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
                 raise ValueError(
                     f"schedule count for site {site!r} must be >= 0, got {count}"
                 )
-        plan[site] = Schedule(count, fatal=fatal, torn=torn)
+        plan[site] = Schedule(count, fatal=fatal, torn=torn, oom=oom)
     return plan
 
 
@@ -187,9 +218,10 @@ class FaultPlan:
             if sched.should_fail(invocation):
                 self.fired.append((site, invocation))
                 emit("fault", action="fire", site=site, invocation=invocation,
-                     fatal=sched.fatal, torn=sched.torn)
+                     fatal=sched.fatal, torn=sched.torn, oom=sched.oom)
                 raise InjectedFault(
-                    site, invocation, fatal=sched.fatal, torn=sched.torn
+                    site, invocation, fatal=sched.fatal, torn=sched.torn,
+                    oom=sched.oom,
                 )
 
 

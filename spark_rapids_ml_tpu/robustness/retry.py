@@ -79,8 +79,37 @@ def classify(exc: BaseException) -> str:
         return "fatal"
     # Everything environmental — OSError/ConnectionError/TimeoutError and
     # the distributed-runtime RuntimeErrors (heartbeat loss, coordination
-    # service unavailable) — is worth another attempt.
+    # service unavailable) — is worth another attempt. That includes
+    # device RESOURCE_EXHAUSTED (see :func:`is_oom_error`): retryable
+    # because the fit path frees reclaimable memory between attempts.
     return "retryable"
+
+
+#: Message markers XLA's allocators put in device out-of-memory errors.
+#: Injected ``:oom`` faults carry the first marker too, so classification
+#: cannot tell (and does not care) whether the OOM was real.
+OOM_MARKERS = ("resource_exhausted", "out of memory", "ran out of memory")
+
+
+def is_oom_error(exc: Optional[BaseException]) -> bool:
+    """True when ``exc`` (or anything on its ``__cause__`` chain — a
+    :class:`RetryExhaustedError` wraps the last attempt's error) is a
+    device out-of-memory failure: an ``XlaRuntimeError`` carrying
+    ``RESOURCE_EXHAUSTED``, or an injected ``:oom`` fault. String-matched
+    by necessity — jaxlib raises OOM as a plain ``RuntimeError`` subclass
+    with no structured code — but only within the RuntimeError subtree,
+    so a ValueError mentioning memory never classifies as OOM."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if getattr(exc, "oom", False):
+            return True
+        if isinstance(exc, RuntimeError):
+            text = str(exc).lower()
+            if any(marker in text for marker in OOM_MARKERS):
+                return True
+        exc = exc.__cause__
+    return False
 
 
 def _deterministic_jitter(name: str, attempt: int) -> float:

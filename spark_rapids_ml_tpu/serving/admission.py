@@ -54,6 +54,10 @@ class Overloaded(RuntimeError):
     request's priced bytes would push reserved device memory past the
     budget); the remaining fields snapshot the state the decision was
     made against, so a caller/load-balancer can log or route on them.
+    ``retry_after_ms`` is the server's backoff hint — the p95 of the
+    live request-latency histogram (roughly one queue residency), so a
+    well-behaved client retries after the backlog it was shed over has
+    had time to drain.
     """
 
     def __init__(
@@ -66,6 +70,7 @@ class Overloaded(RuntimeError):
         reserved_bytes: int = 0,
         request_bytes: int = 0,
         mem_budget: int = 0,
+        retry_after_ms: float = 0.0,
     ):
         self.reason = reason
         self.model = model
@@ -74,6 +79,7 @@ class Overloaded(RuntimeError):
         self.reserved_bytes = reserved_bytes
         self.request_bytes = request_bytes
         self.mem_budget = mem_budget
+        self.retry_after_ms = float(retry_after_ms)
         if reason == "memory":
             detail = (
                 f"request needs ~{request_bytes} device bytes but "
@@ -85,6 +91,33 @@ class Overloaded(RuntimeError):
                 f"queue is at its depth bound {queue_limit} ({QUEUE_ENV})"
             )
         super().__init__(f"serving overloaded ({reason}) for {model!r}: {detail}")
+
+
+#: Backoff hint when the latency histogram is still empty (cold server):
+#: long enough to skip a few busy-loop retries, short enough not to park
+#: a client behind an idle queue.
+DEFAULT_RETRY_AFTER_MS = 10.0
+
+
+def retry_after_hint_ms(default_ms: float = DEFAULT_RETRY_AFTER_MS) -> float:
+    """The shed backoff hint: p95 of the live
+    ``serving.request.latency_ms`` histogram — roughly one queue
+    residency, i.e. how long the backlog the request was shed over takes
+    to drain — falling back to ``default_ms`` while the histogram is
+    empty. Imported lazily from the batcher so admission stays
+    importable without it."""
+    try:
+        from spark_rapids_ml_tpu.observability.metrics import (
+            percentile_from_histogram,
+        )
+        from spark_rapids_ml_tpu.serving.batcher import _latency_hist
+
+        p95 = percentile_from_histogram(_latency_hist().value(), 0.95)
+    except Exception:  # pragma: no cover - metrics registry unavailable
+        return float(default_ms)
+    if not (p95 > 0):  # NaN (empty histogram) or degenerate zero
+        return float(default_ms)
+    return float(p95)
 
 
 class DeadlineExceeded(TimeoutError):
@@ -155,6 +188,7 @@ class AdmissionQueue:
                 raise Overloaded(
                     "queue", name,
                     queue_depth=depth, queue_limit=self.limit,
+                    retry_after_ms=retry_after_hint_ms(),
                 )
             if self.mem_budget and reserved + req.cost > self.mem_budget:
                 self._shed(req, "memory", depth, reserved)
@@ -163,6 +197,7 @@ class AdmissionQueue:
                     queue_depth=depth, queue_limit=self.limit,
                     reserved_bytes=reserved, request_bytes=req.cost,
                     mem_budget=self.mem_budget,
+                    retry_after_ms=retry_after_hint_ms(),
                 )
             self._reserved += req.cost
             req.enqueue_mono = time.monotonic()

@@ -222,17 +222,17 @@ class ServingRuntime:
         # actually allocates per execution) replace the declared-spec
         # estimate — the observation→budget loop of "Memory Safe
         # Computations with XLA" closed with measurements.
-        measured = _costs.measured_request_bytes(
-            sig.kernel, sig.static, bucket, sig.n_features, dtype, sig.weights
+        from spark_rapids_ml_tpu.core.membudget import measured_or_declared
+
+        cost = measured_or_declared(
+            _costs.measured_request_bytes(
+                sig.kernel, sig.static, bucket, sig.n_features, dtype,
+                sig.weights,
+            ),
+            bucket * sig.n_features * dtype.itemsize
+            + spec_bytes(sig.output_spec(bucket, dtype)),
+            "serving.admission",
         )
-        if measured is not None:
-            cost = measured
-            bump_counter("serving.admission.measured")
-        else:
-            cost = bucket * sig.n_features * dtype.itemsize + spec_bytes(
-                sig.output_spec(bucket, dtype)
-            )
-            bump_counter("serving.admission.declared")
         timeout_ms = float(timeout) * 1e3 if timeout is not None else 0.0
         # The submit→dispatcher-thread hop carries the caller's trace (or
         # roots a fresh one per request) via the Request itself — the

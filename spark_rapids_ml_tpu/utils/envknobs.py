@@ -83,6 +83,20 @@ KNOBS: Dict[str, Knob] = _knob_table(
     Knob("TPUML_DEGRADE", "choice", "robustness",
          "off: errors propagate; cpu: single-process fits fall back",
          default="off", choices=("off", "cpu")),
+    # fit memory budget & streaming degradation
+    Knob("TPUML_FIT_MEM_BUDGET", "int", "fit-memory",
+         "fit admission budget in device bytes (unset = live free HBM "
+         "from memory_stats(); 0 = gate off)"),
+    Knob("TPUML_FIT_BLOCK_ROWS", "int", "fit-memory",
+         "rows per block for degraded-streaming fits and ArrowBlockReader",
+         default=65536),
+    Knob("TPUML_FIT_OOM_RETRIES", "int", "fit-memory",
+         "streaming attempts after device OOM, block rows halving each",
+         default=3),
+    Knob("TPUML_FIT_DEGRADE", "choice", "fit-memory",
+         "auto: over-budget host fits reroute to streaming; off: raise "
+         "the structured budget error", default="auto",
+         choices=("auto", "off")),
     # checkpoint / resume
     Knob("TPUML_CHECKPOINT_EVERY", "int", "checkpoint",
          "solver iterations per jitted segment (0 = monolithic)",

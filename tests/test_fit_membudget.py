@@ -1,0 +1,495 @@
+"""Memory-safe training data plane (core/membudget.py + the ingest funnel).
+
+Four contracts, each asserted end to end through REAL fits:
+
+  1. **Budgeted admission**: an over-budget host fit degrades to the
+     family's streaming path with one ``DegradationWarning``, a
+     ``fit_admission`` event, and a ``fit.admission.degraded`` counter
+     bump — and the result is BIT-IDENTICAL to an explicit streaming fit
+     over the same reader/block size, because the degraded path re-enters
+     the explicit one.
+  2. **OOM recovery**: an injected device ``RESOURCE_EXHAUSTED`` (the
+     ``:oom`` fault suffix) mid-fit recovers without user intervention —
+     in-memory fits fall back to streaming, streaming fits retry at
+     halved block rows — all counter-asserted.
+  3. **Structured failure**: families with no streaming rung (UMAP,
+     RandomForest) and ``TPUML_FIT_DEGRADE=off`` raise the structured
+     :class:`FitMemoryError`; a raw ``XlaRuntimeError`` never escapes
+     ``Estimator.fit``.
+  4. **Parquet ingestion**: :class:`core.data.ArrowBlockReader` makes a
+     parquet dataset a first-class fit input, matching the in-memory fit
+     within float32-accumulation tolerance.
+
+Plus the serving-side satellite: ``Overloaded.retry_after_ms`` carries
+the p95-latency backoff hint.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.core.data import HostArrayBlockReader, fit_block_rows
+from spark_rapids_ml_tpu.core.membudget import (
+    FitMemoryError,
+    fit_mem_budget,
+    host_matrix,
+    padded_input_bytes,
+)
+from spark_rapids_ml_tpu.robustness import DegradationWarning, inject
+from spark_rapids_ml_tpu.robustness.faults import disarm, parse_spec
+from spark_rapids_ml_tpu.robustness.retry import is_oom_error
+from spark_rapids_ml_tpu.utils.tracing import counter_value
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    """A test that dies mid-inject must not poison its neighbors."""
+    yield
+    disarm()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("TPUML_RETRY_BASE_DELAY", "0")
+
+
+@pytest.fixture
+def data(rng):
+    return rng.normal(size=(300, 6))
+
+
+@pytest.fixture
+def tiny_budget(monkeypatch):
+    """A budget every real test matrix exceeds, with a small block size
+    so degraded streaming runs multiple blocks."""
+    monkeypatch.setenv("TPUML_FIT_MEM_BUDGET", "4096")
+    monkeypatch.setenv("TPUML_FIT_BLOCK_ROWS", "64")
+
+
+@pytest.fixture
+def no_budget(monkeypatch):
+    """Admission off — for tests that need the in-memory path to actually
+    run (e.g. to OOM at ingest) even when CI pins a tiny global budget."""
+    monkeypatch.setenv("TPUML_FIT_MEM_BUDGET", "0")
+
+
+def _counter_delta(name, fn):
+    before = counter_value(name)
+    result = fn()
+    return result, counter_value(name) - before
+
+
+def _fit_degraded(est, dataset):
+    """Fit expecting exactly the degradation warning + counter."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        model, delta = _counter_delta(
+            "fit.admission.degraded", lambda: est.fit(dataset)
+        )
+    degrade_warnings = [
+        w for w in caught if isinstance(w.message, DegradationWarning)
+    ]
+    assert len(degrade_warnings) == 1, "expected exactly one DegradationWarning"
+    assert "streaming" in str(degrade_warnings[0].message)
+    assert delta == 1
+    return model
+
+
+# --- pricing & knob resolution ------------------------------------------
+
+
+class TestPricing:
+    def test_padded_input_bytes_matches_prepare_rows_spec(self):
+        from spark_rapids_ml_tpu.core.ingest import _mask_dtype
+
+        n, d = 100, 8
+        dt = np.float32
+        mask_item = np.dtype(_mask_dtype(np.dtype(dt))).itemsize
+        assert padded_input_bytes(n, d, dt) == n * d * 4 + n * mask_item
+
+    def test_explicit_budget_wins_and_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("TPUML_FIT_MEM_BUDGET", "12345")
+        assert fit_mem_budget() == 12345
+        monkeypatch.setenv("TPUML_FIT_MEM_BUDGET", "0")
+        assert fit_mem_budget() == 0
+
+    def test_within_budget_admits_without_warning(self, monkeypatch, data):
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        monkeypatch.setenv("TPUML_FIT_MEM_BUDGET", str(1 << 30))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", DegradationWarning)
+            _, delta = _counter_delta(
+                "fit.admission.admitted",
+                lambda: KMeans().setK(3).setSeed(0).fit(data),
+            )
+        assert delta == 1
+
+    def test_streaming_source_waved_through(self, tiny_budget, data):
+        """An already-streaming input has nothing to admit — no warning,
+        no degrade counter."""
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        reader = HostArrayBlockReader(np.asarray(data), block_rows=64)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", DegradationWarning)
+            _, delta = _counter_delta(
+                "fit.admission.degraded",
+                lambda: KMeans().setK(3).setSeed(0).fit(reader),
+            )
+        assert delta == 0
+
+
+# --- the :oom fault vocabulary ------------------------------------------
+
+
+class TestOomClassification:
+    def test_oom_spec_parses(self):
+        sched = parse_spec("solver.segment=1:oom")["solver.segment"]
+        assert sched.oom and sched.count == 1 and not sched.fatal
+
+    def test_injected_oom_message_and_flag(self):
+        from spark_rapids_ml_tpu.robustness.faults import fault_point
+
+        with inject("ingest.device_put=1:oom"):
+            with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+                fault_point("ingest.device_put")
+
+    def test_is_oom_error_markers_and_cause_chain(self):
+        assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+        assert is_oom_error(RuntimeError("xla ran out of memory allocating"))
+        assert not is_oom_error(RuntimeError("shape mismatch"))
+        assert not is_oom_error(None)
+        wrapper = RuntimeError("retry budget exhausted")
+        wrapper.__cause__ = RuntimeError("RESOURCE_EXHAUSTED: oom")
+        assert is_oom_error(wrapper)
+
+    def test_fit_memory_error_does_not_self_classify(self):
+        """FitMemoryError wording must avoid the OOM markers, or the
+        recovery paths would loop on their own structured error."""
+        exc = FitMemoryError("kmeans", "input exceeds the budget",
+                             needed_bytes=10, budget_bytes=5)
+        assert not is_oom_error(exc)
+
+    def test_malformed_suffix_rejected(self):
+        with pytest.raises(ValueError, match="malformed"):
+            parse_spec("ingest.device_put=1:bogus")
+
+
+# --- degradation parity (acceptance: bit-identical) ----------------------
+
+
+class TestDegradationParity:
+    def test_kmeans(self, tiny_budget, monkeypatch, data):
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        est = lambda: KMeans(uid="km-parity").setK(3).setSeed(7)
+        degraded = _fit_degraded(est(), data)
+        monkeypatch.setenv("TPUML_FIT_MEM_BUDGET", "0")
+        explicit = est().fit(HostArrayBlockReader(np.asarray(data), block_rows=64))
+        assert np.array_equal(degraded.clusterCenters(), explicit.clusterCenters())
+
+    def test_logistic(self, tiny_budget, monkeypatch, data):
+        from spark_rapids_ml_tpu.models.logistic_regression import (
+            LogisticRegression,
+        )
+
+        x = np.asarray(data)
+        y = (x[:, 0] + x[:, 1] > 0).astype(np.int64)
+        est = lambda: LogisticRegression(uid="lr-parity").setMaxIter(25)
+        degraded = _fit_degraded(est(), (x, y))
+        monkeypatch.setenv("TPUML_FIT_MEM_BUDGET", "0")
+        explicit = est().fit((HostArrayBlockReader(x, block_rows=64), y))
+        assert np.array_equal(np.asarray(degraded.weights),
+                              np.asarray(explicit.weights))
+        assert np.array_equal(np.asarray(degraded.intercepts),
+                              np.asarray(explicit.intercepts))
+
+    def test_linear(self, tiny_budget, monkeypatch, data):
+        from spark_rapids_ml_tpu.models.linear_regression import LinearRegression
+
+        x = np.asarray(data)
+        y = x @ np.arange(1.0, x.shape[1] + 1) + 0.25
+        est = lambda: LinearRegression(uid="lin-parity")
+        degraded = _fit_degraded(est(), (x, y))
+        monkeypatch.setenv("TPUML_FIT_MEM_BUDGET", "0")
+        explicit = est().fit((HostArrayBlockReader(x, block_rows=64), y))
+        assert np.array_equal(np.asarray(degraded.coefficients),
+                              np.asarray(explicit.coefficients))
+        assert np.asarray(degraded.intercept) == np.asarray(explicit.intercept)
+
+    def test_pca(self, tiny_budget, monkeypatch, data):
+        from spark_rapids_ml_tpu.models.pca import PCA
+
+        est = lambda: PCA(uid="pca-parity").setK(3)
+        degraded = _fit_degraded(est(), data)
+        monkeypatch.setenv("TPUML_FIT_MEM_BUDGET", "0")
+        explicit = est().fit(HostArrayBlockReader(np.asarray(data), block_rows=64))
+        assert np.array_equal(np.asarray(degraded.pc), np.asarray(explicit.pc))
+
+    def test_degraded_block_size_is_the_streaming_default(self, monkeypatch):
+        """The reroute must use fit_block_rows() — the same default an
+        explicit streaming fit gets — or bit-identity would be luck."""
+        monkeypatch.setenv("TPUML_FIT_BLOCK_ROWS", "77")
+        assert fit_block_rows() == 77
+
+    def test_degrade_event_emitted(self, tiny_budget, tmp_path, data):
+        import json
+
+        from spark_rapids_ml_tpu.observability import events
+
+        path = tmp_path / "events.jsonl"
+        events.configure(str(path))
+        try:
+            from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+            _fit_degraded(KMeans().setK(3).setSeed(0), data)
+        finally:
+            events.configure(None)
+        recs = [json.loads(line) for line in path.read_text().splitlines()]
+        admissions = [r for r in recs if r["event"] == "fit_admission"]
+        assert any(
+            r["action"] == "degrade" and r["family"] == "kmeans"
+            and r["needed_bytes"] > r["budget_bytes"]
+            for r in admissions
+        )
+        assert any(r["event"] == "degrade" for r in recs)
+
+
+# --- degrade=off & families with no streaming rung -----------------------
+
+
+class TestStructuredRejection:
+    def test_degrade_off_raises_structured(self, tiny_budget, monkeypatch, data):
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        monkeypatch.setenv("TPUML_FIT_DEGRADE", "off")
+        _, delta = _counter_delta(
+            "fit.admission.rejected",
+            lambda: pytest.raises(
+                FitMemoryError, KMeans().setK(3).setSeed(0).fit, data
+            ),
+        )
+        assert delta == 1
+
+    def test_umap_over_budget(self, tiny_budget, data):
+        from spark_rapids_ml_tpu.models.umap import UMAP
+
+        with pytest.raises(FitMemoryError, match="streaming") as ei:
+            UMAP().setNNeighbors(5).fit(data)
+        assert ei.value.family == "umap"
+        assert ei.value.needed_bytes > ei.value.budget_bytes > 0
+
+    def test_random_forest_over_budget(self, tiny_budget, data):
+        from spark_rapids_ml_tpu.models.random_forest import (
+            RandomForestClassifier,
+        )
+
+        x = np.asarray(data)
+        y = (x[:, 0] > 0).astype(np.int64)
+        with pytest.raises(FitMemoryError) as ei:
+            RandomForestClassifier().setNumTrees(3).fit((x, y))
+        assert ei.value.family == "random_forest"
+        # The message must be actionable: names the budget knob.
+        assert "TPUML_FIT_MEM_BUDGET" in str(ei.value)
+
+    def test_weight_col_kmeans_cannot_stream(self, tiny_budget, data):
+        """A config the streaming path doesn't support rejects instead of
+        silently dropping the weights."""
+        import pandas as pd
+
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        x = np.asarray(data)
+        df = pd.DataFrame({
+            "features": list(x),
+            "w": np.ones(x.shape[0]),
+        })
+        with pytest.raises(FitMemoryError, match="weightCol"):
+            KMeans().setK(3).setSeed(0).setWeightCol("w").fit(df)
+
+
+# --- OOM recovery (acceptance: recovers without user intervention) -------
+
+
+class TestOomRecovery:
+    def test_ingest_oom_falls_back_to_streaming(self, no_budget, data):
+        """RESOURCE_EXHAUSTED at the placement chokepoint, every attempt:
+        the in-memory fit reroutes to streaming and completes."""
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        with inject("ingest.device_put=always:oom"):
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                model, recovered = _counter_delta(
+                    "fit.oom.recovered",
+                    lambda: KMeans().setK(3).setSeed(7).fit(data),
+                )
+        assert recovered == 1
+        assert model.clusterCenters().shape == (3, data.shape[1])
+        assert any(isinstance(w.message, DegradationWarning) for w in caught)
+
+    def test_ingest_oom_reclaims_caches(self, no_budget, data):
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        with inject("ingest.device_put=always:oom"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                _, reclaims = _counter_delta(
+                    "fit.oom.reclaims",
+                    lambda: KMeans().setK(3).setSeed(7).fit(data),
+                )
+        assert reclaims >= 1
+
+    def test_mid_stream_oom_halves_block_rows(self, tiny_budget, monkeypatch,
+                                              data):
+        """A degraded fit whose FIRST streaming pass dies with OOM retries
+        at half the block rows and recovers."""
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        monkeypatch.setenv("TPUML_FIT_BLOCK_ROWS", "512")
+        with inject("solver.segment=1:oom"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                model, halved = _counter_delta(
+                    "fit.oom.block_halved",
+                    lambda: KMeans().setK(3).setSeed(7).fit(data),
+                )
+        assert halved == 1
+        assert model.clusterCenters().shape == (3, data.shape[1])
+
+    def test_oom_retries_exhausted_is_structured(self, tiny_budget, data):
+        """Every streaming attempt OOMs: the fit ends in FitMemoryError
+        (with the OOM chained), never a raw RuntimeError."""
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        with inject("solver.segment=always:oom"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with pytest.raises(FitMemoryError) as ei:
+                    KMeans().setK(3).setSeed(7).fit(data)
+        assert is_oom_error(ei.value.__cause__)
+
+    def test_raw_oom_never_escapes_fit(self, no_budget, monkeypatch, data):
+        """The Estimator.fit boundary net: degrade off, OOM at ingest —
+        the error the caller sees is FitMemoryError, not the raw one."""
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        monkeypatch.setenv("TPUML_FIT_DEGRADE", "off")
+        with inject("ingest.device_put=always:oom"):
+            with pytest.raises(FitMemoryError):
+                KMeans().setK(3).setSeed(0).fit(data)
+
+    def test_logistic_recovery_matches_streaming_result(self, no_budget, data):
+        """Recovered-fit correctness, not just completion: the fallback
+        result equals the explicit streaming fit."""
+        from spark_rapids_ml_tpu.models.logistic_regression import (
+            LogisticRegression,
+        )
+
+        x = np.asarray(data)
+        y = (x[:, 0] > 0).astype(np.int64)
+        with inject("ingest.device_put=always:oom"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                recovered = LogisticRegression(uid="l").setMaxIter(20).fit((x, y))
+        explicit = LogisticRegression(uid="l").setMaxIter(20).fit(
+            (HostArrayBlockReader(x, block_rows=fit_block_rows()), y)
+        )
+        assert np.array_equal(np.asarray(recovered.weights),
+                              np.asarray(explicit.weights))
+
+
+# --- ArrowBlockReader: parquet as a first-class fit input -----------------
+
+
+class TestArrowBlockReader:
+    @pytest.fixture
+    def parquet_xy(self, tmp_path, rng):
+        pa = pytest.importorskip("pyarrow")
+        pq = pytest.importorskip("pyarrow.parquet")
+        x = rng.normal(size=(500, 5))
+        y = x @ np.arange(1.0, 6.0) + 0.5
+        table = pa.table(
+            {f"f{j}": x[:, j] for j in range(5)} | {"label": y}
+        )
+        path = tmp_path / "train.parquet"
+        pq.write_table(table, path, row_group_size=128)
+        return str(path), x, y
+
+    def test_reader_blocks_match_matrix(self, parquet_xy):
+        from spark_rapids_ml_tpu.core.data import ArrowBlockReader
+
+        path, x, _ = parquet_xy
+        reader = ArrowBlockReader(path, exclude=("label",), block_rows=100)
+        got = np.vstack(list(reader.iter_blocks()))
+        np.testing.assert_allclose(got, x, rtol=0, atol=0)
+        # Re-iterable: a second pass yields the same rows.
+        again = np.vstack(list(reader.iter_blocks()))
+        assert np.array_equal(got, again)
+
+    def test_parquet_fit_close_to_in_memory(self, parquet_xy):
+        """Documented tolerance: the streaming fit accumulates moments in
+        float32 blocks, so coefficients match the in-memory float fit to
+        ~1e-4 relative — not bitwise (different reduction order)."""
+        from spark_rapids_ml_tpu.core.data import ArrowBlockReader
+        from spark_rapids_ml_tpu.models.linear_regression import (
+            LinearRegression,
+        )
+
+        path, x, y = parquet_xy
+        reader = ArrowBlockReader(path, exclude=("label",), block_rows=100)
+        label = ArrowBlockReader(path).read_column("label")
+        streamed = LinearRegression(uid="pq").fit((reader, label))
+        in_mem = LinearRegression(uid="pq").fit((x, y))
+        np.testing.assert_allclose(
+            np.asarray(streamed.coefficients),
+            np.asarray(in_mem.coefficients),
+            rtol=1e-4,
+        )
+
+    def test_parquet_kmeans_over_budget_stays_streaming(self, parquet_xy,
+                                                        tiny_budget):
+        """A parquet reader is already a streaming source: tiny budget or
+        not, the fit runs without degradation ceremony."""
+        from spark_rapids_ml_tpu.core.data import ArrowBlockReader
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        path, x, _ = parquet_xy
+        reader = ArrowBlockReader(path, exclude=("label",), block_rows=100)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", DegradationWarning)
+            model = KMeans().setK(3).setSeed(0).fit(reader)
+        assert model.clusterCenters().shape == (3, x.shape[1])
+
+
+# --- serving satellite: the shed backoff hint ----------------------------
+
+
+class TestRetryAfterHint:
+    def test_cold_hint_is_default(self):
+        from spark_rapids_ml_tpu.serving import admission
+
+        # A fresh registry histogram may or may not have samples from
+        # sibling tests; assert only the contract: positive and finite.
+        hint = admission.retry_after_hint_ms()
+        assert hint > 0 and np.isfinite(hint)
+
+    def test_overloaded_carries_hint(self):
+        from spark_rapids_ml_tpu.serving.admission import (
+            AdmissionQueue,
+            Overloaded,
+            Request,
+        )
+
+        q = AdmissionQueue(limit=0)
+        req = Request(key=("m", 1, 4, "float32"), x=np.zeros((1, 4)), n=1,
+                      version=None, run_id="r")
+        with pytest.raises(Overloaded) as ei:
+            q.submit(req)
+        assert ei.value.retry_after_ms > 0
+
+    def test_host_matrix_roundtrip(self, data):
+        m = host_matrix(data)
+        assert m.ndim == 2 and m.shape == np.asarray(data).shape

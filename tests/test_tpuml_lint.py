@@ -164,6 +164,92 @@ class TestJaxHazards:
         assert not rules_of(findings) & {"jax-host-sync", "jax-traced-branch"}
 
 
+def lint_model_src(tmp_path, src, name="fake.py"):
+    """Write a fixture under the models/ package path — the
+    jax-whole-dataset-put rule only audits model fit files."""
+    pkg = tmp_path / "spark_rapids_ml_tpu" / "models"
+    pkg.mkdir(parents=True, exist_ok=True)
+    return lint_src(
+        tmp_path, src,
+        name=f"spark_rapids_ml_tpu/models/{name}", root=tmp_path,
+    )
+
+
+class TestWholeDatasetPut:
+    BAD_FIT = '''
+        """f"""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.core.ingest import extract_features
+
+
+        class M:
+            def _fit(self, dataset):
+                rows = extract_features(dataset, "features")
+                a = jnp.asarray(rows)          # HAZARD: extractor-tainted
+                b = jax.device_put(dataset)    # HAZARD: raw fit param
+                return a, b
+    '''
+
+    def test_true_positives(self, tmp_path):
+        findings = lint_model_src(tmp_path, self.BAD_FIT)
+        hits = [f for f in findings if f.rule == "jax-whole-dataset-put"]
+        assert len(hits) == 2, findings
+        assert all("ingest" in h.message for h in hits)
+
+    def test_only_models_fit_paths_audited(self, tmp_path):
+        # Same source outside models/ — rule does not fire.
+        findings = lint_src(tmp_path, self.BAD_FIT, name="ops_fake.py")
+        assert not [f for f in findings if f.rule == "jax-whole-dataset-put"]
+        # Same source in models/ but not a _fit* function — no finding.
+        findings = lint_model_src(tmp_path, self.BAD_FIT.replace(
+            "def _fit(", "def transform("
+        ))
+        assert not [f for f in findings if f.rule == "jax-whole-dataset-put"]
+
+    def test_tuple_unpack_taints_matrix_only(self, tmp_path):
+        findings = lint_model_src(tmp_path, '''
+            """f"""
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.core.ingest import _extract_xy
+
+
+            class M:
+                def _fit(self, dataset):
+                    x, y = _extract_xy(dataset, "f", "l")
+                    bad = jnp.asarray(x)   # HAZARD: the (n, d) matrix
+                    ok = jnp.asarray(y)    # labels are O(n): fine
+                    return bad, ok
+        ''')
+        hits = [f for f in findings if f.rule == "jax-whole-dataset-put"]
+        assert len(hits) == 1 and "x" in hits[0].message
+
+    def test_guarded_and_bounded_paths_clean(self, tmp_path):
+        findings = lint_model_src(tmp_path, '''
+            """f"""
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.core.ingest import (
+                extract_features,
+                place_array,
+                prepare_rows,
+            )
+
+
+            class M:
+                def _fit(self, dataset):
+                    rows = extract_features(dataset, "features")
+                    x = prepare_rows(rows)         # the guarded funnel
+                    xj = place_array(rows)         # the guarded chokepoint
+                    sample = rows[:256]
+                    s = jnp.asarray(sample)        # bounded slice: fine
+                    return x, xj, s
+        ''')
+        assert not [f for f in findings if f.rule == "jax-whole-dataset-put"]
+
+
 # --- family (b): lock discipline ---------------------------------------
 
 

@@ -51,6 +51,12 @@ RULES: Dict[str, Rule] = {
         Rule("jax-static-loop-arg", "jax", ERROR,
              "a static argument that varies per loop iteration compiles a new "
              "program every pass — the retrace bait PR 2/5 exist to kill"),
+        Rule("jax-whole-dataset-put", "jax", ERROR,
+             "a model fit path uploading the raw extracted dataset with a "
+             "bare jnp.asarray/jax.device_put bypasses the ingest "
+             "chokepoint (fault point, OOM retry, cache reclaim) the "
+             "memory-safe data plane gates fits through — use "
+             "prepare_rows or ingest.place_array"),
         # (b) lock discipline
         Rule("lock-guarded", "locks", ERROR,
              "an attribute annotated '# guarded-by: <lock>' was touched "

@@ -266,14 +266,99 @@ def _check_retrace_bait(module: ModuleContext,
     return findings
 
 
+#: Extractors whose result is the WHOLE dataset (O(n*d) host memory):
+#: placing it with a bare put bypasses the memory-safe fit chokepoint.
+#: extract_weights is deliberately absent — an (n,) weight vector is
+#: O(n), not the allocation the admission gate prices.
+DATASET_EXTRACTORS = {
+    "extract_features",
+    "extract_column",
+    "as_matrix",
+    "matrix_like",
+    "_extract_xy",
+}
+
+
+def _call_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute):
+            return f.attr
+    return None
+
+
+def _check_whole_dataset_put(fn: ast.FunctionDef,
+                             module: ModuleContext) -> List[Finding]:
+    """``jax-whole-dataset-put``: inside a model ``_fit*`` path, a bare
+    ``jnp.asarray`` / ``jax.device_put`` whose argument is the raw
+    dataset — a function parameter, or a name assigned from one of the
+    dataset extractors — uploads O(n*d) bytes around the guarded ingest
+    funnel (``prepare_rows`` / ``ingest.place_array``)."""
+    findings: List[Finding] = []
+    tainted: Set[str] = {
+        p for p in _param_names(fn) if p not in ("self", "params")
+    }
+    # One forward pass: taint flows through extractor assignments in
+    # source order before the puts below them are judged.
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            name = _call_name(node.value)
+            target = node.targets[0]
+            if name in DATASET_EXTRACTORS:
+                if isinstance(target, ast.Name):
+                    tainted.add(target.id)
+                elif isinstance(target, ast.Tuple) and target.elts:
+                    # (X, y) unpack: only the matrix side is O(n*d).
+                    first = target.elts[0]
+                    if isinstance(first, ast.Name):
+                        tainted.add(first.id)
+            elif isinstance(target, ast.Name):
+                # Reassignment from anything else clears the taint
+                # (e.g. a bounded sample drawn FROM the stream).
+                tainted.discard(target.id)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        is_put = (
+            isinstance(f, ast.Attribute)
+            and (
+                (f.attr == "asarray"
+                 and isinstance(f.value, ast.Name)
+                 and module.import_bindings.get(f.value.id) == "jax.numpy")
+                or (f.attr == "device_put"
+                    and isinstance(f.value, ast.Name)
+                    and module.import_bindings.get(f.value.id) == "jax")
+            )
+        )
+        if not is_put:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Name) and arg.id in tainted:
+            fname = f"{f.value.id}.{f.attr}"  # type: ignore[union-attr]
+            findings.append(Finding(
+                module.rel, node.lineno, node.col_offset,
+                "jax-whole-dataset-put",
+                f"{fname}({arg.id}) in {fn.name}() uploads the whole "
+                "dataset around the guarded ingest funnel — route it "
+                "through prepare_rows or ingest.place_array",
+            ))
+    return findings
+
+
 def check(module: ModuleContext, repo: RepoContext) -> List[Finding]:
     findings: List[Finding] = []
     jitted: Dict[str, Tuple[ast.FunctionDef, Set[str]]] = {}
+    in_models = module.rel.startswith("spark_rapids_ml_tpu/models/")
     for node in ast.walk(module.tree):
         if isinstance(node, ast.FunctionDef):
             static = jit_static_names(node, module)
             if static is not None:
                 jitted[node.name] = (node, static)
                 findings.extend(_check_traced_region(node, module, static))
+            if in_models and node.name.startswith("_fit"):
+                findings.extend(_check_whole_dataset_put(node, module))
     findings.extend(_check_retrace_bait(module, jitted))
     return findings

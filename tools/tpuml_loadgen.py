@@ -63,25 +63,12 @@ def build_model(family: str, d: int, k: int, seed: int):
     raise SystemExit(f"unknown --family {family!r}")
 
 
-def percentile_from_histogram(hist_value: dict, q: float) -> float:
-    """Linear-interpolated percentile from a fixed-bucket histogram
-    snapshot (``{"buckets": {le: cumulative}, "count": n}``). The +Inf
-    bucket reports its lower edge (the histogram's resolution limit)."""
-    count = hist_value["count"]
-    if count == 0:
-        return float("nan")
-    target = q * count
-    prev_le, prev_cum = 0.0, 0
-    for le, cum in sorted(hist_value["buckets"].items()):
-        if cum >= target:
-            if le == float("inf"):
-                return prev_le
-            if cum == prev_cum:
-                return le
-            frac = (target - prev_cum) / (cum - prev_cum)
-            return prev_le + frac * (le - prev_le)
-        prev_le, prev_cum = le, cum
-    return prev_le
+# The percentile math moved next to the histogram type it reads
+# (observability/metrics.py) so the serving shed-backoff hint shares it;
+# re-exported here because scripts import it from the loadgen.
+from spark_rapids_ml_tpu.observability.metrics import (  # noqa: E402,F401
+    percentile_from_histogram,
+)
 
 
 def main() -> None:
@@ -146,9 +133,14 @@ def main() -> None:
                     args.family, probes[tid, j], timeout=args.timeout
                 ).result()
                 ok[tid] += 1
-            except Overloaded:
+            except Overloaded as exc:
                 with err_lock:
                     errors["overloaded"] += 1
+                # Honor the server's backoff hint (p95 of the live
+                # latency histogram ~= one queue residency), capped so a
+                # pathological tail can't park the generator.
+                if exc.retry_after_ms > 0:
+                    time.sleep(min(exc.retry_after_ms, 100.0) / 1e3)
             except DeadlineExceeded:
                 with err_lock:
                     errors["deadline"] += 1
