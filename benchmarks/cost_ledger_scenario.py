@@ -1,7 +1,9 @@
 """Deterministic fit+serve scenario for the cost-ledger CI gate.
 
 Runs a fixed KMeans workload — a segmented (checkpointed) fit plus a
-batched serving session across three row buckets — under
+batched serving session across three row buckets — then a small UMAP
+fit and a device AUC evaluation (the PR-11 hot-spot families: the
+tail-scatter SGD and the sort-attack evaluator are gated too) under
 ``TPUML_COST_LEDGER=1`` so the resulting ledger document is stable
 call-for-call: same programs, same invocation counts, same analyzed
 flops/bytes for a given jax version. CI dumps the ledger
@@ -35,6 +37,9 @@ def main() -> None:
     # driver chokepoint contributes `segment`-kind entries.
     os.environ.setdefault("TPUML_CHECKPOINT_EVERY", "5")
     os.environ.setdefault("TPUML_CHECKPOINT_DIR", "/tmp/tpuml-cost-ck")
+    # UMAP layout checkpointing is opt-in; it routes the epoch SGD
+    # through the ledgered segment path, so the tail scatter is gated.
+    os.environ.setdefault("TPUML_CHECKPOINT_UMAP", "1")
 
     from spark_rapids_ml_tpu.clustering import KMeans
     from spark_rapids_ml_tpu.observability import costs
@@ -52,11 +57,33 @@ def main() -> None:
         for n in (5, 40, 300):
             model.predict(x[:n])
 
+    # UMAP fit: the layout SGD (and its tail scatter) joins the gate —
+    # a regression in the epoch program's analyzed cost fails CI.
+    from spark_rapids_ml_tpu.manifold import UMAP
+
+    xu = rng.normal(size=(256, 8)).astype(np.float32)
+    umap_model = UMAP().setNNeighbors(5).setNEpochs(10).setSeed(1).fit(xu)
+    assert umap_model.embedding.shape == (256, 2)
+
+    # Device AUC: the sort-attack evaluator program (ops.metrics), both
+    # metrics so each compiled variant is ledgered.
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.metrics import binary_auc_device
+
+    ys = (rng.uniform(size=2048) < 0.5).astype(np.float32)
+    ss = (ys * 0.4 + rng.normal(size=2048)).astype(np.float32)
+    for metric in ("areaUnderROC", "areaUnderPR"):
+        float(binary_auc_device(jnp.asarray(ys), jnp.asarray(ss), metric=metric))
+
     doc = costs.ledger_snapshot()
     problems = costs.validate_ledger(doc)
     assert not problems, problems
     kinds = {e["kind"] for e in doc["entries"]}
     assert "aot" in kinds and "segment" in kinds, sorted(kinds)
+    families = {e["family"] for e in doc["entries"]}
+    assert "umap.layout.segment" in families, sorted(families)
+    assert "metrics.binary_auc" in families, sorted(families)
     print(
         f"cost-ledger scenario: {len(doc['entries'])} programs, "
         f"kinds={sorted(kinds)}"

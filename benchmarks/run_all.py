@@ -34,6 +34,7 @@ CONFIGS = [
     ("config14_evaluators.py", {}),
     ("config15_serving.py", {}),
     ("config16_server.py", {}),
+    ("config17_kmeans_packed.py", {}),
 ]
 
 

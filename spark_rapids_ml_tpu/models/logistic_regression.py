@@ -60,7 +60,14 @@ from spark_rapids_ml_tpu.core.serving import (
     serve_rows,
     stream_block_rows,
 )
+from spark_rapids_ml_tpu.utils.envknobs import env_choice
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
+
+
+def _logistic_fused_knob() -> bool:
+    """TPUML_LOGISTIC_FUSED, read in the model layer (outside jit) and
+    plumbed into the solvers as a static arg."""
+    return env_choice("TPUML_LOGISTIC_FUSED", ("0", "1"), "1") == "1"
 
 
 def _forward_kernel(x, w, b, *, n_classes: int, threshold: float):
@@ -311,6 +318,9 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                 y_int, int(xs.shape[0]), n_true=n, mesh=self.mesh, dtype=jnp.int32
             )
             use_multinomial = family == "multinomial"
+            # Knob read OUTSIDE jit; the flag rides into the programs as a
+            # static arg (fused one-pass loss+grad vs legacy two-pass AD).
+            fused = _logistic_fused_knob()
             enet = self.getElasticNetParam()
             # regParam == 0 means zero effective penalty whatever enet says:
             # use the L-BFGS path (faster, and it applies the multinomial
@@ -353,6 +363,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                     multinomial=use_multinomial,
                     init_w=init_w,
                     init_b=init_b,
+                    fused=fused,
                     **extra,
                 )
             else:
@@ -378,6 +389,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                     max_iter=self.getMaxIter(),
                     tol=self.getTol(),
                     multinomial=use_multinomial,
+                    fused=fused,
                 )
         # Strip model-axis feature padding (device slice, stays async);
         # host float64 conversion happens lazily inside the model.
@@ -455,6 +467,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                 max_iter=self.getMaxIter(),
                 tol=self.getTol(),
                 multinomial=family == "multinomial",
+                fused=_logistic_fused_knob(),
             )
         model = LogisticRegressionModel(
             self.uid,

@@ -158,6 +158,20 @@ class RunReport:
         cost ledger is off) — see ``observability/costs.py``."""
         return self.costs
 
+    def top_hot_spot(self) -> Optional[dict]:
+        """The costliest ledger row by wall time — the next demolition
+        target once the current hot spots are optimized. Returns the row
+        dict plus its ``wall_share`` of the run's total attributed wall,
+        or None when the ledger is off or recorded no wall time."""
+        timed = [r for r in self.costs if r.get("wall_seconds")]
+        if not timed:
+            return None
+        total = sum(r["wall_seconds"] for r in timed)
+        top = max(timed, key=lambda r: r["wall_seconds"])
+        out = dict(top)
+        out["wall_share"] = top["wall_seconds"] / total if total > 0 else 0.0
+        return out
+
     def summary(self) -> dict:
         out = {
             "run_id": self.run_id,
@@ -206,6 +220,7 @@ class RunReport:
                     f"  device {dev}: {stats['bytes_in_use']} bytes in use"
                 )
         if self.costs:
+            hot = self.top_hot_spot()
             lines.append("  where the FLOPs and bytes went:")
             lines.append(
                 f"    {'program':<40s} {'kind':<8s} {'calls':>6s} "
@@ -217,6 +232,18 @@ class RunReport:
                 byts = row.get("bytes_accessed")
                 rate = row.get("achieved_flops_per_sec")
                 util = row.get("utilization")
+                # Flag the top residual hot spot: the row that would pay
+                # the most to optimize next.
+                is_hot = (
+                    hot is not None
+                    and row.get("family") == hot.get("family")
+                    and row.get("kind") == hot.get("kind")
+                )
+                mark = (
+                    f"  << hot spot ({hot['wall_share']:.0%} of wall)"
+                    if is_hot
+                    else ""
+                )
                 lines.append(
                     f"    {str(row.get('family'))[:40]:<40s} "
                     f"{str(row.get('kind')):<8s} "
@@ -226,6 +253,7 @@ class RunReport:
                     f"{(row.get('wall_seconds') or 0.0) * 1e3:>9.2f} "
                     f"{(f'{rate / 1e9:.2f}' if rate else '-'):>8s} "
                     f"{(f'{util:.1%}' if util is not None else '-'):>6s}"
+                    f"{mark}"
                 )
         if self.hbm.get("by_span"):
             lines.append(

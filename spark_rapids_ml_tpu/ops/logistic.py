@@ -45,28 +45,124 @@ class LogisticFit(NamedTuple):
     loss: jax.Array  # final objective value (standardized space)
 
 
-def _make_logistic_loss(x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec):
+#: Row-block length of the fused one-pass objective: big enough that the
+#: per-evaluation GEMMs stay MXU-bound, small enough that a block's
+#: standardized slice is a cache/VMEM-resident temporary instead of a
+#: materialized (n, d) HBM array.
+_FUSED_BLOCK_ROWS = 65536
+
+
+def _make_logistic_loss(
+    x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec,
+    fused=False,
+):
     """The ONE home of the (standardized-space) logistic objective —
     closed over by the monolithic :func:`fit_logistic` program, the
     segmented :func:`_lbfgs_segment` program, and the finalizer, so all
     three optimize/evaluate literally the same expression (the
-    bit-identity bar of the checkpoint subsystem)."""
+    bit-identity bar of the checkpoint subsystem).
 
-    def loss_fn(params):
-        w, b = params
-        xs = (x - offset) / scale
+    ``fused=False`` returns the plain objective (gradients via autodiff,
+    which saves the standardized (n, d) design as a residual — X is
+    effectively streamed twice per evaluation). ``fused=True`` returns a
+    ``jax.custom_vjp`` objective whose forward pass computes the value
+    AND the analytic gradient in ONE blocked sweep over X — the algebra
+    needs only X^T(p - y) and the logloss sum, so each row block's
+    standardized slice lives and dies on-chip (VERDICT r5 #4: the second
+    X pass was ~16.7% of the fit's HBM traffic). The fused callable also
+    exposes ``.value_and_grad(params)`` for drivers that want both
+    without round-tripping through AD. Fused and legacy agree to float
+    tolerance (per-block partial sums reduce in a different order);
+    every segmented/monolithic pair shares ONE flag, so checkpoint
+    bit-identity is preserved in both modes."""
+
+    def _block_terms(xb, yb, mb, w, b):
+        """One row block's (masked loss sum, unnormalized dL/dw, dL/db)."""
+        xs = (xb - offset) / scale
         logits = jnp.matmul(xs, w, precision=prec)
         if fit_intercept:
             logits = logits + b
         if c == 1:
             z = logits[:, 0]
             # log(1+e^z) - y z, numerically stable via softplus
-            per_row = jax.nn.softplus(z) - y_target * z
+            per_row = jax.nn.softplus(z) - yb * z
+            dz = ((jax.nn.sigmoid(z) - yb) * mb)[:, None]
         else:
-            per_row = -jnp.sum(y_target * jax.nn.log_softmax(logits, axis=1), axis=1)
-        data_loss = jnp.sum(per_row * mask) / n
-        return data_loss + 0.5 * reg_param * jnp.sum(w * w)
+            logp = jax.nn.log_softmax(logits, axis=1)
+            per_row = -jnp.sum(yb * logp, axis=1)
+            dz = (jnp.exp(logp) - yb) * mb[:, None]
+        loss_b = jnp.sum(per_row * mb)
+        gw_b = jnp.matmul(xs.T, dz, precision=prec)
+        gb_b = jnp.sum(dz, axis=0)
+        return loss_b, gw_b, gb_b
 
+    if not fused:
+
+        def loss_fn(params):
+            w, b = params
+            xs = (x - offset) / scale
+            logits = jnp.matmul(xs, w, precision=prec)
+            if fit_intercept:
+                logits = logits + b
+            if c == 1:
+                z = logits[:, 0]
+                # log(1+e^z) - y z, numerically stable via softplus
+                per_row = jax.nn.softplus(z) - y_target * z
+            else:
+                per_row = -jnp.sum(
+                    y_target * jax.nn.log_softmax(logits, axis=1), axis=1
+                )
+            data_loss = jnp.sum(per_row * mask) / n
+            return data_loss + 0.5 * reg_param * jnp.sum(w * w)
+
+        return loss_fn
+
+    nrows = x.shape[0]
+    bs = min(_FUSED_BLOCK_ROWS, nrows)
+
+    def value_and_grad(params):
+        w, b = params
+        if nrows <= bs:
+            loss_s, gw_s, gb_s = _block_terms(x, y_target, mask, w, b)
+        else:
+            nb = -(-nrows // bs)
+
+            def body(i, acc):
+                l_a, gw_a, gb_a = acc
+                # The last block slides back to stay in bounds; rows the
+                # previous block already counted mask to zero.
+                start = jnp.minimum(i * bs, nrows - bs)
+                xb = jax.lax.dynamic_slice_in_dim(x, start, bs)
+                yb = jax.lax.dynamic_slice_in_dim(y_target, start, bs)
+                mb = jax.lax.dynamic_slice_in_dim(mask, start, bs)
+                keep = (start + jnp.arange(bs)) >= i * bs
+                l_b, gw_b, gb_b = _block_terms(
+                    xb, yb, mb * keep.astype(mb.dtype), w, b
+                )
+                return l_a + l_b, gw_a + gw_b, gb_a + gb_b
+
+            loss_s, gw_s, gb_s = jax.lax.fori_loop(
+                0, nb, body,
+                (jnp.zeros((), x.dtype), jnp.zeros_like(w), jnp.zeros((c,), x.dtype)),
+            )
+        value = loss_s / n + 0.5 * reg_param * jnp.sum(w * w)
+        gw = gw_s / n + reg_param * w
+        gb = gb_s / n if fit_intercept else jnp.zeros_like(b)
+        return value, (gw, gb.astype(b.dtype))
+
+    @jax.custom_vjp
+    def loss_fn(params):
+        return value_and_grad(params)[0]
+
+    def _fwd(params):
+        value, grad = value_and_grad(params)
+        return value, grad
+
+    def _bwd(grad, ct):
+        return (jax.tree_util.tree_map(lambda g: g * ct, grad),)
+
+    loss_fn.defvjp(_fwd, _bwd)
+    loss_fn.value_and_grad = value_and_grad
     return loss_fn
 
 
@@ -92,6 +188,7 @@ def _masked_feature_moments(x: jax.Array, mask: jax.Array) -> Tuple[jax.Array, j
         "max_iter",
         "precision",
         "multinomial",
+        "fused",
     ),
 )
 def fit_logistic(
@@ -108,6 +205,7 @@ def fit_logistic(
     multinomial: bool = False,
     init_w: jax.Array | None = None,
     init_b: jax.Array | None = None,
+    fused: bool = True,
 ) -> LogisticFit:
     """Fit binomial or multinomial logistic regression.
 
@@ -167,7 +265,8 @@ def fit_logistic(
         y_target = jax.nn.one_hot(y, c, dtype=dtype)
 
     loss_fn = _make_logistic_loss(
-        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec
+        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec,
+        fused=fused,
     )
 
     if init_w is None:
@@ -254,12 +353,15 @@ def _logistic_prep(x, mask, fit_intercept: bool, standardization: bool):
 
 @partial(
     jax.jit,
-    static_argnames=("c", "fit_intercept", "max_iter", "every", "precision"),
+    static_argnames=(
+        "c", "fit_intercept", "max_iter", "every", "precision", "fused",
+    ),
 )
 def _lbfgs_segment(
     x, y_target, mask, offset, scale, n, reg_param, tol,
     params, opt_state, it, gnorm,
     c: int, fit_intercept: bool, max_iter: int, every: int, precision: str,
+    fused: bool = True,
 ):
     """Up to ``every`` L-BFGS iterations from an explicit optimizer
     state — exactly :func:`fit_logistic`'s loop body and stopping rule
@@ -267,7 +369,8 @@ def _lbfgs_segment(
     gradient norm) carry visible as a pytree between segments."""
     prec = _dot_precision(precision)
     loss_fn = _make_logistic_loss(
-        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec
+        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec,
+        fused=fused,
     )
     solver = optax.lbfgs()
     from spark_rapids_ml_tpu.utils.compat import value_and_grad_from_state
@@ -296,17 +399,20 @@ def _lbfgs_segment(
     return params, opt_state, it, gnorm
 
 
-@partial(jax.jit, static_argnames=("c", "fit_intercept", "precision"))
+@partial(
+    jax.jit, static_argnames=("c", "fit_intercept", "precision", "fused")
+)
 def _logistic_finalize(
     x, y_target, mask, offset, scale, n, reg_param, w, b,
-    c: int, fit_intercept: bool, precision: str,
+    c: int, fit_intercept: bool, precision: str, fused: bool = True,
 ):
     """:func:`fit_logistic`'s post-solve tail (identifiability pivot,
     back-map to original feature space, final objective) as its own
     program for the segmented driver."""
     prec = _dot_precision(precision)
     loss_fn = _make_logistic_loss(
-        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec
+        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec,
+        fused=fused,
     )
     if c > 1:
         do_center = reg_param == 0.0
@@ -333,6 +439,7 @@ def fit_logistic_resumable(
     init_w: jax.Array | None = None,
     init_b: jax.Array | None = None,
     mesh=None,
+    fused: bool = True,
 ) -> LogisticFit:
     """Preemption-tolerant :func:`fit_logistic` (the L-BFGS / L2 path):
     a host outer loop over jitted L-BFGS segments, the (params, optimizer
@@ -413,6 +520,7 @@ def fit_logistic_resumable(
                 static=dict(
                     c=c, fit_intercept=fit_intercept, max_iter=max_iter,
                     every=checkpointer.every, precision=precision,
+                    fused=fused,
                 ),
                 name="logistic.lbfgs.segment",
             )
@@ -426,7 +534,7 @@ def fit_logistic_resumable(
     (w, b), _, n_iter, _ = carry
     w_orig, b_orig, final_loss = _logistic_finalize(
         x, y_target, mask, offset, scale, n, reg_param, w, b,
-        c=c, fit_intercept=fit_intercept, precision=precision,
+        c=c, fit_intercept=fit_intercept, precision=precision, fused=fused,
     )
     if out_dtype is not None:  # f64 fallback solve: hand back f32
         w_orig = w_orig.astype(out_dtype)
@@ -445,6 +553,7 @@ def fit_logistic_resumable(
         "max_iter",
         "precision",
         "multinomial",
+        "fused",
     ),
 )
 def fit_logistic_elastic_net(
@@ -460,6 +569,7 @@ def fit_logistic_elastic_net(
     tol: float = 1e-7,
     precision: str = "highest",
     multinomial: bool = False,
+    fused: bool = True,
 ) -> LogisticFit:
     """Elastic-net logistic regression by FISTA (proximal gradient).
 
@@ -520,19 +630,35 @@ def fit_logistic_elastic_net(
     # 1.1 safety margin: power iteration converges from below.
     lip = 1.1 * lam_max * curvature / n + reg2 + 1e-12
 
-    def smooth_loss(params):
-        w, b = params
-        logits = xs_matvec(w)
-        if fit_intercept:
-            logits = logits + b
-        if c == 1:
-            z = logits[:, 0]
-            per_row = jax.nn.softplus(z) - y_target * z
-        else:
-            per_row = -jnp.sum(y_target * jax.nn.log_softmax(logits, axis=1), axis=1)
-        return jnp.sum(per_row * mask) / n + 0.5 * reg2 * jnp.sum(w * w)
+    # The FISTA smooth part (log-loss + L2 at reg2) IS the L-BFGS
+    # objective at reg_param=reg2 — so the fused one-pass builder serves
+    # both solvers from the same algebra.
+    if fused:
+        smooth_loss = _make_logistic_loss(
+            x, y_target, mask, offset, scale, n, reg2, c, fit_intercept,
+            prec, fused=True,
+        )
 
-    grad_fn = jax.grad(smooth_loss)
+        def grad_fn(params):
+            return smooth_loss.value_and_grad(params)[1]
+
+    else:
+
+        def smooth_loss(params):
+            w, b = params
+            logits = xs_matvec(w)
+            if fit_intercept:
+                logits = logits + b
+            if c == 1:
+                z = logits[:, 0]
+                per_row = jax.nn.softplus(z) - y_target * z
+            else:
+                per_row = -jnp.sum(
+                    y_target * jax.nn.log_softmax(logits, axis=1), axis=1
+                )
+            return jnp.sum(per_row * mask) / n + 0.5 * reg2 * jnp.sum(w * w)
+
+        grad_fn = jax.grad(smooth_loss)
 
     w0 = jnp.zeros((d, c), dtype=dtype)
     b0 = jnp.zeros((c,), dtype=dtype)
@@ -567,17 +693,42 @@ def fit_logistic_elastic_net(
     return LogisticFit(w_orig, b_orig, n_iter, final_loss)
 
 
-@partial(jax.jit, static_argnames=("c", "fit_intercept", "precision"))
-def _stream_block_value_grad(xb, yb, w, b, offset, scale, c, fit_intercept, precision):
+@partial(
+    jax.jit, static_argnames=("c", "fit_intercept", "precision", "fused")
+)
+def _stream_block_value_grad(
+    xb, yb, w, b, offset, scale, c, fit_intercept, precision,
+    fused: bool = True,
+):
     """UNnormalized block loss + gradient contribution for the streaming
     fit: sum_i logloss_i over this block only (the driver divides by the
-    global n and adds the L2 term once)."""
+    global n and adds the L2 term once). ``fused=True`` computes the
+    value and the analytic gradient in one sweep of the block (no AD
+    residual); ``fused=False`` keeps the autodiff formulation."""
     prec = _dot_precision(precision)
     dtype = xb.dtype
     if c == 1:
         y_t = (yb == 1).astype(dtype)
     else:
         y_t = jax.nn.one_hot(yb, c, dtype=dtype)
+
+    if fused:
+        xs = (xb - offset) / scale
+        logits = jnp.matmul(xs, w, precision=prec)
+        if fit_intercept:
+            logits = logits + b
+        if c == 1:
+            z = logits[:, 0]
+            per_row = jax.nn.softplus(z) - y_t * z
+            dz = (jax.nn.sigmoid(z) - y_t)[:, None]
+        else:
+            logp = jax.nn.log_softmax(logits, axis=1)
+            per_row = -jnp.sum(y_t * logp, axis=1)
+            dz = jnp.exp(logp) - y_t
+        val = jnp.sum(per_row)
+        gw = jnp.matmul(xs.T, dz, precision=prec)
+        gb = jnp.sum(dz, axis=0) if fit_intercept else jnp.zeros_like(b)
+        return val, gw, gb
 
     def f(params):
         w_, b_ = params
@@ -639,6 +790,7 @@ def fit_logistic_streaming(
     precision: str = "highest",
     multinomial: bool = False,
     dtype=None,
+    fused: bool = True,
 ) -> LogisticFit:
     """Multi-pass L-BFGS fit over a RE-ITERABLE (X_block, y_block) source.
 
@@ -690,7 +842,8 @@ def fit_logistic_streaming(
             xj = jnp.asarray(np.ascontiguousarray(xb, dtype=np_dtype))
             yj = jnp.asarray(np.asarray(yb).ravel().astype(np.int32))
             v, gw, gb = _stream_block_value_grad(
-                xj, yj, wj, bj, offset_j, scale_j, c, fit_intercept, precision
+                xj, yj, wj, bj, offset_j, scale_j, c, fit_intercept,
+                precision, fused,
             )
             tot, gw_acc, gb_acc = tot + v, gw_acc + gw, gb_acc + gb
         val = float(tot) / n + 0.5 * reg_param * float(np.sum(w * w))

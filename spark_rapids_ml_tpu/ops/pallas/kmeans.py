@@ -178,6 +178,175 @@ def assign_stats_fused(
     return sums[:k], counts[0, :k], cost[0, 0], c2[0, :k]
 
 
+def _packed_geometry(d_pad: int, k: int):
+    """(P, dg, kg) for the lane-packed kernel, or None when packing
+    cannot help: dg is the per-group feature stride (16/32/64), P = 128
+    // dg groups share one contraction, kg = 128 // P score slots per
+    group. Packing needs d_pad <= 64 (else the lane tile is already
+    well used) and k <= kg (each group's scores must fit its slot)."""
+    for dg in (16, 32, 64):
+        if d_pad <= dg:
+            p = 128 // dg
+            if k <= 128 // p:
+                return p, dg, 128 // p
+            return None
+    return None
+
+
+def packed_feasible(d: int, k: int) -> bool:
+    """True when :func:`assign_stats_packed` can run at this (d, k)."""
+    return _packed_geometry(d + ((-d) % 8), k) is not None
+
+
+def _assign_stats_packed_kernel(
+    xp_ref, cp_ref, c2p_ref, sums_ref, counts_ref, cost_ref,
+    *, precision, groups, kg,
+):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        cost_ref[0, 0] = jnp.float32(0.0)
+
+    xp = xp_ref[:]  # (128, bn): P groups of dg feature sublanes
+    bn = xp.shape[1]
+    # ONE 128-lane contraction scores all P groups: cp is block-diagonal,
+    # so group g's score slot sees only group g's features.
+    xc = _dot_prec(
+        xp, cp_ref[:], (((0,), (0,)), ((), ())), precision
+    )  # (bn, P*kg)
+    scores = c2p_ref[:] - 2.0 * xc
+    s3 = scores.reshape(bn, groups, kg)
+    labels = jnp.argmin(s3, axis=2)  # (bn, groups)
+    m = jnp.min(s3, axis=2)
+    oh = (
+        jax.lax.broadcasted_iota(jnp.int32, s3.shape, 2) == labels[:, :, None]
+    ).astype(jnp.float32).reshape(bn, groups * kg)
+    # Packed stats GEMM: (P*kg, P*dg) in one tile; only the P diagonal
+    # (kg, dg) blocks are wanted — the off-diagonal blocks are the price
+    # of the shared contraction and are discarded by the caller.
+    if precision == "high":
+        xp_hi, xp_lo = _split_hi_lo(xp)
+        default = jax.lax.Precision.DEFAULT
+        kw = dict(
+            dimension_numbers=(((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        sums_ref[:] += jax.lax.dot_general(
+            oh, xp_hi, precision=default, **kw
+        ) + jax.lax.dot_general(oh, xp_lo, precision=default, **kw)
+    else:
+        sums_ref[:] += _dot_prec(oh, xp, (((0,), (1,)), ((), ())), precision)
+    counts_ref[:] += jnp.sum(oh, axis=0, keepdims=True)
+    cost_ref[0, 0] += jnp.sum(xp * xp) + jnp.sum(m)
+
+
+@partial(jax.jit, static_argnames=("block_n", "precision", "interpret"))
+def assign_stats_packed(
+    xt: jax.Array,
+    centers: jax.Array,
+    block_n: int = 4096,
+    precision: str = "highest",
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Lane-packed :func:`assign_stats_fused` for small d AND small k.
+
+    At d=16, k<=16 the fused kernel's score contraction uses 16 of 128
+    MXU lanes and 16 of 128 output columns — 112 lanes of zeros ride
+    along every tile (VERDICT r5 #3). This variant packs P = 128/dg
+    INDEPENDENT row blocks into one contraction: X regroups to (128,
+    n/P) with each group's d features at its own sublane offset, the
+    centers become a block-diagonal (128, 128) operand, and both the
+    score and stats GEMMs cover P row blocks per MXU tile — an
+    algebraically identical assignment (same c2 values, same per-group
+    argmin) at 1/P the tile count. Same contract as
+    :func:`assign_stats_fused` (raw stats INCLUDING padding rows).
+
+    Measured verdict lives in BASELINE.md ("KMeans lane packing"): the
+    tile-count win is a TPU systolic-array property; on this CPU-only
+    environment the packed shapes run the same algebraic FLOPs, so the
+    entry records the measured CPU number and the model, not a claimed
+    TPU speedup.
+    """
+    d_pad, n_pad = xt.shape
+    k = centers.shape[0]
+    if centers.shape[1] != d_pad:
+        raise ValueError(f"centers width {centers.shape[1]} != x width {d_pad}")
+    geom = _packed_geometry(d_pad, k)
+    if geom is None:
+        raise ValueError(f"packing infeasible at d_pad={d_pad}, k={k}")
+    p, dg, kg = geom
+    if n_pad % p:
+        raise ValueError(f"n_pad {n_pad} not divisible by pack factor {p}")
+    np_rows = n_pad // p
+    if np_rows % block_n:
+        block_n = max(
+            128, min(block_n, (np_rows // max(np_rows // block_n, 1)))
+        )
+        while np_rows % block_n:
+            block_n //= 2
+        if block_n < 8:
+            raise ValueError(f"no block size divides {np_rows}")
+    if precision not in ("highest", "high", "default"):
+        raise ValueError(f"precision must be highest|high|default, got {precision!r}")
+
+    # (d_pad, P*np) -> (P, d_pad, np) -> zero-pad each group to dg
+    # sublanes -> (128, np): group g's features live at sublane g*dg.
+    xp = xt.reshape(d_pad, p, np_rows).transpose(1, 0, 2)
+    xp = jnp.pad(xp, ((0, 0), (0, dg - d_pad), (0, 0))).reshape(
+        p * dg, np_rows
+    )
+    ct = centers.T  # (d_pad, k)
+    c2_col = jnp.sum(ct * ct, axis=0)  # (k,) — same reduction as fused
+    # Block-diagonal centers: group g rows [g*dg, g*dg+d_pad) x cols
+    # [g*kg, g*kg+k).
+    eye = jnp.eye(p, dtype=xt.dtype)  # (P, P)
+    cp = jnp.einsum("ab,dk->adbk", eye, jnp.pad(ct, ((0, dg - d_pad), (0, kg - k)))).reshape(p * dg, p * kg)
+    # Unused score slots (k..kg) push to +inf so no row lands there.
+    slot = jax.lax.broadcasted_iota(jnp.int32, (kg,), 0)
+    c2_slot = jnp.where(slot < k, jnp.pad(c2_col, (0, kg - k)), jnp.inf)
+    c2p = jnp.tile(c2_slot, p)[None, :]  # (1, 128)
+
+    nb = np_rows // block_n
+    sums, counts, cost = pl.pallas_call(
+        partial(
+            _assign_stats_packed_kernel,
+            precision=precision, groups=p, kg=kg,
+        ),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((p * dg, block_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((p * dg, p * kg), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, p * kg), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((p * kg, p * dg), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, p * kg), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((p * kg, p * dg), jnp.float32),
+            jax.ShapeDtypeStruct((1, p * kg), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(xp, cp, c2p)
+
+    # Keep the P diagonal (kg, dg) blocks; the off-diagonal blocks are
+    # cross-group garbage from the shared stats tile.
+    sums4 = sums.reshape(p, kg, p, dg)
+    sums_kd = sum(sums4[g, :, g, :] for g in range(p))  # (kg, dg)
+    counts_k = jnp.sum(counts.reshape(p, kg), axis=0)
+    return (
+        sums_kd[:k, :d_pad],
+        counts_k[:k],
+        cost[0, 0],
+        c2_slot[:k],
+    )
+
+
 def fused_feasible(d: int, k: int) -> bool:
     """True when the kernel's fixed VMEM residents (centers + c2 + the
     (k, d) accumulator) plus one minimum 128-column block fit the budget.
@@ -219,6 +388,7 @@ def pad_transposed(x: jax.Array, block_n: int = 4096) -> Tuple[jax.Array, int]:
     jax.jit,
     static_argnames=(
         "n_true", "max_iter", "block_n", "precision", "cosine", "interpret",
+        "packed",
     ),
 )
 def lloyd_fused(
@@ -231,6 +401,7 @@ def lloyd_fused(
     precision: str = "highest",
     cosine: bool = False,
     interpret: bool = False,
+    packed: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full Lloyd fit on the fused kernel: (centers, cost, n_iter).
 
@@ -243,6 +414,12 @@ def lloyd_fused(
     Padding correction: the n_pad zero columns all score argmin(c2) with
     distance min(c2) and contribute zero to sums — subtracted in closed
     form each pass, so results are EXACTLY the masked formulation's.
+
+    ``packed=True`` routes each pass through
+    :func:`assign_stats_packed` (lane-packed contraction for small d and
+    k; caller checks :func:`packed_feasible` first). Padding rows behave
+    identically — each group's unused score slots are +inf, so zero rows
+    land on the global argmin(c2) in every group.
     """
     d_pad = xt.shape[0]
     n_pad_rows = xt.shape[1] - n_true
@@ -262,8 +439,10 @@ def lloyd_fused(
         cost = cost - n_pad_rows * c2[pad_label]
         return sums, counts, cost
 
+    assign = assign_stats_packed if packed else assign_stats_fused
+
     def step(centers):
-        stats = assign_stats_fused(
+        stats = assign(
             xt, centers, block_n=block_n, precision=precision,
             interpret=interpret,
         )
@@ -295,7 +474,7 @@ def lloyd_fused(
     centers, _, n_iter, _ = jax.lax.while_loop(cond, body, state0)
     # Final cost at the converged centers (lloyd parity).
     _, _, cost = correct(
-        assign_stats_fused(
+        assign(
             xt, centers, block_n=block_n, precision=precision,
             interpret=interpret,
         )

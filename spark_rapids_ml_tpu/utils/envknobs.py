@@ -144,6 +144,16 @@ KNOBS: Dict[str, Knob] = _knob_table(
          "declared device peak FLOP/s for roofline utilization estimates"),
     Knob("TPUML_PEAK_BYTES_PER_SEC", "float", "observability",
          "declared device peak HBM bytes/s for roofline utilization"),
+    # hot-path kernel backend selection
+    Knob("TPUML_UMAP_SCATTER", "choice", "kernels",
+         "UMAP tail scatter backend: pallas = bucketed-accumulation "
+         "kernel over the tail-sorted edge list; xla = per-element "
+         "scatter; auto = pallas on the TPU backend",
+         default="auto", choices=("auto", "pallas", "xla")),
+    Knob("TPUML_LOGISTIC_FUSED", "choice", "kernels",
+         "1 = fused one-pass logistic loss+grad (X streamed once per "
+         "evaluation); 0 = legacy two-pass autodiff objective",
+         default="1", choices=("0", "1")),
     # serving-path program cache
     Knob("TPUML_SERVING_CACHE_SIZE", "int", "serving",
          "bound on the AOT executable LRU (entries per process)",

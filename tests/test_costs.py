@@ -511,3 +511,44 @@ class TestHbmSampler:
             costs.reset_for_tests()
             assert costs.sampler() is None
             assert costs.active() is None
+
+
+class TestTopHotSpot:
+    """The roofline table flags the costliest residual family — every
+    fit report answers "what pays the most to optimize next"."""
+
+    def _report(self, costs_rows):
+        from spark_rapids_ml_tpu.observability.report import RunReport
+
+        return RunReport(
+            run_id="r1", kind="fit", label="t", wall_seconds=1.0,
+            spans=[], counters={}, device_memory={}, ok=True,
+            costs=costs_rows,
+        )
+
+    def test_flags_largest_wall_share(self):
+        rep = self._report([
+            {"family": "a.small", "kind": "aot", "invocations": 1,
+             "wall_seconds": 0.1},
+            {"family": "b.big", "kind": "segment", "invocations": 4,
+             "wall_seconds": 0.3},
+        ])
+        hot = rep.top_hot_spot()
+        assert hot["family"] == "b.big"
+        assert hot["wall_share"] == pytest.approx(0.75)
+        rendered = str(rep)
+        assert "<< hot spot (75% of wall)" in rendered
+        # Only the hot row carries the marker.
+        assert rendered.count("<< hot spot") == 1
+
+    def test_no_costs_no_flag(self):
+        rep = self._report([])
+        assert rep.top_hot_spot() is None
+        assert "hot spot" not in str(rep)
+
+    def test_zero_wall_rows_ignored(self):
+        rep = self._report([
+            {"family": "compiled.never.ran", "kind": "aot",
+             "invocations": 0, "wall_seconds": 0.0},
+        ])
+        assert rep.top_hot_spot() is None

@@ -140,3 +140,78 @@ class TestPrecisionRouting:
         p = np.where(rng.uniform(size=500) < 0.8, y, 7.0)
         ev = MulticlassClassificationEvaluator().setMetricName("accuracy")
         assert ev.evaluate((y, p)) == pytest.approx(np.mean(y == p))
+
+
+class TestAUCSortAttack:
+    """The sort-attack rewrite (BASELINE.md "AUC sort shoot-out") has two
+    code paths: the packed-uint64 single sort (f32 scores under x64) and
+    the variadic key+label sort (everything else). Both must reproduce
+    the host tie-grouped curve; the packed path must survive the exact
+    hazards that killed the pack32 candidate (tie splitting, -0.0)."""
+
+    def _host(self, y, s, m):
+        ev = BinaryClassificationEvaluator().setMetricName(m)
+        return ev.evaluate((y.astype(np.float64), s.astype(np.float64)))
+
+    @pytest.mark.parametrize("metric", ["areaUnderROC", "areaUnderPR"])
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_ties_10k_both_branches(self, rng, metric, dtype):
+        """f32 under x64 dispatches the packed sort, f64 the variadic
+        sort — same 10k heavy-ties fixture, same host oracle."""
+        y = rng.integers(0, 2, 10_000).astype(np.float64)
+        s = np.round(y * 0.5 + rng.normal(size=10_000), 1).astype(dtype)
+        dev = float(
+            binary_auc_device(jnp.asarray(y), jnp.asarray(s), metric=metric)
+        )
+        assert dev == pytest.approx(self._host(y, s, metric), rel=1e-6)
+
+    def test_branches_agree(self, rng):
+        """The two dispatch branches compute one definition: f32 scores
+        (packed) vs their f64 copy (variadic) on ties-free data."""
+        y = rng.integers(0, 2, 10_000).astype(np.float64)
+        s32 = (y * 0.3 + rng.normal(size=10_000)).astype(np.float32)
+        for m in ("areaUnderROC", "areaUnderPR"):
+            a32 = float(binary_auc_device(jnp.asarray(y), jnp.asarray(s32), metric=m))
+            a64 = float(
+                binary_auc_device(
+                    jnp.asarray(y), jnp.asarray(s32.astype(np.float64)), metric=m
+                )
+            )
+            assert a32 == pytest.approx(a64, rel=1e-6), m
+
+    def test_negative_zero_one_tie_group(self):
+        """-0.0 and +0.0 compare equal but have different bit patterns:
+        the packed path must canonicalize before the bit transform or the
+        zeros split into two tie groups (the bug XLA's `s + 0.0` folding
+        would resurrect — see the kernel comment)."""
+        y = np.array([1.0, 0.0, 1.0, 0.0])
+        s_zero = np.array([-0.0, 0.0, 0.5, -0.25], dtype=np.float32)
+        s_tied = np.array([0.0, 0.0, 0.5, -0.25], dtype=np.float32)
+        for m in ("areaUnderROC", "areaUnderPR"):
+            a_zero = float(binary_auc_device(jnp.asarray(y), jnp.asarray(s_zero), metric=m))
+            a_tied = float(binary_auc_device(jnp.asarray(y), jnp.asarray(s_tied), metric=m))
+            assert a_zero == a_tied, m
+            # rel 1e-6: the packed path divides in the score's f32 dtype.
+            assert a_zero == pytest.approx(self._host(y, s_zero, m), rel=1e-6), m
+
+    def test_adjacent_floats_stay_distinct(self):
+        """The pack32 candidate collapsed adjacent f32 scores with even
+        keys (label stole the LSB) — the exactness probe that rejected
+        it. The shipped pack64 keeps all 32 key bits: a one-ULP score gap
+        must still separate the curve points."""
+        lo = np.float32(0.5)
+        hi = np.nextafter(lo, np.float32(1.0), dtype=np.float32)
+        y = np.array([0.0, 1.0])
+        s = np.array([lo, hi], dtype=np.float32)
+        assert float(binary_auc_device(jnp.asarray(y), jnp.asarray(s))) == 1.0
+
+    @pytest.mark.parametrize("n", [1, 2, 17, 1000])
+    def test_sizes_vs_host(self, rng, n):
+        y = rng.integers(0, 2, n).astype(np.float64)
+        s = rng.normal(size=n).astype(np.float32)
+        for m in ("areaUnderROC", "areaUnderPR"):
+            dev = float(binary_auc_device(jnp.asarray(y), jnp.asarray(s), metric=m))
+            if np.all(y == y[0]):  # degenerate: device defines 0.0
+                assert dev == 0.0
+            else:
+                assert dev == pytest.approx(self._host(y, s, m), rel=1e-6), m

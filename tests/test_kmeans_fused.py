@@ -146,3 +146,112 @@ class TestFusedEstimator:
             KMeans().setPrecision("bf16")
         with pytest.raises(ValueError, match="backend"):
             KMeans().setBackend("cuda")
+
+
+class TestPackedOps:
+    """Lane-packed assignment kernel (VERDICT r5 #3): P row groups share
+    one 128-lane contraction at small d and k. Raw-stats parity with the
+    unpacked fused kernel must hold at every packable geometry; the
+    measured speedup lives in BASELINE.md ("KMeans lane packing")."""
+
+    @pytest.mark.parametrize(
+        "n,d,k",
+        [(1100, 8, 4), (1100, 16, 7), (777, 16, 16), (1100, 64, 33), (513, 64, 4)],
+    )
+    def test_assign_stats_parity(self, n, d, k):
+        from spark_rapids_ml_tpu.ops.pallas.kmeans import (
+            assign_stats_packed,
+            packed_feasible,
+        )
+
+        assert packed_feasible(d, k)
+        rng = np.random.default_rng(n + d + k)
+        x = jnp.asarray(
+            (rng.normal(size=(n, d)) + rng.integers(0, k, n)[:, None]).astype(
+                np.float32
+            )
+        )
+        centers = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+        xt, n_true = pad_transposed(x, block_n=256)
+        d_pad = xt.shape[0]
+        cpad = jnp.pad(centers, ((0, 0), (0, d_pad - d)))
+        sf, cf, costf, c2f = assign_stats_fused(
+            xt, cpad, block_n=256, interpret=True
+        )
+        sp, cp, costp, c2p = assign_stats_packed(
+            xt, cpad, block_n=256, interpret=True
+        )
+        # Identical assignments (counts are integers), accumulation-order
+        # epsilon on the float sums.
+        np.testing.assert_array_equal(np.asarray(cf), np.asarray(cp))
+        np.testing.assert_allclose(sp, sf, rtol=1e-5, atol=1e-4)
+        assert float(costp) == pytest.approx(float(costf), rel=1e-5)
+        np.testing.assert_allclose(c2p, c2f, rtol=1e-6)
+
+    def test_feasibility_boundaries(self):
+        from spark_rapids_ml_tpu.ops.pallas.kmeans import packed_feasible
+
+        assert packed_feasible(8, 16)
+        assert packed_feasible(16, 16)
+        assert packed_feasible(64, 64)
+        assert not packed_feasible(128, 4)  # lane tile already well used
+        assert not packed_feasible(16, 32)  # scores overflow the group slot
+        assert not packed_feasible(64, 65)
+        assert not packed_feasible(65, 4)  # d_pad 72 > 64
+
+    def test_lloyd_packed_matches_unpacked(self, data):
+        """End-to-end Lloyd on both kernels: same assignments each pass,
+        centers agree to accumulation tolerance."""
+        x, k = data
+        xj = jnp.asarray(x)
+        mask = jnp.ones(x.shape[0], jnp.float32)
+        init = random_init(xj, mask, jax.random.key(2), k)
+        xt, n_true = pad_transposed(xj, block_n=256)
+
+        def run(packed):
+            return lloyd_fused(
+                xt, n_true, init, max_iter=5, tol=0.0, block_n=256,
+                interpret=True, packed=packed,
+            )
+
+        cu, costu, itu = run(False)
+        cp, costp, itp = run(True)
+        assert int(itu) == int(itp)
+        np.testing.assert_allclose(cp, cu, rtol=1e-4, atol=1e-4)
+        assert float(costp) == pytest.approx(float(costu), rel=1e-5)
+
+    def test_estimator_fused_backend_packs_small_d(self, data, monkeypatch):
+        """The model layer routes packable shapes onto the packed kernel;
+        the fit must match the XLA backend regardless."""
+        import spark_rapids_ml_tpu.ops.pallas.kmeans as pk
+
+        x, k = data  # d=16, k=6: packable
+        calls = {"packed": 0}
+        real = pk.assign_stats_packed
+
+        def spy(*a, **kw):
+            calls["packed"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pk, "assign_stats_packed", spy)
+
+        def fit(backend):
+            est = (
+                KMeans()
+                .setK(k)
+                .setMaxIter(5)
+                .setTol(0.0)
+                .setInitMode("random")
+                .setSeed(0)
+                .setBackend(backend)
+            )
+            return est.fit(jnp.asarray(x))
+
+        m_fused = fit("fused")
+        assert calls["packed"] > 0  # the packed kernel actually ran
+        m_xla = fit("xla")
+        np.testing.assert_allclose(
+            np.sort(m_fused.clusterCenters(), axis=0),
+            np.sort(m_xla.clusterCenters(), axis=0),
+            rtol=1e-4, atol=1e-4,
+        )

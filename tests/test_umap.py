@@ -316,3 +316,87 @@ class TestResume:
         x = rng.normal(size=(30, 5))
         with pytest.raises(ValueError, match="shape"):
             UMAP().setNNeighbors(5).setInitEmbedding(np.zeros((10, 2))).fit(x)
+
+
+class TestTailScatterPallas:
+    """Bucketed tail scatter-add kernel (VERDICT r5 #1): the per-epoch
+    XLA scatter replaced by a static tail-sort + dense per-tile
+    accumulation. Interpret mode on CPU; the TPU walls live in
+    BASELINE.md's "UMAP tail scatter" entry."""
+
+    @pytest.mark.parametrize(
+        "n,k,dim",
+        [(600, 8, 2), (257, 5, 3), (1024, 15, 2), (130, 3, 10)],
+    )
+    def test_tail_accumulate_matches_scatter(self, rng, n, k, dim):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops.pallas.umap import (
+            build_tail_plan,
+            plan_feasible,
+            tail_accumulate,
+        )
+
+        assert plan_feasible(n, k, dim)
+        indices = rng.integers(0, n, size=(n, k))
+        g = rng.normal(size=(n * k, dim)).astype(np.float32)
+        plan, cfg = build_tail_plan(indices, n, dim)
+        out = np.asarray(
+            tail_accumulate(jnp.asarray(g), plan, cfg, interpret=True)
+        )
+        expected = np.zeros((n, dim), dtype=np.float64)
+        np.add.at(expected, indices.reshape(-1), g.astype(np.float64))
+        # In-tile accumulation order differs from the scatter order:
+        # float tolerance, not bitwise (PARITY.md TPUML_UMAP_SCATTER).
+        np.testing.assert_allclose(out, expected, atol=1e-4, rtol=1e-5)
+
+    def test_plan_infeasible_wide_embedding(self):
+        from spark_rapids_ml_tpu.ops.pallas.umap import plan_feasible
+
+        assert not plan_feasible(1000, 15, 129)  # dim > one sublane tile
+        assert not plan_feasible(0, 15, 2)  # empty edge stream
+
+    def test_backend_one_epoch_matches_xla(self, rng, monkeypatch):
+        """One SGD epoch: before chaotic divergence compounds, the two
+        scatter implementations must agree tightly (measured 4.8e-7 at
+        one epoch; 20 epochs diverge to O(1) — hence the structural
+        oracle below, not a numeric one)."""
+        x, _ = _three_blobs(rng, n_per=50)
+
+        def fit(mode):
+            monkeypatch.setenv("TPUML_UMAP_SCATTER", mode)
+            return (
+                UMAP().setNNeighbors(8).setNEpochs(1).setSeed(5).fit(x).embedding
+            )
+
+        np.testing.assert_allclose(fit("pallas"), fit("xla"), atol=1e-5)
+
+    @pytest.mark.slow
+    def test_backend_trustworthiness_at_scale(self, rng, monkeypatch):
+        """Multi-epoch runs diverge numerically (per-epoch epsilon is
+        amplified by the SGD's chaotic dynamics), so at scale the oracle
+        is structural: both backends must embed equally trustworthily."""
+        manifold = pytest.importorskip("sklearn.manifold")
+        x = rng.normal(size=(50_000, 64)).astype(np.float32)
+        x[:25_000, 0] += 8.0  # two far sheets: real structure to preserve
+
+        def fit(mode):
+            monkeypatch.setenv("TPUML_UMAP_SCATTER", mode)
+            est = (
+                UMAP()
+                .setNNeighbors(10)
+                .setNEpochs(10)
+                .setBuildAlgo("brute_approx")
+                .setInit("random")
+                .setSeed(5)
+            )
+            return est.fit(x).embedding
+
+        # Trustworthiness on a fixed subsample (the full 50k pairwise
+        # matrix would need ~10 GB); same rows for both backends.
+        sub = rng.choice(50_000, size=2_000, replace=False)
+        t_pallas = manifold.trustworthiness(
+            x[sub], fit("pallas")[sub], n_neighbors=10
+        )
+        t_xla = manifold.trustworthiness(x[sub], fit("xla")[sub], n_neighbors=10)
+        assert abs(t_pallas - t_xla) < 0.05
