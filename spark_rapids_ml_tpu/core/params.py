@@ -14,9 +14,10 @@ DefaultParamsWriter-style persistence in :mod:`spark_rapids_ml_tpu.core.persiste
 from __future__ import annotations
 
 import numbers
-import threading
 import uuid
 from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 
 
 class Param:
@@ -89,7 +90,7 @@ def gt(bound: float) -> Callable[[Any], Any]:
     return check
 
 
-_uid_lock = threading.Lock()
+_uid_lock = make_lock("params.uid")
 _uid_counters: Dict[str, int] = {}
 
 

@@ -57,7 +57,6 @@ calls but do not bucket.
 from __future__ import annotations
 
 import os
-import threading
 import time
 import warnings
 import weakref
@@ -71,6 +70,7 @@ from spark_rapids_ml_tpu.observability.events import emit, run_scope
 from spark_rapids_ml_tpu.observability.metrics import ROW_BUCKETS, histogram
 from spark_rapids_ml_tpu.observability.metrics import gauge as _gauge
 from spark_rapids_ml_tpu.utils.envknobs import env_choice, env_int, env_str
+from spark_rapids_ml_tpu.utils.lockcheck import guarded, make_lock, make_rlock
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
 
@@ -82,13 +82,15 @@ def _observe_batch(n: int) -> None:
     ).observe(n)
 
 
-def _publish_cache_size(size: int) -> None:
-    """``serving.cache.size`` gauge, updated at every mutation with a
-    size snapshotted UNDER the cache lock — the thread-safe size truth
-    (tests used to derive it from hit/miss arithmetic, which races
-    concurrent servers). The size arrives as an argument so this helper
-    stays lexically lock-free (tpuml-lint: lock-guarded)."""
-    _gauge("serving.cache.size", "AOT program cache entries").set(size)
+def _publish_cache_size() -> None:
+    """``serving.cache.size`` gauge, updated at every mutation from a
+    size read UNDER the cache lock — the thread-safe size truth (tests
+    used to derive it from hit/miss arithmetic, which races concurrent
+    servers). Every call site holds ``_LOCK`` — the interprocedural
+    lock-guarded pass proves it statically, ``guarded()`` asserts it at
+    runtime when the sanitizer is armed."""
+    guarded(_LOCK, "core.serving._PROGRAMS")
+    _gauge("serving.cache.size", "AOT program cache entries").set(len(_PROGRAMS))
 
 #: Smallest row bucket — tiny interactive batches (a single scored row, a
 #: 3-row unit test) all share one program instead of one each.
@@ -124,7 +126,7 @@ def bucket_rows(n: int, min_bucket: int = MIN_ROW_BUCKET) -> int:
 # Persistent XLA compilation cache (process-restart warm starts)
 # ---------------------------------------------------------------------------
 
-_cache_lock = threading.Lock()
+_cache_lock = make_lock("core_serving.cache_wiring")
 _cache_wired: Optional[str] = None  # guarded-by: _cache_lock
 _cache_checked = False  # guarded-by: _cache_lock
 
@@ -175,7 +177,7 @@ def _reset_compile_cache_wiring_for_tests() -> None:
 # AOT program cache
 # ---------------------------------------------------------------------------
 
-_LOCK = threading.RLock()
+_LOCK = make_rlock("core_serving.programs")
 _PROGRAMS: "OrderedDict[tuple, Any]" = OrderedDict()  # guarded-by: _LOCK
 _STATS = {"hits": 0, "misses": 0, "evictions": 0, "compiles": 0}  # guarded-by: _LOCK
 # Cost-ledger bookkeeping (populated ONLY while the ledger is enabled):
@@ -218,7 +220,7 @@ def clear_program_cache() -> None:
         _EVICTED_KEYS.clear()
         for k in _STATS:
             _STATS[k] = 0
-        _publish_cache_size(len(_PROGRAMS))
+        _publish_cache_size()
         models = list(_DEVICE_CACHED_MODELS)
     ledger = _costs.active()
     if ledger is not None:
@@ -391,7 +393,7 @@ def _get_program(
                 _STATS["evictions"] += 1
                 bump_counter("serving.cache.evict")
                 emit("serving", action="evict")
-            _publish_cache_size(len(_PROGRAMS))
+            _publish_cache_size()
         return _PROGRAMS[key], (
             _LEDGER_KEYS.get(key) if ledger is not None else None
         )

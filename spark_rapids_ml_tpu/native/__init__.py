@@ -20,13 +20,14 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
+
 _LIB_NAME = "libtpuml_host.so"
-_lock = threading.Lock()
+_lock = make_lock("native.loader")
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 

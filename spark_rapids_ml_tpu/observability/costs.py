@@ -71,6 +71,7 @@ from spark_rapids_ml_tpu.utils.envknobs import (
     env_int,
     env_str,
 )
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 
 COST_LEDGER_ENV = "TPUML_COST_LEDGER"
 COST_DUMP_ENV = "TPUML_COST_LEDGER_DUMP"
@@ -210,7 +211,7 @@ class Ledger:
     path touches it only when the ledger is enabled."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("costs.ledger")
         self._entries: Dict[str, ProgramCost] = {}  # guarded-by: _lock
         # (fn id, static, rows, d, dtype, args key) -> entry key — the
         # admission controller's measured-pricing index.
@@ -408,7 +409,7 @@ class Ledger:
 
 _LEDGER: Optional[Ledger] = None  # None = disabled: active() is one read
 _SAMPLER: Optional["HbmSampler"] = None
-_config_lock = threading.Lock()
+_config_lock = make_lock("costs.config")
 
 
 def active() -> Optional[Ledger]:
@@ -552,7 +553,7 @@ def record_aot(
 #: lowerings — one cost analysis per distinct shape, mirroring jit's
 #: own cache so the recording path never re-traces a warm shape.
 _FALLBACK_KEYS: Dict[tuple, str] = {}  # guarded-by: _fallback_lock
-_fallback_lock = threading.Lock()
+_fallback_lock = make_lock("costs.fallback")
 
 
 def record_fallback(
@@ -610,7 +611,7 @@ def record_fallback(
 #: segmented solver drivers — the ledger's own program cache, used
 #: ONLY when the ledger is enabled.
 _SEGMENT_EXES: Dict[tuple, tuple] = {}  # guarded-by: _segment_lock
-_segment_lock = threading.Lock()
+_segment_lock = make_lock("costs.segment")
 
 
 def _any_multi_device(tree) -> bool:

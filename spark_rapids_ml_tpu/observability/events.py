@@ -52,7 +52,6 @@ import itertools
 import json
 import os
 import sys
-import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -60,6 +59,7 @@ from typing import Any, Dict, List, Optional
 import contextvars
 
 from spark_rapids_ml_tpu.utils.envknobs import EnvKnobError, env_int, env_str
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 
 EVENT_LOG_ENV = "TPUML_EVENT_LOG"
 TELEMETRY_DIR_ENV = "TPUML_TELEMETRY_DIR"
@@ -100,6 +100,7 @@ SCHEMA: Dict[str, frozenset] = {
     "distributed": frozenset({"action"}),
     "persistence": frozenset({"action", "path"}),
     "telemetry": frozenset({"action", "path"}),
+    "lockcheck": frozenset({"action", "lock"}),
 }
 
 
@@ -251,7 +252,7 @@ class RunContext:
         self.spans: deque = deque(maxlen=MAX_RUN_SPANS)
         self.t0_wall = time.time()
         self.t0_mono = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = make_lock("events.run_context")
 
     def add_span(self, record: dict) -> None:
         with self._lock:
@@ -319,7 +320,7 @@ _sink = None  # None = disabled: emit() is a single attribute check
 # (_sink itself is deliberately NOT lock-guarded: the disabled fast path
 # reads it lock-free once, then re-checks under the lock before writing.)
 _sink_owned = False  # guarded-by: _sink_lock
-_sink_lock = threading.Lock()
+_sink_lock = make_lock("events.sink")
 _n_emitted = 0  # guarded-by: _sink_lock
 #: Active telemetry-dir sharding: {"dir": <dir>, "shard": <shard path>}.
 _telemetry: Optional[dict] = None  # guarded-by: _sink_lock

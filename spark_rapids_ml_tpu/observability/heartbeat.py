@@ -28,6 +28,7 @@ from typing import Optional
 from spark_rapids_ml_tpu.observability.events import emit
 from spark_rapids_ml_tpu.observability.metrics import gauge
 from spark_rapids_ml_tpu.utils.envknobs import env_float
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 
 HEARTBEAT_EVERY_ENV = "TPUML_GANG_HEARTBEAT_EVERY"
 DEFAULT_INTERVAL = 5.0
@@ -54,21 +55,30 @@ class GangHeartbeat:
         self.process_id = int(process_id)
         self.interval = heartbeat_interval() if interval is None else float(interval)
         self.what = what
-        self.seq = 0
-        self._last = time.monotonic()
+        # The beat thread and the caller's thread (beat 1, stop, gauge
+        # scrapes) both touch the beat state: one lock owns it.
+        self._lock = make_lock("heartbeat.state")
+        self.seq = 0  # guarded-by: _lock
+        self._last = time.monotonic()  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._registered = False
 
     def age_seconds(self) -> float:
-        return time.monotonic() - self._last
+        with self._lock:
+            last = self._last
+        return time.monotonic() - last
 
     def beat(self) -> None:
-        self.seq += 1
-        self._last = time.monotonic()
+        # Snapshot under the lock, emit outside it: the event sink does
+        # its own locking and must not nest inside ours.
+        with self._lock:
+            self.seq += 1
+            self._last = time.monotonic()
+            seq = self.seq
         emit(
             "heartbeat",
-            seq=self.seq,
+            seq=seq,
             interval=self.interval,
             what=self.what,
             process=self.process_id,

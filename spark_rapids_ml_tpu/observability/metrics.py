@@ -37,6 +37,7 @@ import time
 from typing import Callable, Dict, Iterable, Optional, Tuple, Union
 
 from spark_rapids_ml_tpu.utils.envknobs import env_str
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 
 METRICS_DUMP_ENV = "TPUML_METRICS_DUMP"
 
@@ -226,14 +227,14 @@ class Registry:
     (:data:`default_registry`) backs the whole process."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
         self._metrics: Dict[str, _Metric] = {}  # guarded-by: _lock
 
     def _get(self, name: str, kind: type, help: str, **kwargs) -> _Metric:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = kind(name, help, threading.Lock(), **kwargs)
+                m = kind(name, help, make_lock(f"metrics.{name}"), **kwargs)
                 self._metrics[name] = m
             elif not isinstance(m, kind):
                 raise MetricError(

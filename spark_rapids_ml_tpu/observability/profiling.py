@@ -17,14 +17,14 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 from typing import Optional
 
 from spark_rapids_ml_tpu.utils.envknobs import env_str
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 
 PROFILE_DIR_ENV = "TPUML_PROFILE_DIR"
 
-_lock = threading.Lock()
+_lock = make_lock("profiling.active")
 _active = False  # guarded-by: _lock
 
 

@@ -25,7 +25,6 @@ steady-state serving picture rather than one call's tree.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -33,6 +32,7 @@ from spark_rapids_ml_tpu.observability import costs as _costs
 from spark_rapids_ml_tpu.observability import events
 from spark_rapids_ml_tpu.observability.metrics import default_registry, gauge
 from spark_rapids_ml_tpu.observability.profiling import maybe_profile
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 
 #: Counter prefixes a report folds into its summary.
 _REPORT_PREFIXES = ("serving.", "checkpoint.", "retry.", "gang.", "ingest.",
@@ -347,7 +347,7 @@ class RunRecorder:
 
 # --- the serving-side report ------------------------------------------
 
-_serve_lock = threading.Lock()
+_serve_lock = make_lock("report.serving")
 
 
 def serving_report() -> dict:

@@ -64,6 +64,7 @@ from spark_rapids_ml_tpu.observability.events import (
 )
 from spark_rapids_ml_tpu.robustness.faults import InjectedFault, fault_point
 from spark_rapids_ml_tpu.utils.envknobs import env_int, env_str
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
 SCHEMA_VERSION = 1
@@ -188,7 +189,10 @@ class FitCheckpointer:
         self.every = every
         self.keep = keep
         self.solver = solver
-        self._pending: Optional[threading.Thread] = None
+        # The solver thread and finalize/wait callers can race over the
+        # in-flight writer handle: hand-offs go through one lock.
+        self._lock = make_lock("checkpoint.pending")
+        self._pending: Optional[threading.Thread] = None  # guarded-by: _lock
 
     @classmethod
     def for_fit(cls, instance, solver: str, data: Sequence = ()) -> Optional["FitCheckpointer"]:
@@ -299,7 +303,8 @@ class FitCheckpointer:
 
         t = threading.Thread(target=ctx.run, args=(_run,), daemon=True)
         t.start()
-        self._pending = t
+        with self._lock:
+            self._pending = t
 
     def _write(self, step: int, leaves: list) -> None:
         with TraceRange("checkpoint write", TraceColor.ORANGE):
@@ -366,9 +371,10 @@ class FitCheckpointer:
 
     def wait(self) -> None:
         """Block until the in-flight write (if any) has committed."""
-        if self._pending is not None:
-            self._pending.join()
-            self._pending = None
+        with self._lock:
+            t, self._pending = self._pending, None
+        if t is not None:
+            t.join()
 
     def finalize_success(self) -> None:
         """The fit completed: its checkpoints are spent. Flush the last

@@ -52,11 +52,11 @@ or the :func:`inject` context manager. When no plan is active,
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple, Union
 
 from spark_rapids_ml_tpu.observability.events import emit
 from spark_rapids_ml_tpu.utils.envknobs import env_str
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 
 KNOWN_SITES = frozenset(
     {
@@ -201,7 +201,7 @@ class FaultPlan:
     def __init__(self, schedules: Dict[str, Schedule]):
         self._schedules = dict(schedules)
         self._counts: Dict[str, int] = {}  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.plan")
         self.fired: List[Tuple[str, int]] = []
 
     def invocations(self, site: str) -> int:

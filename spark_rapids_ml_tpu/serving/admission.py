@@ -26,7 +26,6 @@ backend-unavailable error re-runs on the cached CPU path under
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from concurrent.futures import Future
@@ -39,6 +38,7 @@ from spark_rapids_ml_tpu.core.serving import _jit_fallback, serve_rows
 from spark_rapids_ml_tpu.observability.events import emit
 from spark_rapids_ml_tpu.robustness.degrade import cpu_device, run_degradable
 from spark_rapids_ml_tpu.serving.signature import ServingSignature
+from spark_rapids_ml_tpu.utils.lockcheck import guarded, make_condition
 from spark_rapids_ml_tpu.utils.tracing import bump_counter
 
 QUEUE_ENV = "TPUML_SERVE_QUEUE"
@@ -171,7 +171,7 @@ class AdmissionQueue:
         self.limit = int(limit)
         self.mem_budget = int(mem_budget)
         self._dq: "deque[Request]" = deque()  # guarded-by: _cond
-        self._cond = threading.Condition()
+        self._cond = make_condition("serving.admission")
         self._reserved = 0  # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
 
@@ -181,38 +181,38 @@ class AdmissionQueue:
         with self._cond:
             if self._closed:
                 raise RuntimeError("serving queue is closed")
-            name = req.key[0]
-            depth, reserved = len(self._dq), self._reserved
-            if depth >= self.limit:
-                self._shed(req, "queue", depth, reserved)
-                raise Overloaded(
-                    "queue", name,
-                    queue_depth=depth, queue_limit=self.limit,
-                    retry_after_ms=retry_after_hint_ms(),
-                )
-            if self.mem_budget and reserved + req.cost > self.mem_budget:
-                self._shed(req, "memory", depth, reserved)
-                raise Overloaded(
-                    "memory", name,
-                    queue_depth=depth, queue_limit=self.limit,
-                    reserved_bytes=reserved, request_bytes=req.cost,
-                    mem_budget=self.mem_budget,
-                    retry_after_ms=retry_after_hint_ms(),
-                )
+            if len(self._dq) >= self.limit:
+                raise self._shed(req, "queue")
+            if self.mem_budget and self._reserved + req.cost > self.mem_budget:
+                raise self._shed(req, "memory")
             self._reserved += req.cost
             req.enqueue_mono = time.monotonic()
             self._dq.append(req)
             self._cond.notify_all()
 
-    def _shed(self, req: Request, reason: str, depth: int, reserved: int) -> None:
-        # Queue state arrives as arguments: the caller snapshots it under
-        # the admission lock, so this helper stays lexically lock-free
-        # (tpuml-lint: lock-guarded).
+    def _shed(self, req: Request, reason: str) -> Overloaded:
+        """Count + emit one shed and build the :class:`Overloaded` for
+        ``submit`` to raise. Reads queue state directly: it only runs
+        under ``self._cond`` — the lint's interprocedural guarded-by
+        pass proves every call site holds it, and ``guarded()`` asserts
+        the same at runtime when the sanitizer is armed."""
+        guarded(self._cond, "AdmissionQueue._dq")
+        depth, reserved = len(self._dq), self._reserved
         bump_counter(f"serving.shed.{reason}")
         emit(
             "serving", action="shed", reason=reason, model=req.key[0],
             version=req.key[1], rows=req.n, run_id=req.run_id,
             depth=depth, reserved_bytes=reserved,
+        )
+        extra = (
+            dict(reserved_bytes=reserved, request_bytes=req.cost,
+                 mem_budget=self.mem_budget)
+            if reason == "memory" else {}
+        )
+        return Overloaded(
+            reason, req.key[0],
+            queue_depth=depth, queue_limit=self.limit,
+            retry_after_ms=retry_after_hint_ms(), **extra,
         )
 
     def release(self, req: Request) -> None:

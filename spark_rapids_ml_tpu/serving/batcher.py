@@ -32,6 +32,7 @@ import numpy as np
 
 from spark_rapids_ml_tpu.observability.events import emit, trace_scope
 from spark_rapids_ml_tpu.observability.metrics import histogram
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 from spark_rapids_ml_tpu.serving.admission import (
     AdmissionQueue,
     DeadlineExceeded,
@@ -92,7 +93,7 @@ class MicroBatcher:
         self._stop = False
         self._drain = True
         self._inflight = 0  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.batcher")
 
     # --- lifecycle ---
 

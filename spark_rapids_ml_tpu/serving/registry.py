@@ -21,7 +21,6 @@ out) model cannot pin stale weights in device memory.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple, Type
 
@@ -34,6 +33,7 @@ from spark_rapids_ml_tpu.core.serving import (
 )
 from spark_rapids_ml_tpu.observability.events import emit
 from spark_rapids_ml_tpu.serving.signature import ServingSignature
+from spark_rapids_ml_tpu.utils.lockcheck import make_rlock
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange, bump_counter
 
 
@@ -62,7 +62,7 @@ class ModelRegistry:
     """Thread-safe versioned registry with alias pinning and warm-up."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("serving.registry")
         self._versions: Dict[str, Dict[int, ModelVersion]] = {}  # guarded-by: _lock
         # High-water version per name: never decremented, so a retired
         # version number is never reissued to a different model.

@@ -18,7 +18,6 @@ and :func:`runtime_snapshots` feeds the runtime section of
 
 from __future__ import annotations
 
-import threading
 import weakref
 from concurrent.futures import Future
 from typing import Any, Iterable, List, Optional
@@ -54,11 +53,12 @@ from spark_rapids_ml_tpu.serving.admission import (
 from spark_rapids_ml_tpu.serving.registry import ModelRegistry, ModelVersion
 from spark_rapids_ml_tpu.serving.signature import spec_bytes
 from spark_rapids_ml_tpu.utils.envknobs import env_float, env_int
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
 from spark_rapids_ml_tpu.utils.tracing import bump_counter
 
 #: Live runtimes (weak): the serving report's runtime section.
 _RUNTIMES: "weakref.WeakSet[ServingRuntime]" = weakref.WeakSet()
-_runtime_seq_lock = threading.Lock()
+_runtime_seq_lock = make_lock("serving.runtime_seq")
 _runtime_seq = 0  # guarded-by: _runtime_seq_lock
 
 

@@ -180,6 +180,18 @@ KNOBS: Dict[str, Knob] = _knob_table(
     Knob("TPUML_SERVE_MEM_BUDGET", "int", "serving-runtime",
          "device-memory admission budget in bytes (0 = gate off)",
          default=0),
+    # concurrency sanitizer (utils/lockcheck.py)
+    Knob("TPUML_LOCKCHECK", "choice", "lockcheck",
+         "off: plain threading primitives; warn: instrumented locks "
+         "emit lockcheck events on violations; strict: violations raise",
+         default="off", choices=("off", "warn", "strict")),
+    Knob("TPUML_LOCKCHECK_STALL_MS", "float", "lockcheck",
+         "blocking-acquire wait that triggers the stall watchdog's "
+         "all-threads lockcheck event (0 = watchdog off)",
+         default=30000.0),
+    Knob("TPUML_LOCKCHECK_GRAPH", "str", "lockcheck",
+         "write the runtime acquisition-order graph + violation log "
+         "here at interpreter exit"),
     # benchmark shape overrides (benchmarks/ only)
     Knob("TPUML_BENCH_ROWS", "int", "benchmarks",
          "row-count override for serving benchmarks"),

@@ -14,6 +14,29 @@ import sys
 from pathlib import Path
 
 
+def _dump_lock_graph(root: Path, paths, out: Path) -> None:
+    """Write the static acquisition-order graph (the edge set the
+    lock-order rule cycles over) for the CI artifact / post-mortems."""
+    import ast as _ast
+
+    from tools.tpuml_lint import engine, locks
+
+    edges = []
+    for f in engine.iter_python_files(
+        [root / p if not Path(p).is_absolute() else Path(p) for p in paths]
+    ):
+        src = f.read_text()
+        try:
+            tree = _ast.parse(src, filename=str(f))
+        except SyntaxError:
+            continue
+        module = engine.ModuleContext(root, f, src, tree)
+        edges.extend(locks.order_edges(module))
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(
+        {"kind": "tpuml-lock-order-graph", "edges": edges}, indent=2))
+
+
 def main(argv=None) -> int:
     import tools.tpuml_lint as tl
     from tools.tpuml_lint import baseline as bl
@@ -38,10 +61,18 @@ def main(argv=None) -> int:
                     help="CI mode: also fail on stale baseline entries")
     ap.add_argument("--write-baseline", action="store_true",
                     help="adopt the current findings as the new baseline")
+    ap.add_argument("--lock-graph", default=None, metavar="PATH",
+                    help="also dump the static lock acquisition-order "
+                         "graph (every nested-with edge the guarded-by "
+                         "pass derived, call graph included) as JSON")
     args = ap.parse_args(argv)
 
     root = Path(args.root) if args.root else tl.REPO_ROOT
     findings, n_files = tl.run(root=root, paths=args.paths or None)
+
+    if args.lock_graph:
+        _dump_lock_graph(root, args.paths or list(tl.DEFAULT_PATHS),
+                         Path(args.lock_graph))
 
     baseline_path = Path(args.baseline) if args.baseline else tl.DEFAULT_BASELINE
     if args.write_baseline:

@@ -138,6 +138,38 @@ class ModuleContext:
         return {r.strip() for r in m.group(1).split(",") if r.strip()}
 
 
+def iter_scopes(tree: ast.Module):
+    """The module's analyzable scopes: ``(class_name, fn)`` for every
+    method defined directly in a top-level class body, and ``(None, fn)``
+    for every top-level function. This is the node set interprocedural
+    checkers (the guarded-by lock pass) build their per-class/module
+    call graphs over; nested defs stay part of their enclosing scope."""
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, stmt
+        elif isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield stmt.name, sub
+
+
+def call_target(node: ast.Call) -> Optional[Tuple[str, str]]:
+    """Resolve an intra-module call edge: ``self.helper(...)`` ->
+    ``("self", "helper")``, ``helper(...)`` -> ``("local", "helper")``,
+    anything else (imported names resolve elsewhere, attribute chains
+    cross object boundaries) -> None."""
+    fn = node.func
+    if (
+        isinstance(fn, ast.Attribute)
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "self"
+    ):
+        return ("self", fn.attr)
+    if isinstance(fn, ast.Name):
+        return ("local", fn.id)
+    return None
+
+
 class RepoContext:
     """Facts parsed once per run from the repo's own source of truth."""
 

@@ -64,6 +64,14 @@ RULES: Dict[str, Rule] = {
         Rule("lock-unknown", "locks", ERROR,
              "a guarded-by annotation names a lock the owning scope never "
              "defines — the convention must stay checkable"),
+        Rule("lock-order", "locks", ERROR,
+             "two locks in one module are acquired in both nesting orders "
+             "— a cycle in the static acquisition-order graph is a "
+             "potential deadlock the moment two threads interleave"),
+        Rule("lock-leak", "locks", ERROR,
+             "a lock acquired via .acquire() without a guaranteed-release "
+             "path (no try/finally release, no with) stays held forever "
+             "on the first exception — use 'with lock:'"),
         # (c) envknob registry
         Rule("knob-raw-environ", "knobs", ERROR,
              "TPUML_* knobs must go through utils/envknobs accessors so "

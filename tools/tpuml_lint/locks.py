@@ -1,4 +1,4 @@
-"""Checker family (b): guarded-by lock discipline.
+"""Checker family (b): guarded-by lock discipline — interprocedural.
 
 Convention: the line that first assigns a shared attribute carries a
 trailing ``# guarded-by: <lock>`` comment::
@@ -6,21 +6,46 @@ trailing ``# guarded-by: <lock>`` comment::
     self._versions = {}      # guarded-by: _lock      (class attribute)
     _PROGRAMS = OrderedDict() # guarded-by: _LOCK     (module global)
 
-The checker then enforces what the comment promises, lexically: every
-subsequent read or write of the guarded attribute in the owning class
-(inheritance within the module included) — or, for a module global,
-inside any function of the module — must sit inside a ``with
-self.<lock>:`` / ``with <lock>:`` block. ``__init__``/``__new__`` are
-exempt (the object is not shared during construction), as is module
-top-level code (imports run single-threaded by convention).
+The checker enforces what the comment promises across method
+boundaries: every read or write of the guarded attribute in the owning
+class (inheritance within the module included) — or, for a module
+global, inside any function of the module — must happen while the lock
+is held, either lexically (``with self.<lock>:`` / ``with <lock>:``) or
+*provably at every call site*: a private helper (leading underscore,
+non-dunder) whose intra-module callers ALL hold the lock inherits that
+lock context (must-analysis: the intersection of the held sets at its
+call sites, computed to a fixpoint over the per-class/per-module call
+graph from :func:`engine.iter_scopes`). ``__init__``/``__new__`` are
+exempt for ``self`` attributes (the object is not shared during
+construction — construction-time call sites are likewise ignored), as
+is module top-level code (imports run single-threaded by convention).
 
-A helper that is only ever CALLED with the lock held still gets flagged
-— that is deliberate: the convention is lexical so it can be machine-
-checked; restructure the helper to take values as arguments, or
+Lock aliases are resolved within a scope: ``self._cv = self._cond`` or
+``_MUTEX = _LOCK`` makes either name satisfy a guard declared under the
+other (union-find, canonical = smallest name). An attribute assigned
+from *another object's* lock (``self._lock = registry._lock``) counts
+as a defined lock here; cross-object identity is the runtime
+sanitizer's job (``spark_rapids_ml_tpu/utils/lockcheck.py``).
+
+Three more rules ride on the same analysis:
+
+- ``lock-unknown``: an annotation names a lock the owning scope never
+  defines, so a typo'd annotation cannot silently check nothing.
+- ``lock-order``: nested ``with`` scopes (call graph included, using
+  may-held sets so every potential nesting counts) build a static
+  acquisition-order graph per module; any cycle — lock A taken under B
+  somewhere and B under A somewhere else — is a potential deadlock.
+  Reentrant self-nesting (RLock) is not an edge. Cross-class and
+  cross-module ordering is invisible statically; the runtime
+  sanitizer's global order graph covers that half.
+- ``lock-leak``: ``<lock>.acquire()`` without a guaranteed release —
+  no enclosing (or immediately following) ``try/finally`` that releases
+  the same lock — leaves the lock held forever on the first exception.
+
+A helper the analysis cannot prove (public, or called lock-free from
+anywhere) still gets flagged; restructure it, add a lexical ``with``,
+or assert the invariant at runtime with ``lockcheck.guarded()`` and
 document the exception with ``# tpuml: noqa[lock-guarded]``.
-
-``lock-unknown`` fires when an annotation names a lock the owning scope
-never defines, so a typo'd annotation cannot silently check nothing.
 """
 
 from __future__ import annotations
@@ -29,10 +54,23 @@ import ast
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
-from tools.tpuml_lint.engine import ModuleContext, RepoContext
+from tools.tpuml_lint.engine import (
+    ModuleContext,
+    RepoContext,
+    call_target,
+    iter_scopes,
+)
 from tools.tpuml_lint.findings import Finding
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+#: threading constructors and the lockcheck factory fronts for them.
+_LOCK_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "make_lock", "make_rlock", "make_condition",
+}
+
+_CONSTRUCTORS = ("__init__", "__new__")
 
 
 def _annotation_on(module: ModuleContext, lineno: int) -> Optional[str]:
@@ -53,12 +91,49 @@ def _self_attr(node: ast.AST) -> Optional[str]:
     return None
 
 
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _LOCK_CTORS
+    if isinstance(fn, ast.Name):
+        return fn.id in _LOCK_CTORS
+    return False
+
+
+class _Aliases:
+    """Union-find over lock names; canonical = smallest name, so runs
+    are deterministic regardless of declaration order."""
+
+    def __init__(self):
+        self._parent: Dict[str, str] = {}
+
+    def find(self, name: str) -> str:
+        path = []
+        while self._parent.get(name, name) != name:
+            path.append(name)
+            name = self._parent[name]
+        for p in path:
+            self._parent[p] = name
+        return name
+
+    def union(self, a: str, b: str) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            keep, drop = sorted((ra, rb))
+            self._parent[drop] = keep
+
+
 class _ClassInfo:
     def __init__(self, node: ast.ClassDef):
         self.node = node
         self.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
         self.guarded: Dict[str, Tuple[str, int]] = {}  # attr -> (lock, line)
         self.assigned_attrs: Set[str] = set()
+        self.lock_attrs: Set[str] = set()
+        self.aliases = _Aliases()
+        self.alias_pairs: List[Tuple[str, str]] = []
 
 
 def _scan_class(module: ModuleContext, node: ast.ClassDef) -> _ClassInfo:
@@ -69,6 +144,7 @@ def _scan_class(module: ModuleContext, node: ast.ClassDef) -> _ClassInfo:
             targets = list(sub.targets)
         elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
             targets = [sub.target]
+        value = getattr(sub, "value", None)
         for t in targets:
             attr = _self_attr(t)
             if attr is None:
@@ -77,148 +153,477 @@ def _scan_class(module: ModuleContext, node: ast.ClassDef) -> _ClassInfo:
             lock = _annotation_on(module, sub.lineno)
             if lock is not None:
                 info.guarded[attr] = (lock, sub.lineno)
+            if _is_lock_ctor(value):
+                info.lock_attrs.add(attr)
+            elif isinstance(value, ast.Attribute):
+                other = _self_attr(value)
+                if other is not None:
+                    info.alias_pairs.append((attr, other))
+                else:
+                    # self._lock = registry._lock — an adopted lock.
+                    # Identity across objects is the runtime half's job;
+                    # statically it is "a lock this class defines".
+                    info.lock_attrs.add(attr)
     return info
+
+
+def _resolve_aliases(aliases: _Aliases, pairs: List[Tuple[str, str]],
+                     lockish: Set[str]) -> Set[str]:
+    """Union alias pairs that touch a known lock name; two passes pick
+    up chains declared in either order. Returns the canonical lock set."""
+    for _ in range(2):
+        canon = {aliases.find(n) for n in lockish}
+        for a, b in pairs:
+            if aliases.find(a) in canon or aliases.find(b) in canon:
+                aliases.union(a, b)
+    return {aliases.find(n) for n in lockish}
 
 
 def _effective(info: _ClassInfo, classes: Dict[str, _ClassInfo],
                seen: Optional[Set[str]] = None
-               ) -> Tuple[Dict[str, Tuple[str, int]], Set[str]]:
-    """(guarded map, attrs-assigned) including same-module base classes."""
+               ) -> Tuple[Dict[str, Tuple[str, int]], Set[str], Set[str],
+                          List[Tuple[str, str]]]:
+    """(guarded map, attrs-assigned, lock attrs, alias pairs) including
+    same-module base classes."""
     seen = seen or set()
     guarded = dict(info.guarded)
     assigned = set(info.assigned_attrs)
+    locks = set(info.lock_attrs)
+    pairs = list(info.alias_pairs)
     for base in info.bases:
         b = classes.get(base)
         if b is None or base in seen:
             continue
-        g, a = _effective(b, classes, seen | {info.node.name})
+        g, a, lk, pr = _effective(b, classes, seen | {info.node.name})
         for attr, v in g.items():
             guarded.setdefault(attr, v)
         assigned |= a
-    return guarded, assigned
+        locks |= lk
+        pairs += pr
+    return guarded, assigned, locks, pairs
 
 
-def _check_method(module: ModuleContext, cls: str, fn: ast.FunctionDef,
-                  guarded: Dict[str, Tuple[str, int]]) -> List[Finding]:
-    findings: List[Finding] = []
+class _Scope:
+    """One resolved class context: effective guarded map, aliases,
+    canonical lock-attr set."""
 
-    def visit(node: ast.AST, held: Set[str]) -> None:
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            inner = set(held)
-            for item in node.items:
-                attr = _self_attr(item.context_expr)
-                if attr is not None:
-                    inner.add(attr)
-            for child in node.body:
-                visit(child, inner)
-            return
-        attr = _self_attr(node)
-        if attr is not None and attr in guarded:
-            lock = guarded[attr][0]
-            if lock not in held:
-                ctx = getattr(node, "ctx", None)
-                verb = "written" if isinstance(ctx, (ast.Store, ast.Del)) else "read"
-                findings.append(Finding(
-                    module.rel, node.lineno, node.col_offset, "lock-guarded",
-                    f"self.{attr} is {verb} in {cls}.{fn.name}() outside "
-                    f"'with self.{lock}:' (declared guarded-by {lock})",
+    def __init__(self, name: str, info: _ClassInfo,
+                 classes: Dict[str, _ClassInfo]):
+        self.name = name
+        self.info = info
+        guarded, assigned, locks, pairs = _effective(info, classes)
+        self.guarded = guarded
+        self.assigned = assigned
+        self.aliases = info.aliases
+        lockish = locks | {lock for lock, _ in guarded.values()
+                           if lock in assigned}
+        self.lock_canon = _resolve_aliases(self.aliases, pairs, lockish)
+        self.methods = {
+            s.name: s for s in info.node.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+
+class _Analysis:
+    """The whole-module pass: alias-resolved guard checking with
+    interprocedural must-held propagation, may-held lock-order edge
+    collection, and leak detection."""
+
+    def __init__(self, module: ModuleContext):
+        self.module = module
+        self.findings: List[Finding] = []
+        # (src, dst) -> (line, scope qualname); src/dst are module-scoped
+        # lock tokens ("_LOCK" or "ClassName._lock", alias-canonical).
+        self.edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+
+        # --- module-level declarations ---------------------------------
+        self.mod_guarded: Dict[str, Tuple[str, int]] = {}
+        self.mod_names: Set[str] = set()
+        self.mod_aliases = _Aliases()
+        mod_locks: Set[str] = set()
+        mod_pairs: List[Tuple[str, str]] = []
+        for stmt in module.tree.body:
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target] if isinstance(stmt, ast.AnnAssign)
+                else []
+            )
+            value = getattr(stmt, "value", None)
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                self.mod_names.add(t.id)
+                lock = _annotation_on(module, stmt.lineno)
+                if lock is not None:
+                    self.mod_guarded[t.id] = (lock, stmt.lineno)
+                if _is_lock_ctor(value):
+                    mod_locks.add(t.id)
+                elif isinstance(value, ast.Name):
+                    mod_pairs.append((t.id, value.id))
+        lockish = mod_locks | {
+            lock for lock, _ in self.mod_guarded.values()
+            if lock in self.mod_names
+        }
+        self.mod_lock_canon = _resolve_aliases(
+            self.mod_aliases, mod_pairs, lockish)
+
+        # --- classes (inheritance resolved within the module) -----------
+        infos: Dict[str, _ClassInfo] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                infos[node.name] = _scan_class(module, node)
+        self.scopes: Dict[str, _Scope] = {
+            name: _Scope(name, info, infos) for name, info in infos.items()
+        }
+
+        # --- the call-graph node set ------------------------------------
+        # fid: ("cls", ClassName, meth) | ("mod", fname); jobs carry the
+        # fn node + owning scope. Only scopes from iter_scopes are nodes;
+        # nested defs are analyzed as part of their enclosing scope.
+        self.jobs: List[Tuple[tuple, Optional[_Scope], ast.AST]] = []
+        self.mod_funcs: Set[str] = set()
+        for cls_name, fn in iter_scopes(module.tree):
+            if cls_name is None:
+                self.jobs.append((("mod", fn.name), None, fn))
+                self.mod_funcs.add(fn.name)
+            else:
+                self.jobs.append(
+                    (("cls", cls_name, fn.name), self.scopes[cls_name], fn))
+        # fid -> (frozenset of self-canon locks, frozenset of mod-canon
+        # locks) a private helper provably/possibly enters with.
+        self.entry_must: Dict[tuple, tuple] = {}
+        self.entry_may: Dict[tuple, tuple] = {}
+
+    # --- helpers --------------------------------------------------------
+
+    def has_locks(self) -> bool:
+        return bool(
+            self.mod_guarded or self.mod_lock_canon
+            or any(s.guarded or s.lock_canon for s in self.scopes.values())
+        )
+
+    @staticmethod
+    def _creditable(fid: tuple) -> bool:
+        name = fid[-1]
+        return name.startswith("_") and not name.startswith("__")
+
+    def _entry_sets(self, fid: tuple, scope: Optional[_Scope]
+                    ) -> Tuple[Set[str], Set[str], List[str]]:
+        """(must self, must mod, may order tokens) for one job under the
+        current fixpoint state. Absent entries are bottom (no credit):
+        the fixpoint climbs from below, so a helper is only ever
+        credited with locks provably held at EVERY call site."""
+        self_must, mod_must = self.entry_must.get(
+            fid, (frozenset(), frozenset()))
+        may_s, may_m = self.entry_may.get(fid, (frozenset(), frozenset()))
+        tokens = sorted(
+            f"{scope.name}.{a}" for a in may_s
+            if scope is not None and a in scope.lock_canon
+        ) + sorted(m for m in may_m if m in self.mod_lock_canon)
+        return set(self_must), set(mod_must), tokens
+
+    # --- the traversal (shared by fixpoint + final check) ---------------
+
+    def _walk(self, fid: tuple, scope: Optional[_Scope], fn: ast.AST,
+              on_call, check: bool) -> None:
+        check_self = check and fn.name not in _CONSTRUCTORS
+        self_must, mod_must, may0 = self._entry_sets(fid, scope)
+        qual = f"{scope.name}.{fn.name}" if scope else fn.name
+
+        def tokens_for(item_expr: ast.AST) -> Tuple[Optional[str],
+                                                    Optional[str],
+                                                    Optional[str]]:
+            """(self canon, mod canon, order token) for one with-item."""
+            attr = _self_attr(item_expr)
+            if attr is not None and scope is not None:
+                c = scope.aliases.find(attr)
+                tok = f"{scope.name}.{c}" if c in scope.lock_canon else None
+                return c, None, tok
+            if isinstance(item_expr, ast.Name):
+                c = self.mod_aliases.find(item_expr.id)
+                tok = c if c in self.mod_lock_canon else None
+                return None, c, tok
+            return None, None, None
+
+        def visit(node: ast.AST, s_held: Set[str], m_held: Set[str],
+                  order: List[str]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                s2, m2, o2 = set(s_held), set(m_held), list(order)
+                for item in node.items:
+                    s_c, m_c, tok = tokens_for(item.context_expr)
+                    if s_c is not None:
+                        s2.add(s_c)
+                    if m_c is not None:
+                        m2.add(m_c)
+                    if tok is not None and tok not in o2:
+                        for prev in o2:
+                            self.edges.setdefault(
+                                (prev, tok), (node.lineno, qual))
+                        o2.append(tok)
+                for child in node.body:
+                    visit(child, s2, m2, o2)
+                return
+            if isinstance(node, ast.Call):
+                tgt = call_target(node)
+                if tgt is not None:
+                    if tgt[0] == "self" and scope is not None:
+                        on_call(("cls", scope.name, tgt[1]), fn.name,
+                                s_held, m_held, order)
+                    elif tgt[0] == "local" and tgt[1] in self.mod_funcs:
+                        on_call(("mod", tgt[1]), fn.name,
+                                s_held, m_held, order)
+            if check:
+                attr = _self_attr(node)
+                if (
+                    check_self and scope is not None
+                    and attr is not None and attr in scope.guarded
+                ):
+                    lock = scope.guarded[attr][0]
+                    if scope.aliases.find(lock) not in s_held:
+                        ctx = getattr(node, "ctx", None)
+                        verb = ("written"
+                                if isinstance(ctx, (ast.Store, ast.Del))
+                                else "read")
+                        self.findings.append(Finding(
+                            self.module.rel, node.lineno, node.col_offset,
+                            "lock-guarded",
+                            f"self.{attr} is {verb} in {qual}() outside "
+                            f"'with self.{lock}:' (declared guarded-by "
+                            f"{lock})",
+                        ))
+                if isinstance(node, ast.Name) and node.id in self.mod_guarded:
+                    lock = self.mod_guarded[node.id][0]
+                    if self.mod_aliases.find(lock) not in m_held:
+                        verb = ("written"
+                                if isinstance(node.ctx, (ast.Store, ast.Del))
+                                else "read")
+                        self.findings.append(Finding(
+                            self.module.rel, node.lineno, node.col_offset,
+                            "lock-guarded",
+                            f"module global {node.id} is {verb} outside "
+                            f"'with {lock}:' (declared guarded-by {lock})",
+                        ))
+            for child in ast.iter_child_nodes(node):
+                visit(child, s_held, m_held, order)
+
+        for stmt in fn.body:
+            visit(stmt, set(self_must), set(mod_must), list(may0))
+
+    # --- the fixpoint ---------------------------------------------------
+
+    def solve(self) -> None:
+        """Iterate to the held-set fixpoint. Each round re-derives every
+        creditable helper's entry sets from the held sets observed at
+        its call sites (must = intersection, may = union) under the
+        previous round's entries. Starting from bottom, must-sets only
+        grow toward what is provable at every site, so this converges;
+        the bound is a backstop for pathological graphs."""
+        creditable = {
+            fid for fid, _, _ in self.jobs if self._creditable(fid)
+        }
+        for _ in range(len(self.jobs) + 2):
+            contrib_must: Dict[tuple, tuple] = {}
+            contrib_may: Dict[tuple, tuple] = {}
+
+            def on_call(callee, caller_name, s_held, m_held, order):
+                if callee not in creditable:
+                    return
+                if callee[0] == "cls" and caller_name in _CONSTRUCTORS:
+                    return  # construction-time call: object unshared
+                s, m = frozenset(s_held), frozenset(m_held)
+                prev = contrib_must.get(callee)
+                contrib_must[callee] = (
+                    (s, m) if prev is None else (prev[0] & s, prev[1] & m))
+                pm = contrib_may.get(callee, (frozenset(), frozenset()))
+                contrib_may[callee] = (pm[0] | s, pm[1] | m)
+
+            for fid, scope, fn in self.jobs:
+                self._walk(fid, scope, fn, on_call, check=False)
+
+            if (contrib_must == self.entry_must
+                    and contrib_may == self.entry_may):
+                break
+            self.entry_must = contrib_must
+            self.entry_may = contrib_may
+
+    # --- final passes ---------------------------------------------------
+
+    def report_guards(self) -> None:
+        def on_call(*_args):
+            pass
+
+        for fid, scope, fn in self.jobs:
+            self._walk(fid, scope, fn, on_call, check=True)
+        # Top-level code outside any function is exempt (single-threaded
+        # import convention) but still contributes no findings — matching
+        # the seed checker's behavior.
+
+    def report_unknown(self) -> None:
+        for name, (lock, line) in self.mod_guarded.items():
+            if lock not in self.mod_names:
+                self.findings.append(Finding(
+                    self.module.rel, line, 0, "lock-unknown",
+                    f"guarded-by names {lock!r}, which this module never "
+                    "assigns at top level",
                 ))
-        for child in ast.iter_child_nodes(node):
-            visit(child, held)
+        for name, scope in self.scopes.items():
+            for attr, (lock, line) in sorted(scope.guarded.items()):
+                if attr in scope.info.guarded and lock not in scope.assigned:
+                    self.findings.append(Finding(
+                        self.module.rel, line, 0, "lock-unknown",
+                        f"guarded-by names self.{lock}, which {name} (and "
+                        "its bases here) never assigns",
+                    ))
 
-    for stmt in fn.body:
-        visit(stmt, set())
-    return findings
+    def report_cycles(self) -> None:
+        adj: Dict[str, Set[str]] = {}
+        for (src, dst), _ in self.edges.items():
+            adj.setdefault(src, set()).add(dst)
+        seen_cycles: Set[frozenset] = set()
+        for (src, dst), (line, qual) in sorted(
+            self.edges.items(), key=lambda kv: (kv[1][0], kv[0])
+        ):
+            # Does dst reach src? Then this edge closes a cycle.
+            path = self._find_path(adj, dst, src)
+            if path is None:
+                continue
+            nodes = [src] + path[:-1]  # path runs dst..src inclusive
+            key = frozenset(nodes)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            self.findings.append(Finding(
+                self.module.rel, line, 0, "lock-order",
+                "lock acquisition-order cycle: "
+                + " -> ".join(nodes + [src])
+                + f" (edge {src} -> {dst} added in {qual}); two threads "
+                "taking these locks in opposite orders deadlock",
+            ))
+
+    @staticmethod
+    def _find_path(adj: Dict[str, Set[str]], start: str, goal: str
+                   ) -> Optional[List[str]]:
+        parent: Dict[str, Optional[str]] = {start: None}
+        queue = [start]
+        while queue:
+            cur = queue.pop(0)
+            if cur == goal:
+                path = [cur]
+                while parent[cur] is not None:
+                    cur = parent[cur]
+                    path.append(cur)
+                return list(reversed(path))
+            for nxt in sorted(adj.get(cur, ())):
+                if nxt not in parent:
+                    parent[nxt] = cur
+                    queue.append(nxt)
+        return None
+
+    def report_leaks(self) -> None:
+        all_cls_locks: Set[str] = set()
+        for scope in self.scopes.values():
+            all_cls_locks |= {
+                a for a in scope.assigned
+                if scope.aliases.find(a) in scope.lock_canon
+            }
+        for node in ast.walk(self.module.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                continue
+            base = node.func.value
+            attr = _self_attr(base)
+            if attr is not None and attr in all_cls_locks:
+                desc = f"self.{attr}"
+            elif (
+                isinstance(base, ast.Name)
+                and self.mod_aliases.find(base.id) in self.mod_lock_canon
+            ):
+                desc = base.id
+            else:
+                continue
+            if self._has_release_path(node, base):
+                continue
+            self.findings.append(Finding(
+                self.module.rel, node.lineno, node.col_offset, "lock-leak",
+                f"{desc}.acquire() without a guaranteed release path "
+                "(no try/finally releasing it) — the first exception "
+                f"leaves it held forever; use 'with {desc}:'",
+            ))
+
+    def _has_release_path(self, call: ast.Call, base: ast.AST) -> bool:
+        def releases(body: List[ast.stmt]) -> bool:
+            for sub in body:
+                for n in ast.walk(sub):
+                    if (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "release"
+                        and ast.dump(n.func.value) == ast.dump(base)
+                    ):
+                        return True
+            return False
+
+        # (a) an enclosing try/finally that releases the same lock
+        node: ast.AST = call
+        while True:
+            parent = self.module.parent_of(node)
+            if parent is None:
+                break
+            if isinstance(parent, ast.Try) and releases(parent.finalbody):
+                return True
+            node = parent
+        # (b) lock.acquire() immediately followed by try/finally release
+        stmt: ast.AST = call
+        parent = self.module.parent_of(stmt)
+        while parent is not None and not isinstance(stmt, ast.stmt):
+            stmt = parent
+            parent = self.module.parent_of(stmt)
+        if parent is not None:
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(parent, field, None)
+                if isinstance(body, list) and stmt in body:
+                    i = body.index(stmt)
+                    if (
+                        i + 1 < len(body)
+                        and isinstance(body[i + 1], ast.Try)
+                        and releases(body[i + 1].finalbody)
+                    ):
+                        return True
+        return False
 
 
-def _check_module_globals(module: ModuleContext,
-                          guarded: Dict[str, Tuple[str, int]]) -> List[Finding]:
-    findings: List[Finding] = []
+def analyze(module: ModuleContext) -> _Analysis:
+    """Run the full pass; exposed so ``--lock-graph`` can dump the
+    acquisition-order edges the checker derived."""
+    a = _Analysis(module)
+    if not a.has_locks():
+        return a
+    a.solve()
+    a.report_unknown()
+    a.report_guards()
+    a.report_cycles()
+    a.report_leaks()
+    return a
 
-    def visit(node: ast.AST, held: Set[str], in_fn: bool) -> None:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for child in node.body:
-                visit(child, held, True)
-            return
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            inner = set(held)
-            for item in node.items:
-                if isinstance(item.context_expr, ast.Name):
-                    inner.add(item.context_expr.id)
-            for child in node.body:
-                visit(child, inner, in_fn)
-            return
-        if in_fn and isinstance(node, ast.Name) and node.id in guarded:
-            lock = guarded[node.id][0]
-            if lock not in held:
-                verb = (
-                    "written"
-                    if isinstance(node.ctx, (ast.Store, ast.Del))
-                    else "read"
-                )
-                findings.append(Finding(
-                    module.rel, node.lineno, node.col_offset, "lock-guarded",
-                    f"module global {node.id} is {verb} outside "
-                    f"'with {lock}:' (declared guarded-by {lock})",
-                ))
-        for child in ast.iter_child_nodes(node):
-            visit(child, held, in_fn)
 
-    for stmt in module.tree.body:
-        visit(stmt, set(), False)
-    return findings
+def order_edges(module: ModuleContext) -> List[dict]:
+    """The module's static acquisition-order edges as JSON-able rows."""
+    a = _Analysis(module)
+    if not a.has_locks():
+        return []
+    a.solve()
+    a.report_guards()  # the edge-collecting traversal
+    return [
+        {"module": module.rel, "src": src, "dst": dst,
+         "line": line, "scope": qual}
+        for (src, dst), (line, qual) in sorted(a.edges.items())
+    ]
 
 
 def check(module: ModuleContext, repo: RepoContext) -> List[Finding]:
-    findings: List[Finding] = []
-
-    # Module globals: annotated top-level assignments.
-    module_guarded: Dict[str, Tuple[str, int]] = {}
-    module_names: Set[str] = set()
-    for stmt in module.tree.body:
-        targets = (
-            stmt.targets if isinstance(stmt, ast.Assign)
-            else [stmt.target] if isinstance(stmt, ast.AnnAssign)
-            else []
-        )
-        for t in targets:
-            if isinstance(t, ast.Name):
-                module_names.add(t.id)
-                lock = _annotation_on(module, stmt.lineno)
-                if lock is not None:
-                    module_guarded[t.id] = (lock, stmt.lineno)
-    for name, (lock, line) in module_guarded.items():
-        if lock not in module_names:
-            findings.append(Finding(
-                module.rel, line, 0, "lock-unknown",
-                f"guarded-by names {lock!r}, which this module never "
-                "assigns at top level",
-            ))
-    if module_guarded:
-        findings.extend(_check_module_globals(module, module_guarded))
-
-    # Classes (inheritance resolved within the module).
-    classes: Dict[str, _ClassInfo] = {}
-    for node in ast.walk(module.tree):
-        if isinstance(node, ast.ClassDef):
-            classes[node.name] = _scan_class(module, node)
-    for name, info in classes.items():
-        guarded, assigned = _effective(info, classes)
-        if not guarded:
-            continue
-        for attr, (lock, line) in sorted(guarded.items()):
-            if attr in info.guarded and lock not in assigned:
-                findings.append(Finding(
-                    module.rel, line, 0, "lock-unknown",
-                    f"guarded-by names self.{lock}, which {name} (and its "
-                    "bases here) never assigns",
-                ))
-        for stmt in info.node.body:
-            if (
-                isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and stmt.name not in ("__init__", "__new__")
-            ):
-                findings.extend(
-                    _check_method(module, name, stmt, guarded)
-                )
-    return findings
+    if module.tree is None:
+        return []
+    return analyze(module).findings
