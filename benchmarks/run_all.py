@@ -35,6 +35,7 @@ CONFIGS = [
     ("config15_serving.py", {}),
     ("config16_server.py", {}),
     ("config17_kmeans_packed.py", {}),
+    ("config18_router.py", {}),
 ]
 
 

@@ -392,6 +392,20 @@ def serving_report() -> dict:
         # first dispatch still registers them with the right buckets.
         out["request_latency_ms"] = _batcher._latency_hist().value()
         out["batch_fill"] = _batcher._fill_hist().value()
+    try:
+        from spark_rapids_ml_tpu.serving.router import router_snapshots
+
+        routers = router_snapshots()
+    except ImportError:  # pragma: no cover - serving package stripped
+        routers = []
+    if routers:
+        # The distributed tier's front door(s): per-member depth/
+        # outstanding/shed/backoff as the router sees them, plus the
+        # router-clock latency histogram over routed requests.
+        out["routers"] = routers
+        out["routed_latency_ms"] = default_registry.histogram(
+            "serving.router.latency_ms"
+        ).value()
     return out
 
 

@@ -26,7 +26,8 @@ Deployment shape (mirrors the reference's executor model):
 from __future__ import annotations
 
 import functools as _functools
-from typing import List, Optional, Sequence, Tuple
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -231,6 +232,39 @@ def global_mesh(shape: Optional[Tuple[int, int]] = None) -> Mesh:
     builds the identical mesh (jax.devices() is globally consistent after
     :func:`initialize`)."""
     return make_mesh(shape)
+
+
+def member_env(
+    process_id: int,
+    num_processes: int,
+    base: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    """The environment for one spawned gang member (the serving router's
+    worker processes, or any launcher forking local peers): the parent's
+    environment plus this member's gang coordinates and the PR 7 trace
+    carrier, so the child's telemetry shard lands in the same merged
+    trace with a distinct process index. Members run as INDEPENDENT
+    single-process runtimes (no jax.distributed cohort), so any inherited
+    coordinator address is dropped rather than having N children fight
+    over one gang slot. The repo root rides PYTHONPATH so ``python -m``
+    entry points resolve regardless of the parent's cwd."""
+    from spark_rapids_ml_tpu.observability.events import inject_env
+
+    env = dict(base if base is not None else os.environ)
+    env["TPUML_PROCESS_ID"] = str(int(process_id))
+    env["TPUML_NUM_PROCESSES"] = str(int(num_processes))
+    env.pop("TPUML_COORDINATOR", None)
+    inject_env(env)
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    existing = env.get("PYTHONPATH")
+    if existing:
+        if root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = root + os.pathsep + existing
+    else:
+        env["PYTHONPATH"] = root
+    return env
 
 
 def _allgather_counts_and_width(n_local: int, d_local: int):

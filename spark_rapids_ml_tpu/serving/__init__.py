@@ -5,8 +5,13 @@ The in-process inference layer over the PR 2 AOT program cache
 pinning, warm-up and hot swap; a :class:`MicroBatcher` coalescing
 concurrent callers into shared bucketed executions; memory-budgeted
 admission with structured :class:`Overloaded` shedding; and the
-:class:`ServingRuntime` façade tying them together. See each module's
-docstring for the design; README "Online serving" for the walkthrough.
+:class:`ServingRuntime` façade tying them together. The distributed
+tier scales that façade across processes: :class:`RoutingRuntime`
+(``router.py``) spreads micro-batches over N ``worker.py`` member
+processes with backpressure-weighted routing, a replicated registry
+with version-atomic hot swap, and a mesh-sharded path for requests too
+big for any one member. See each module's docstring for the design;
+README "Online serving" / "Scaling the serving tier" for walkthroughs.
 """
 
 from spark_rapids_ml_tpu.serving.admission import (
@@ -16,6 +21,7 @@ from spark_rapids_ml_tpu.serving.admission import (
 )
 from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
 from spark_rapids_ml_tpu.serving.registry import ModelRegistry, ModelVersion
+from spark_rapids_ml_tpu.serving.router import RoutingRuntime, router_snapshots
 from spark_rapids_ml_tpu.serving.server import ServingRuntime, runtime_snapshots
 from spark_rapids_ml_tpu.serving.signature import ServingSignature
 
@@ -26,7 +32,9 @@ __all__ = [
     "ModelRegistry",
     "ModelVersion",
     "Overloaded",
+    "RoutingRuntime",
     "ServingRuntime",
     "ServingSignature",
+    "router_snapshots",
     "runtime_snapshots",
 ]

@@ -1,0 +1,118 @@
+"""Length-prefixed pickle framing for the router <-> worker socket hop.
+
+The distributed serving tier (``serving/router.py`` front door, one
+``serving/worker.py`` process per member) talks over one persistent
+loopback TCP connection per member. Frames are ``4-byte big-endian
+length + pickle``; every request dict carries an ``id`` the reply echoes,
+so the router can pipeline many requests down one connection and a
+receiver thread demultiplexes replies onto per-request futures.
+
+Models cross the wire cloudpickled (plain pickle chokes on the lambda
+default-value closures in the param mixins); numpy row blocks and result
+pytrees go through the protocol-5 fast path. cloudpickle is the same
+serializer the Spark task closures already depend on, so this adds no
+dependency the deployment doesn't have — with a plain-pickle fallback
+for model objects that support it.
+
+Workers only ever bind 127.0.0.1 and members rendezvous through a
+shared directory of ``member-<id>.json`` files (atomic tmp+rename
+writes), mirroring the coordinator handoff in ``parallel/distributed``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import socket
+import struct
+import tempfile
+from typing import Any, Optional
+
+_LEN = struct.Struct(">I")
+
+#: Frames above this are refused before allocation — a corrupt length
+#: prefix must fail loudly, not trigger a multi-GB read.
+MAX_FRAME_BYTES = 1 << 31
+
+
+def dumps_model(model: Any) -> bytes:
+    """Serialize a model object for registry replication."""
+    try:
+        import cloudpickle
+
+        return cloudpickle.dumps(model)
+    except ImportError:  # pragma: no cover - cloudpickle is baked in
+        return pickle.dumps(model)
+
+
+def loads_model(blob: bytes) -> Any:
+    return pickle.loads(blob)
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    """One framed message. The caller serializes access per socket."""
+    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:  # orderly EOF mid-frame or between frames
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    """The next framed message, or None on orderly EOF."""
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(f"ipc frame of {length} bytes exceeds the bound")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+# --- the rendezvous directory ------------------------------------------
+
+
+def member_path(rendezvous: str, member: int) -> str:
+    return os.path.join(rendezvous, f"member-{int(member)}.json")
+
+
+def publish_member(rendezvous: str, member: int, host: str, port: int) -> str:
+    """Atomically publish one member's contact card (tmp + rename, the
+    same torn-write posture the checkpoint layer uses)."""
+    os.makedirs(rendezvous, exist_ok=True)
+    card = {"member": int(member), "pid": os.getpid(), "host": host,
+            "port": int(port)}
+    fd, tmp = tempfile.mkstemp(dir=rendezvous, prefix=f".member-{member}-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(card, f)
+        path = member_path(rendezvous, member)
+        os.replace(tmp, path)
+        return path
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_member(rendezvous: str, member: int) -> Optional[dict]:
+    """The member's contact card, or None while it hasn't published."""
+    path = member_path(rendezvous, member)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None

@@ -1,0 +1,982 @@
+"""RoutingRuntime — the multi-process serving front door.
+
+The distributed serving tier: one router process spreading micro-batch
+traffic across N :mod:`serving.worker` member processes, each a full
+:class:`ServingRuntime` with its own admission queue, micro-batcher and
+AOT program cache. The façade is the same ``submit`` / ``submit_many`` /
+``close`` contract the in-process runtime exposes, so callers scale from
+one process to a gang by swapping the constructor.
+
+Three mechanisms carry the design:
+
+- **Backpressure-driven member selection.** Every worker reply
+  piggy-backs its live queue depth; the router picks the member with the
+  lowest ``outstanding + reported depth`` (weighted least-loaded). A
+  member that sheds answers with its ``Overloaded.retry_after_ms`` hint
+  — p95 of ITS latency histogram — and the router skips it for exactly
+  that window while transparently retrying the request on the next-best
+  member. Only when every member is shedding or backed off does the
+  caller see an :class:`Overloaded` (with the soonest-recovery hint).
+
+- **Replicated registry with version-atomic hot swap.** Registry
+  mutations replicate as an lsn-ordered op log; ``ModelRegistry``
+  assigns versions monotonically per name, so identical log order yields
+  identical version numbers on every member (asserted on every ack).
+  Alias flips are two-phase: warm the target version on EVERY member,
+  replicate the alias, and only then flip the ROUTER's alias — the
+  resolution traffic actually reads. Every request ships a concrete
+  ``(name, version)``, and each member's coalescing key carries the
+  version, so no batch anywhere can mix versions and no request sheds
+  over a swap.
+
+- **Mesh-sharded oversized requests.** A single request too big for any
+  one member's measured admission budget would shed everywhere; the
+  router instead executes it locally over the global device mesh —
+  rows sharded on the data axis, weights replicated once per version —
+  through ``core/serving``'s cached plain-jit sharded fallback (the
+  PR 2 path: multi-device operands route around the strict AOT cache).
+
+PR 7's trace carrier rides every routed request, so the router's route
+event and the member's enqueue/dispatch/complete events merge into ONE
+trace per request across the process hop (``tools/tpuml_trace.py``).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, Iterable, List, Optional, Set
+
+import numpy as np
+
+from spark_rapids_ml_tpu.core.serving import _compute_dtype, bucket_rows
+from spark_rapids_ml_tpu.observability.events import (
+    begin_trace,
+    current_trace_context,
+    emit,
+    inject_env,
+    new_run_id,
+    trace_scope,
+)
+from spark_rapids_ml_tpu.observability.metrics import gauge, histogram
+from spark_rapids_ml_tpu.serving import ipc
+from spark_rapids_ml_tpu.serving.admission import (
+    DEFAULT_RETRY_AFTER_MS,
+    Overloaded,
+)
+from spark_rapids_ml_tpu.serving.batcher import LATENCY_MS_BUCKETS
+from spark_rapids_ml_tpu.serving.registry import ModelRegistry, ModelVersion
+from spark_rapids_ml_tpu.serving.signature import spec_bytes
+from spark_rapids_ml_tpu.serving.worker import (
+    CONNECT_TIMEOUT_ENV,
+    DEFAULT_CONNECT_TIMEOUT_S,
+    MEMBER_ENV,
+    RENDEZVOUS_ENV,
+    decode_error,
+)
+from spark_rapids_ml_tpu.utils.envknobs import env_float, env_int
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock, make_rlock
+from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+WORKERS_ENV = "TPUML_ROUTER_WORKERS"
+SHARD_ROWS_ENV = "TPUML_ROUTER_SHARD_ROWS"
+
+DEFAULT_WORKERS = 2
+
+#: Live routers (weak): the serving report's router section.
+_ROUTERS: "weakref.WeakSet[RoutingRuntime]" = weakref.WeakSet()
+_router_seq_lock = make_lock("serving.router_seq")
+_router_seq = 0  # guarded-by: _router_seq_lock
+
+
+def router_snapshots() -> List[dict]:
+    """Point-in-time state of every live :class:`RoutingRuntime`."""
+    return [rt.snapshot() for rt in list(_ROUTERS)]
+
+
+def _routed_latency_hist():
+    return histogram(
+        "serving.router.latency_ms",
+        "submit-to-result latency per routed request (router clock)",
+        buckets=LATENCY_MS_BUCKETS,
+    )
+
+
+class _Member:
+    """The router's handle on one worker process: socket, receiver
+    thread, live load signals, per-member accounting."""
+
+    def __init__(self, member_id: int, card: dict, sock):
+        self.id = int(member_id)
+        self.card = card
+        self.sock = sock
+        self.send_lock = make_lock("serving.router.member_send")
+        self.recv_thread: Optional[threading.Thread] = None
+        self.proc: Optional[subprocess.Popen] = None
+        # Live load signals + accounting. guarded-by: the router's _lock
+        self.last_depth = 0
+        self.outstanding = 0
+        self.backoff_until = 0.0
+        self.dead = False
+        self.routed = 0
+        self.completed = 0
+        self.shed = 0
+        self.retries = 0
+        self.mem_budget = 0
+        self.queue_limit = 0
+
+    def send(self, msg: dict) -> None:
+        with self.send_lock:
+            ipc.send_msg(self.sock, msg)
+
+
+class RoutingRuntime:
+    """Multi-process serving façade: ``submit``/``submit_many``/``close``
+    over a gang of :mod:`serving.worker` members.
+
+    ``launch="spawn"`` (default) forks one worker subprocess per member
+    via :func:`parallel.distributed.member_env` — each inherits the
+    telemetry dir, the launch trace carrier, and a distinct gang process
+    index. ``launch="barrier"`` runs the members as one Spark barrier
+    stage (``spark.barrier.serving_gang_run``) on a background driver
+    thread. ``launch="attach"`` connects to members something else
+    already published into the rendezvous directory.
+    """
+
+    def __init__(
+        self,
+        workers: Optional[int] = None,
+        *,
+        launch: str = "spawn",
+        rdd=None,
+        rendezvous: Optional[str] = None,
+        registry: Optional[ModelRegistry] = None,
+        max_batch: Optional[int] = None,
+        max_delay_ms: Optional[float] = None,
+        queue_limit: Optional[int] = None,
+        mem_budget: Optional[int] = None,
+        connect_timeout: Optional[float] = None,
+        shard_rows: Optional[int] = None,
+    ):
+        global _router_seq
+        if launch not in ("spawn", "barrier", "attach"):
+            raise ValueError(f"unknown launch mode {launch!r}")
+        self.workers = (
+            int(workers)
+            if workers is not None
+            else env_int(WORKERS_ENV, DEFAULT_WORKERS, minimum=1)
+        )
+        self.launch = launch
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.connect_timeout = (
+            float(connect_timeout)
+            if connect_timeout is not None
+            else env_float(CONNECT_TIMEOUT_ENV, DEFAULT_CONNECT_TIMEOUT_S,
+                           minimum=1.0)
+        )
+        self.shard_rows = (
+            int(shard_rows)
+            if shard_rows is not None
+            else env_int(SHARD_ROWS_ENV, 0, minimum=0)
+        )
+        self._serve_knobs = {
+            "TPUML_SERVE_MAX_BATCH": max_batch,
+            "TPUML_SERVE_MAX_DELAY_MS": max_delay_ms,
+            "TPUML_SERVE_QUEUE": queue_limit,
+            "TPUML_SERVE_MEM_BUDGET": mem_budget,
+        }
+        if rendezvous is None:
+            import tempfile
+
+            rendezvous = tempfile.mkdtemp(prefix="tpuml-router-")
+        self.rendezvous = rendezvous
+        self._closed = False
+        self._lock = make_lock("serving.router")
+        self._op_lock = make_rlock("serving.router.oplog")
+        self._mesh_lock = make_lock("serving.router.mesh")
+        self._pending: Dict[int, dict] = {}  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
+        self._lsn = 0  # guarded-by: _op_lock
+        self._members: Dict[int, _Member] = {}
+        self._barrier_thread: Optional[threading.Thread] = None
+        self._barrier_result: list = []
+        self._shard_pool: Optional[ThreadPoolExecutor] = None
+        self._mesh = None  # guarded-by: _mesh_lock
+        self._replicated: Dict[tuple, Any] = {}  # guarded-by: _mesh_lock
+        self._rejected = 0  # guarded-by: _lock
+        self._oversized = 0  # guarded-by: _lock
+        with _router_seq_lock:
+            _router_seq += 1
+            self.router_id = f"serving-router-{_router_seq}"
+        # The launch trace: every member joins it via the env carrier, so
+        # gang bring-up is one merged trace even before the first request.
+        self._launch_trace = current_trace_context() or begin_trace()
+        with trace_scope(self._launch_trace):
+            if launch == "spawn":
+                self._spawn_members(rdd=None)
+            elif launch == "barrier":
+                if rdd is None:
+                    raise ValueError("launch='barrier' needs an rdd")
+                self._launch_barrier(rdd)
+            self._connect_members()
+        _ROUTERS.add(self)
+
+    # --- launch ---------------------------------------------------------
+
+    def _spawn_members(self, rdd) -> None:
+        from spark_rapids_ml_tpu.parallel.distributed import member_env
+
+        for i in range(self.workers):
+            env = member_env(i, self.workers)
+            env[RENDEZVOUS_ENV] = self.rendezvous
+            env[MEMBER_ENV] = str(i)
+            for knob, value in self._serve_knobs.items():
+                if value is not None:
+                    env[knob] = str(value)
+            # -c, not -m: runpy would re-execute serving.worker after the
+            # serving package (whose __init__ imports it) already did.
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    "from spark_rapids_ml_tpu.serving.worker import main; "
+                    "raise SystemExit(main())",
+                ],
+                env=env,
+            )
+            member = _Member(i, {"pid": proc.pid}, sock=None)
+            member.proc = proc
+            self._members[i] = member
+
+    def _launch_barrier(self, rdd) -> None:
+        from spark_rapids_ml_tpu.spark.barrier import serving_gang_run
+
+        def run():
+            try:
+                self._barrier_result.append(
+                    serving_gang_run(rdd, self.rendezvous)
+                )
+            except BaseException as exc:  # noqa: BLE001 - surfaced at close
+                self._barrier_result.append(exc)
+
+        self._barrier_thread = threading.Thread(
+            target=run, name="tpuml-router-gang", daemon=True
+        )
+        self._barrier_thread.start()
+        for i in range(self.workers):
+            self._members[i] = _Member(i, {}, sock=None)
+
+    def _connect_members(self) -> None:
+        import socket as _socket
+
+        deadline = time.monotonic() + self.connect_timeout
+        for member in self._members.values():
+            card = None
+            while card is None:
+                card = ipc.read_member(self.rendezvous, member.id)
+                if card is None:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"serving member {member.id} did not publish "
+                            f"into {self.rendezvous!r} within "
+                            f"{self.connect_timeout:.0f}s "
+                            f"({CONNECT_TIMEOUT_ENV})"
+                        )
+                    if member.proc is not None and member.proc.poll() is not None:
+                        raise RuntimeError(
+                            f"serving member {member.id} exited with code "
+                            f"{member.proc.returncode} before publishing"
+                        )
+                    time.sleep(0.05)
+            member.card = card
+            sock = _socket.create_connection(
+                (card["host"], card["port"]),
+                timeout=max(1.0, deadline - time.monotonic()),
+            )
+            sock.settimeout(None)
+            member.sock = sock
+            member.recv_thread = threading.Thread(
+                target=self._recv_loop, args=(member,),
+                name=f"tpuml-router-recv-{member.id}", daemon=True,
+            )
+            member.recv_thread.start()
+            hello = self._request(member, {"t": "hello"})
+            member.mem_budget = int(hello.get("mem_budget") or 0)
+            member.queue_limit = int(hello.get("queue_limit") or 0)
+            gauge(
+                "serving.router.member.depth",
+                "per-member queue depth as last reported to the router",
+            ).set_function(
+                lambda m=member: m.last_depth,
+                router=self.router_id, member=str(member.id),
+            )
+            emit(
+                "serving", action="member_up", router=self.router_id,
+                member=member.id, pid=card.get("pid"),
+                mem_budget=member.mem_budget,
+            )
+
+    # --- wire plumbing --------------------------------------------------
+
+    def _register_pending(self, entry: dict) -> int:
+        with self._lock:
+            self._next_id += 1
+            mid = self._next_id
+            self._pending[mid] = entry
+            return mid
+
+    def _request(self, member: _Member, msg: dict,
+                 timeout: Optional[float] = None) -> dict:
+        """One synchronous request/reply round trip to ``member``."""
+        fut: Future = Future()
+        mid = self._register_pending(
+            {"kind": "control", "future": fut, "member": member.id}
+        )
+        msg["id"] = mid
+        member.send(msg)
+        reply = fut.result(
+            timeout=timeout if timeout is not None else self.connect_timeout
+        )
+        if not reply.get("ok"):
+            raise decode_error(reply["error"])
+        return reply
+
+    def _recv_loop(self, member: _Member) -> None:
+        while True:
+            try:
+                msg = ipc.recv_msg(member.sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                self._member_lost(member)
+                return
+            self._handle_reply(member, msg)
+
+    def _member_lost(self, member: _Member) -> None:
+        """EOF from a member: fail or re-route everything it owed."""
+        with self._lock:
+            if member.dead:
+                return
+            member.dead = True
+            orphans = [
+                (mid, e) for mid, e in self._pending.items()
+                if e.get("member") == member.id
+            ]
+            for mid, _ in orphans:
+                del self._pending[mid]
+        gauge("serving.router.member.depth", "").remove(
+            router=self.router_id, member=str(member.id)
+        )
+        if not self._closed:
+            emit(
+                "serving", action="member_down", router=self.router_id,
+                member=member.id, reason="connection lost",
+            )
+        for _, entry in orphans:
+            if entry.get("kind") == "submit":
+                # A died-mid-request member is a shed without a hint:
+                # retry elsewhere, surface only when nowhere is left.
+                self._redispatch(
+                    entry,
+                    RuntimeError(
+                        f"serving member {member.id} lost mid-request"
+                    ),
+                )
+            else:
+                entry["future"].set_exception(
+                    RuntimeError(f"serving member {member.id} connection lost")
+                )
+
+    def _handle_reply(self, member: _Member, msg: dict) -> None:
+        with self._lock:
+            entry = self._pending.pop(msg.get("id"), None)
+            if "depth" in msg:
+                member.last_depth = int(msg["depth"])
+        if entry is None:
+            return
+        if entry.get("kind") != "submit":
+            entry["future"].set_result(msg)
+            return
+        with self._lock:
+            member.outstanding -= 1
+        if msg.get("ok"):
+            with self._lock:
+                member.completed += 1
+            _routed_latency_hist().observe(
+                (time.monotonic() - entry["t0"]) * 1e3
+            )
+            fut = entry["future"]
+            if fut.set_running_or_notify_cancel():
+                fut.set_result(msg["result"])
+            return
+        exc = decode_error(msg["error"])
+        if isinstance(exc, Overloaded):
+            now = time.monotonic()
+            with self._lock:
+                member.shed += 1
+                if exc.retry_after_ms > 0:
+                    member.backoff_until = max(
+                        member.backoff_until, now + exc.retry_after_ms / 1e3
+                    )
+            bump_counter("serving.router.shed")
+            with trace_scope(entry["trace"]):
+                emit(
+                    "serving", action="route_shed", router=self.router_id,
+                    member=member.id, model=entry["name"],
+                    version=entry["version"], run_id=entry["run_id"],
+                    reason=exc.reason,
+                    retry_after_ms=round(exc.retry_after_ms, 3),
+                )
+            self._redispatch(entry, exc)
+            return
+        fut = entry["future"]
+        if fut.set_running_or_notify_cancel():
+            fut.set_exception(exc)
+
+    # --- member selection ----------------------------------------------
+
+    def _pick_member(self, tried: Set[int]) -> Optional[_Member]:
+        """Weighted least-loaded: router-local outstanding count plus the
+        member's last piggy-backed queue depth; shed members sit out
+        their advertised backoff window. Caller must NOT hold _lock."""
+        now = time.monotonic()
+        with self._lock:
+            candidates = [
+                m for m in self._members.values()
+                if not m.dead and m.id not in tried and m.backoff_until <= now
+            ]
+            if not candidates:
+                return None
+            best = min(
+                candidates, key=lambda m: (m.outstanding + m.last_depth, m.id)
+            )
+            best.outstanding += 1
+            best.routed += 1
+            return best
+
+    def _all_members_overloaded(self, name: str) -> Overloaded:
+        """The aggregate shed when no member can take a request: retry
+        after the SOONEST backoff window expires."""
+        now = time.monotonic()
+        with self._lock:
+            self._rejected += 1
+            alive = [m for m in self._members.values() if not m.dead]
+            hints = [
+                (m.backoff_until - now) * 1e3
+                for m in alive
+                if m.backoff_until > now
+            ]
+            depth = max((m.last_depth for m in alive), default=0)
+            limit = max((m.queue_limit for m in alive), default=0)
+        retry_ms = min(hints) if hints else DEFAULT_RETRY_AFTER_MS
+        bump_counter("serving.router.rejected")
+        emit(
+            "serving", action="route_shed", router=self.router_id,
+            member=None, model=name, reason="all-members",
+            retry_after_ms=round(retry_ms, 3),
+        )
+        return Overloaded(
+            "queue", name, queue_depth=depth, queue_limit=limit,
+            retry_after_ms=max(retry_ms, 0.0),
+        )
+
+    def _dispatch(self, entry: dict, member: _Member) -> None:
+        entry["member"] = member.id
+        mid = self._register_pending(entry)
+        frame = {
+            "t": "submit", "id": mid, "name": entry["name"],
+            "version": entry["version"], "x": entry["x"],
+            "timeout": entry["timeout"], "carrier": entry["carrier"],
+        }
+        try:
+            member.send(frame)
+        except OSError:
+            with self._lock:
+                self._pending.pop(mid, None)
+            self._member_lost(member)
+            raise
+
+    def _redispatch(self, entry: dict, last_exc: BaseException) -> None:
+        """Transparent retry on the next-best member after a shed or a
+        lost member; the caller only sees a failure when every member
+        has been tried or is backed off."""
+        entry["tried"].add(entry["member"])
+        while True:
+            member = self._pick_member(entry["tried"])
+            if member is None:
+                fut = entry["future"]
+                exc = (
+                    last_exc
+                    if isinstance(last_exc, Overloaded)
+                    else self._all_members_overloaded(entry["name"])
+                )
+                if fut.set_running_or_notify_cancel():
+                    fut.set_exception(exc)
+                return
+            with self._lock:
+                member.retries += 1
+            bump_counter("serving.router.retry")
+            try:
+                self._dispatch(entry, member)
+                return
+            except OSError:
+                entry["tried"].add(member.id)
+                continue
+
+    # --- the request path -----------------------------------------------
+
+    def submit(
+        self,
+        name: str,
+        x: Any,
+        *,
+        timeout: Optional[float] = None,
+        version: Optional[Any] = None,
+    ) -> Future:
+        """Route one request — same contract as
+        :meth:`ServingRuntime.submit`. Resolution to a CONCRETE version
+        happens here, once, against the router's registry mirror: the
+        member executes exactly ``(name, version)``, which is what makes
+        hot swaps version-atomic across the whole gang."""
+        if self._closed:
+            raise RuntimeError("serving router is closed")
+        mv = self.registry.resolve(name, version)
+        sig = mv.signature
+        xh = np.asarray(x)
+        if xh.ndim == 1:
+            xh = xh[None, :]
+        if xh.ndim != 2:
+            raise ValueError(f"serving input must be 1-D or 2-D, got {xh.ndim}-D")
+        if xh.shape[1] != sig.n_features:
+            raise ValueError(
+                f"model {mv.name!r} v{mv.version} expects {sig.n_features} "
+                f"features, got {xh.shape[1]}"
+            )
+        dtype = _compute_dtype(xh.dtype)
+        xh = np.ascontiguousarray(xh, dtype=dtype)
+        n = int(xh.shape[0])
+        run_id = new_run_id("route")
+        tc = current_trace_context()
+        if tc is None:
+            tc = begin_trace()
+        bump_counter("serving.router.requests")
+        bump_counter("serving.router.rows", n)
+
+        if self._is_oversized(mv, n, dtype):
+            return self._submit_sharded(mv, xh, run_id, tc)
+
+        member = self._pick_member(set())
+        if member is None:
+            raise self._all_members_overloaded(mv.name)
+        # The same env-var names PR 7's spawn carrier uses, as a per-
+        # request dict: the member rebuilds the TraceContext and the
+        # whole hop joins one trace.
+        with trace_scope(tc):
+            carrier = inject_env({})
+            emit(
+                "serving", action="route", router=self.router_id,
+                member=member.id, model=mv.name, version=mv.version,
+                rows=n, run_id=run_id,
+            )
+        entry = {
+            "kind": "submit",
+            "future": Future(),
+            "name": mv.name,
+            "version": mv.version,
+            "x": xh,
+            "timeout": timeout,
+            "carrier": carrier,
+            "tried": set(),
+            "member": member.id,
+            "run_id": run_id,
+            "trace": tc,
+            "t0": time.monotonic(),
+        }
+        try:
+            self._dispatch(entry, member)
+        except OSError:
+            # First-choice member died at send time: fall through the
+            # retry ladder before surfacing anything.
+            self._redispatch(entry, RuntimeError("member lost at dispatch"))
+        return entry["future"]
+
+    def submit_many(
+        self,
+        name: str,
+        xs: Iterable[Any],
+        *,
+        timeout: Optional[float] = None,
+        version: Optional[Any] = None,
+    ) -> List[Future]:
+        """One future per element; resolved ONCE up front so the set is
+        version-consistent even across a concurrent hot swap."""
+        mv = self.registry.resolve(name, version)
+        return [
+            self.submit(mv.name, x, timeout=timeout, version=mv.version)
+            for x in xs
+        ]
+
+    # --- oversized requests: the mesh-sharded path ----------------------
+
+    def _member_budget_floor(self) -> int:
+        with self._lock:
+            budgets = [
+                m.mem_budget for m in self._members.values()
+                if not m.dead and m.mem_budget > 0
+            ]
+        return min(budgets) if budgets else 0
+
+    def _is_oversized(self, mv: ModelVersion, n: int, dtype) -> bool:
+        if self.shard_rows and n >= self.shard_rows:
+            return True
+        floor = self._member_budget_floor()
+        if not floor:
+            return False
+        sig = mv.signature
+        bucket = bucket_rows(max(n, 1))
+        declared = bucket * sig.n_features * dtype.itemsize + spec_bytes(
+            sig.output_spec(bucket, dtype)
+        )
+        return declared > floor
+
+    def _global_mesh(self):
+        from spark_rapids_ml_tpu.parallel.distributed import global_mesh
+
+        with self._mesh_lock:
+            if self._mesh is None:
+                self._mesh = global_mesh()
+            return self._mesh
+
+    def _replicated_weights(self, mv: ModelVersion, mesh):
+        """Weights replicated onto the mesh ONCE per (name, version) —
+        oversized traffic must not re-upload per request."""
+        from spark_rapids_ml_tpu.robustness.checkpoint import (
+            replicate_state_onto_mesh,
+        )
+
+        with self._mesh_lock:
+            cached = self._replicated.get(mv.key)
+        if cached is not None:
+            return cached
+        placed = replicate_state_onto_mesh(mv.signature.weights, mesh)
+        with self._mesh_lock:
+            self._replicated.setdefault(mv.key, placed)
+            return self._replicated[mv.key]
+
+    def _submit_sharded(self, mv: ModelVersion, xh: np.ndarray,
+                        run_id: str, tc) -> Future:
+        with self._lock:
+            self._oversized += 1
+            if self._shard_pool is None:
+                self._shard_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="tpuml-router-shard"
+                )
+            pool = self._shard_pool
+        bump_counter("serving.router.oversized")
+        with trace_scope(tc):
+            emit(
+                "serving", action="route_oversized", router=self.router_id,
+                model=mv.name, version=mv.version, rows=int(xh.shape[0]),
+                run_id=run_id,
+            )
+
+        def run():
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from spark_rapids_ml_tpu.core.serving import serve_rows
+            from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+            with trace_scope(tc):
+                sig = mv.signature
+                mesh = self._global_mesh()
+                dp = int(mesh.shape[DATA_AXIS])
+                n = int(xh.shape[0])
+                padded = -(-n // dp) * dp
+                if padded != n:
+                    xp = np.zeros((padded, xh.shape[1]), dtype=xh.dtype)
+                    xp[:n] = xh
+                else:
+                    xp = xh
+                xs = jax.device_put(
+                    xp, NamedSharding(mesh, P(DATA_AXIS, None))
+                )
+                weights = self._replicated_weights(mv, mesh)
+                # Multi-device operands route serve_rows through the
+                # cached plain-jit sharded fallback (core/serving.py) —
+                # exactly the PR 2 path, program cache shared with every
+                # other sharded caller in this process.
+                outs = serve_rows(
+                    sig.kernel, xs, weights, static=sig.static, name=sig.name
+                )
+                sliced = jax.tree_util.tree_map(
+                    lambda leaf: np.asarray(leaf)[:n]
+                    if np.ndim(leaf) >= 1 and np.shape(leaf)[0] == padded
+                    else np.asarray(leaf),
+                    outs,
+                )
+                emit(
+                    "serving", action="complete", router=self.router_id,
+                    model=mv.name, version=mv.version, rows=n,
+                    run_id=run_id, path="mesh-sharded",
+                )
+                return sliced
+
+        t0 = time.monotonic()
+        fut = pool.submit(run)
+        fut.add_done_callback(
+            lambda f: _routed_latency_hist().observe(
+                (time.monotonic() - t0) * 1e3
+            )
+            if f.exception() is None
+            else None
+        )
+        return fut
+
+    # --- the replicated registry ----------------------------------------
+
+    def _broadcast_op(self, op: dict, timeout: Optional[float] = None) -> List[dict]:
+        """Send one op frame to every live member and gather the acks.
+        Caller holds _op_lock, so ops hit every member in one global
+        order — the determinism the version numbering relies on."""
+        with self._lock:
+            alive = [m for m in self._members.values() if not m.dead]
+        if not alive:
+            raise RuntimeError("serving router has no live members")
+        futs = []
+        for member in alive:
+            fut: Future = Future()
+            mid = self._register_pending(
+                {"kind": "control", "future": fut, "member": member.id}
+            )
+            frame = dict(op)
+            frame["t"] = "op"
+            frame["id"] = mid
+            member.send(frame)
+            futs.append((member, fut))
+        replies = []
+        budget = timeout if timeout is not None else self.connect_timeout
+        for member, fut in futs:
+            reply = fut.result(timeout=budget)
+            if not reply.get("ok"):
+                raise decode_error(reply["error"])
+            replies.append(reply)
+        return replies
+
+    def _next_lsn(self) -> int:
+        self._lsn += 1
+        return self._lsn
+
+    def register(
+        self,
+        name: str,
+        model: Any,
+        *,
+        alias: Optional[str] = None,
+        warm_buckets: Iterable[int] = (),
+        warm_dtype: Any = None,
+    ) -> ModelVersion:
+        """Replicate a registration to every member, then mirror it
+        locally. Every member's ack carries the version IT assigned;
+        divergence from the router's own monotonic assignment is a bug
+        worth crashing on, not routing around. With ``alias=`` the flip
+        follows the same warmed two-phase path as :meth:`set_alias`."""
+        blob = ipc.dumps_model(model)
+        warm_buckets = tuple(warm_buckets)
+        with self._op_lock:
+            lsn = self._next_lsn()
+            replies = self._broadcast_op(
+                {"op": "register", "lsn": lsn, "name": name, "model": blob}
+            )
+            mv = self.registry.register(name, model)
+            got = {int(r["version"]) for r in replies}
+            if got != {mv.version}:
+                raise RuntimeError(
+                    f"registry divergence for {name!r}: router assigned "
+                    f"v{mv.version}, members assigned {sorted(got)}"
+                )
+            emit(
+                "serving", action="replicate", router=self.router_id,
+                op="register", lsn=lsn, model=name, version=mv.version,
+                members=len(replies),
+            )
+            if warm_buckets:
+                self.warm(name, version=mv.version, buckets=warm_buckets,
+                          dtype=warm_dtype)
+            if alias is not None:
+                self.set_alias(name, alias, mv.version,
+                               warm_buckets=warm_buckets or (1,))
+        return mv
+
+    def set_alias(
+        self,
+        name: str,
+        alias: str,
+        version: int,
+        *,
+        warm_buckets: Iterable[int] = (1,),
+    ) -> None:
+        """The cross-member hot swap, two-phase: (1) warm the target
+        version on EVERY member so the first post-flip batch is
+        compile-free everywhere; (2) replicate the alias move, then flip
+        the ROUTER's alias last. Traffic resolves against the router's
+        registry, so the flip is one atomic alias move here — no member
+        ever sees a half-swapped gang, and nothing sheds over the swap."""
+        with self._op_lock:
+            if warm_buckets:
+                self.warm(name, version=version, buckets=warm_buckets)
+            lsn = self._next_lsn()
+            self._broadcast_op(
+                {"op": "set_alias", "lsn": lsn, "name": name,
+                 "alias": alias, "version": int(version)}
+            )
+            self.registry.set_alias(name, alias, int(version))
+            emit(
+                "serving", action="replicate", router=self.router_id,
+                op="set_alias", lsn=lsn, model=name, alias=alias,
+                version=int(version),
+            )
+
+    def warm(
+        self,
+        name: str,
+        *,
+        version: Optional[int] = None,
+        buckets: Iterable[int] = (),
+        dtype: Any = None,
+    ) -> int:
+        """Replicated warm-up; returns the max bucket count any member
+        compiled (they share the op, not the cache)."""
+        with self._op_lock:
+            lsn = self._next_lsn()
+            replies = self._broadcast_op(
+                {"op": "warm", "lsn": lsn, "name": name, "version": version,
+                 "buckets": tuple(buckets),
+                 "dtype": str(dtype) if dtype is not None else None}
+            )
+        return max((int(r.get("warmed", 0)) for r in replies), default=0)
+
+    def retire(self, name: str, version: int) -> None:
+        with self._op_lock:
+            lsn = self._next_lsn()
+            self._broadcast_op(
+                {"op": "retire", "lsn": lsn, "name": name,
+                 "version": int(version)}
+            )
+            self.registry.retire(name, int(version))
+            with self._mesh_lock:
+                self._replicated.pop((name, int(version)), None)
+            emit(
+                "serving", action="replicate", router=self.router_id,
+                op="retire", lsn=lsn, model=name, version=int(version),
+            )
+
+    # --- lifecycle ------------------------------------------------------
+
+    def member_status(self) -> List[dict]:
+        """One ``status`` round trip per live member (registry snapshot +
+        serving counters as THAT member sees them)."""
+        with self._lock:
+            alive = [m for m in self._members.values() if not m.dead]
+        return [self._request(m, {"t": "status"}) for m in alive]
+
+    def close(self, drain: bool = True) -> None:
+        """Shut the gang down. ``drain=True`` lets every member finish
+        its queue first. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            members = list(self._members.values())
+        for member in members:
+            if member.dead or member.sock is None:
+                continue
+            try:
+                self._request(member, {"t": "shutdown", "drain": drain})
+            except Exception:  # noqa: BLE001 - close must not raise per member
+                pass
+        for member in members:
+            if member.recv_thread is not None:
+                member.recv_thread.join(timeout=self.connect_timeout)
+            if member.sock is not None:
+                try:
+                    member.sock.close()
+                except OSError:
+                    pass
+            if member.proc is not None:
+                try:
+                    member.proc.wait(timeout=self.connect_timeout)
+                except subprocess.TimeoutExpired:
+                    member.proc.kill()
+                    member.proc.wait(timeout=10)
+            if not member.dead:
+                member.dead = True
+                gauge("serving.router.member.depth", "").remove(
+                    router=self.router_id, member=str(member.id)
+                )
+        if self._barrier_thread is not None:
+            self._barrier_thread.join(timeout=self.connect_timeout)
+            self._barrier_thread = None
+            if self._barrier_result and isinstance(
+                self._barrier_result[0], BaseException
+            ):
+                raise self._barrier_result[0]
+        if self._shard_pool is not None:
+            self._shard_pool.shutdown(wait=True)
+            self._shard_pool = None
+        with self._lock:
+            leftovers = list(self._pending.values())
+            self._pending.clear()
+        for entry in leftovers:
+            fut = entry["future"]
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(
+                    RuntimeError("serving router closed before reply")
+                )
+        emit("serving", action="close", router=self.router_id, drain=drain)
+
+    def __enter__(self) -> "RoutingRuntime":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(drain=exc_type is None)
+
+    # --- introspection --------------------------------------------------
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            members = [
+                {
+                    "member": m.id,
+                    "pid": m.card.get("pid"),
+                    "dead": m.dead,
+                    "depth": m.last_depth,
+                    "outstanding": m.outstanding,
+                    "backoff_remaining_ms": round(
+                        max(0.0, (m.backoff_until - now) * 1e3), 3
+                    ),
+                    "routed": m.routed,
+                    "completed": m.completed,
+                    "shed": m.shed,
+                    "retries": m.retries,
+                    "mem_budget": m.mem_budget,
+                }
+                for m in self._members.values()
+            ]
+            rejected, oversized = self._rejected, self._oversized
+        return {
+            "router": self.router_id,
+            "closed": self._closed,
+            "launch": self.launch,
+            "workers": self.workers,
+            "rendezvous": self.rendezvous,
+            "rejected": rejected,
+            "oversized": oversized,
+            "members": members,
+            "models": self.registry.snapshot(),
+        }

@@ -169,6 +169,11 @@ class ServingRuntime:
             self._batcher.start()
         self._batcher.stop(drain=drain)
         self._queue.close()
+        # Retire this runtime's callable gauges (mirrors GangHeartbeat.
+        # stop()): a drained gang member must leave no stale depth/
+        # inflight series in the merged snapshot.
+        gauge("serving.queue.depth", "").remove(runtime=self.runtime_id)
+        gauge("serving.inflight", "").remove(runtime=self.runtime_id)
         emit("serving", action="close", runtime=self.runtime_id, drain=drain)
 
     def __enter__(self) -> "ServingRuntime":

@@ -1,0 +1,372 @@
+"""One serving-tier member: a socket front end over a ServingRuntime.
+
+The worker process the router (``serving/router.py``) fans micro-batches
+out to. Each member owns a full in-process :class:`ServingRuntime` —
+admission queue, micro-batcher, AOT program cache — so PR 8's measured
+admission prices every member against ITS OWN ledgered bytes, and a shed
+is a per-member signal the router can route around.
+
+Lifecycle: bind a loopback socket, publish a ``member-<id>.json`` contact
+card into the rendezvous directory (``serving/ipc.py``), accept the ONE
+router connection, then serve frames until a ``shutdown`` frame (or EOF —
+a vanished router drains and exits rather than leaking a process).
+Registry mutations arrive as an lsn-ordered op log and apply on a
+dedicated thread in that order, so a multi-second ``warm`` never stalls
+the request path; ``ModelRegistry.register`` assigns versions
+monotonically per name, so identical op-log order yields identical
+version numbers on every member — the replication invariant the router's
+two-phase alias flip builds on.
+
+Every reply piggy-backs the member's live queue depth — the router's
+weighted least-loaded pick reads it for free, no status polling on the
+hot path. Requests carry the PR 7 trace carrier, so a member's enqueue/
+dispatch/complete events join the router's per-request trace in the
+merged telemetry view. On exit the runtime closes (retiring its
+``serving.queue.depth``/``serving.inflight`` gauges), the heartbeat
+stops (retiring its age gauge), and the telemetry shard flushes — a
+drained gang leaves no stale gauges behind.
+
+Spawn-mode entry: ``python -m spark_rapids_ml_tpu.serving.worker`` with
+``TPUML_ROUTER_RENDEZVOUS`` + ``TPUML_ROUTER_MEMBER`` in the
+environment. Barrier-mode: ``spark.barrier.serving_gang_run`` runs
+:func:`serve_member` as the gang task body.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+import traceback
+from typing import Any, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.observability import events as _ev
+from spark_rapids_ml_tpu.observability.heartbeat import heartbeat_scope
+from spark_rapids_ml_tpu.serving import ipc
+from spark_rapids_ml_tpu.serving.admission import DeadlineExceeded, Overloaded
+from spark_rapids_ml_tpu.serving.server import ServingRuntime
+from spark_rapids_ml_tpu.utils.envknobs import env_float, env_int, env_str
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
+from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+RENDEZVOUS_ENV = "TPUML_ROUTER_RENDEZVOUS"
+MEMBER_ENV = "TPUML_ROUTER_MEMBER"
+CONNECT_TIMEOUT_ENV = "TPUML_ROUTER_CONNECT_TIMEOUT"
+
+DEFAULT_CONNECT_TIMEOUT_S = 120.0
+
+
+def encode_error(exc: BaseException) -> dict:
+    """A structured wire form of the serving exceptions the router must
+    reconstruct faithfully (the backpressure signal rides in the fields)."""
+    if isinstance(exc, Overloaded):
+        return {
+            "kind": "overloaded",
+            "reason": exc.reason,
+            "model": exc.model,
+            "queue_depth": exc.queue_depth,
+            "queue_limit": exc.queue_limit,
+            "reserved_bytes": exc.reserved_bytes,
+            "request_bytes": exc.request_bytes,
+            "mem_budget": exc.mem_budget,
+            "retry_after_ms": exc.retry_after_ms,
+        }
+    if isinstance(exc, DeadlineExceeded):
+        return {
+            "kind": "deadline",
+            "model": exc.model,
+            "waited_ms": exc.waited_ms,
+            "deadline_ms": exc.deadline_ms,
+        }
+    return {
+        "kind": "error",
+        "exc": type(exc).__name__,
+        "msg": str(exc),
+        "trace": traceback.format_exc(limit=8),
+    }
+
+
+def decode_error(err: dict) -> BaseException:
+    """The router-side inverse of :func:`encode_error`."""
+    if err["kind"] == "overloaded":
+        extra = (
+            dict(
+                reserved_bytes=err["reserved_bytes"],
+                request_bytes=err["request_bytes"],
+                mem_budget=err["mem_budget"],
+            )
+            if err["reason"] == "memory"
+            else {}
+        )
+        return Overloaded(
+            err["reason"], err["model"],
+            queue_depth=err["queue_depth"], queue_limit=err["queue_limit"],
+            retry_after_ms=err["retry_after_ms"], **extra,
+        )
+    if err["kind"] == "deadline":
+        return DeadlineExceeded(err["model"], err["waited_ms"],
+                                err["deadline_ms"])
+    return RuntimeError(f"worker {err.get('exc')}: {err.get('msg')}")
+
+
+def _to_host(tree: Any) -> Any:
+    """Result pytrees cross the wire as numpy — device buffers don't."""
+    import jax
+
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class ServingWorker:
+    """The frame loop over one member's :class:`ServingRuntime`."""
+
+    def __init__(self, member: int, runtime: ServingRuntime):
+        self.member = int(member)
+        self.runtime = runtime
+        self.drain = True  # shutdown mode the router requested
+        self.served = 0
+        self._send_lock = make_lock("serving.worker.send")
+        self._conn: Optional[socket.socket] = None
+        # Registry ops apply on their own thread IN ARRIVAL (= lsn)
+        # order: a slow warm must not stall the submit path, but two ops
+        # must never reorder — version determinism depends on it.
+        self._ops: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._op_thread: Optional[threading.Thread] = None
+
+    # --- wire helpers ---
+
+    def _reply(self, msg_id: Any, payload: dict) -> None:
+        payload["id"] = msg_id
+        payload["depth"] = self.runtime.queue_depth()
+        conn = self._conn
+        if conn is None:  # connection already torn down
+            return
+        with self._send_lock:
+            try:
+                ipc.send_msg(conn, payload)
+            except OSError:  # router gone; the recv loop will see EOF
+                pass
+
+    # --- the op log ---
+
+    def _apply_op(self, msg: dict) -> dict:
+        op = msg["op"]
+        rt = self.runtime
+        if op == "register":
+            model = ipc.loads_model(msg["model"])
+            mv = rt.register(msg["name"], model)
+            return {"ok": True, "version": mv.version}
+        if op == "warm":
+            warmed = rt.warm(
+                msg["name"], version=msg.get("version"),
+                buckets=msg.get("buckets") or (),
+                dtype=msg.get("dtype"),
+            )
+            return {"ok": True, "warmed": warmed}
+        if op == "set_alias":
+            rt.set_alias(msg["name"], msg["alias"], msg["version"])
+            return {"ok": True}
+        if op == "retire":
+            rt.retire(msg["name"], msg["version"])
+            return {"ok": True}
+        raise ValueError(f"unknown registry op {op!r}")
+
+    def _op_loop(self) -> None:
+        while True:
+            msg = self._ops.get()
+            if msg is None:
+                return
+            try:
+                out = self._apply_op(msg)
+            except BaseException as exc:  # noqa: BLE001 - reply, don't die
+                out = {"ok": False, "error": encode_error(exc)}
+            out["lsn"] = msg.get("lsn")
+            bump_counter("serving.worker.ops")
+            _ev.emit(
+                "serving", action="replicate", member=self.member,
+                op=msg["op"], lsn=msg.get("lsn"), model=msg.get("name"),
+                ok=out["ok"],
+            )
+            self._reply(msg.get("id"), out)
+
+    # --- the request path ---
+
+    def _handle_submit(self, msg: dict) -> None:
+        carrier = msg.get("carrier") or {}
+        tc = None
+        trace_id = carrier.get(_ev.TRACE_ID_ENV)
+        if trace_id:
+            tc = _ev.TraceContext(trace_id, carrier.get(_ev.TRACE_PARENT_ENV))
+        msg_id = msg["id"]
+        try:
+            with _ev.trace_scope(tc):
+                fut = self.runtime.submit(
+                    msg["name"], msg["x"],
+                    timeout=msg.get("timeout"), version=msg.get("version"),
+                )
+        except BaseException as exc:  # noqa: BLE001 - Overloaded et al.
+            self._reply(msg_id, {"ok": False, "error": encode_error(exc)})
+            return
+
+        def _done(f):
+            try:
+                result = _to_host(f.result())
+            except BaseException as exc:  # noqa: BLE001 - per-request
+                self._reply(msg_id, {"ok": False, "error": encode_error(exc)})
+                return
+            self.served += 1
+            self._reply(msg_id, {"ok": True, "result": result})
+
+        fut.add_done_callback(_done)
+
+    def _status(self) -> dict:
+        from spark_rapids_ml_tpu.utils.tracing import counter_value
+
+        return {
+            "ok": True,
+            "member": self.member,
+            "snapshot": self.runtime.snapshot(),
+            "counters": {
+                name: counter_value(name)
+                for name in (
+                    "serving.requests", "serving.batch.dispatch",
+                    "serving.shed.queue", "serving.shed.memory",
+                    "serving.deadline.expired", "serving.worker.ops",
+                )
+            },
+        }
+
+    # --- the frame loop ---
+
+    def serve(self, conn: socket.socket) -> None:
+        """Serve one router connection until shutdown or EOF."""
+        self._conn = conn
+        self._op_thread = threading.Thread(
+            target=self._op_loop, name=f"tpuml-member-{self.member}-ops",
+            daemon=True,
+        )
+        self._op_thread.start()
+        try:
+            while True:
+                msg = ipc.recv_msg(conn)
+                if msg is None:  # router vanished: drain and exit
+                    break
+                t = msg.get("t")
+                if t == "submit":
+                    self._handle_submit(msg)
+                elif t == "op":
+                    self._ops.put(msg)
+                elif t == "hello":
+                    self._reply(msg.get("id"), {
+                        "ok": True,
+                        "member": self.member,
+                        "pid": os.getpid(),
+                        "mem_budget": self.runtime.mem_budget,
+                        "queue_limit": self.runtime.queue_limit,
+                    })
+                elif t == "status":
+                    self._reply(msg.get("id"), self._status())
+                elif t == "shutdown":
+                    self.drain = bool(msg.get("drain", True))
+                    # Ack AFTER the op log quiesces so a shutdown that
+                    # raced a replication op still leaves every member
+                    # with the full log applied.
+                    self._ops.put(None)
+                    self._op_thread.join(timeout=60.0)
+                    self._op_thread = None
+                    self._reply(msg.get("id"), {"ok": True})
+                    return
+                else:
+                    self._reply(msg.get("id"), {
+                        "ok": False,
+                        "error": {"kind": "error", "exc": "ValueError",
+                                  "msg": f"unknown frame type {t!r}"},
+                    })
+        finally:
+            if self._op_thread is not None:
+                self._ops.put(None)
+                self._op_thread.join(timeout=60.0)
+                self._op_thread = None
+            self._conn = None
+
+
+def serve_member(
+    member: int,
+    rendezvous: str,
+    *,
+    runtime: Optional[ServingRuntime] = None,
+    accept_timeout: Optional[float] = None,
+) -> dict:
+    """One member's whole lifecycle: publish, accept, serve, tear down.
+
+    Returns a small summary dict (the barrier task's collected output).
+    An orphaned member — no router connection within the accept timeout —
+    exits cleanly instead of parking a process forever.
+    """
+    if not _ev.enabled():
+        _ev.configure()
+    timeout = (
+        accept_timeout
+        if accept_timeout is not None
+        else env_float(CONNECT_TIMEOUT_ENV, DEFAULT_CONNECT_TIMEOUT_S,
+                       minimum=1.0)
+    )
+    rt = runtime if runtime is not None else ServingRuntime()
+    worker = ServingWorker(member, rt)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        srv.settimeout(timeout)
+        port = srv.getsockname()[1]
+        ipc.publish_member(rendezvous, member, "127.0.0.1", port)
+        _ev.emit("serving", action="member_up", member=member, port=port,
+                 mem_budget=rt.mem_budget)
+        with heartbeat_scope(member, what="serving"):
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                raise TimeoutError(
+                    f"serving member {member} saw no router connection in "
+                    f"{timeout:.0f}s ({CONNECT_TIMEOUT_ENV})"
+                ) from None
+            try:
+                worker.serve(conn)
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+    finally:
+        try:
+            srv.close()
+        except OSError:
+            pass
+        # The drained-gang contract: close retires the runtime's callable
+        # gauges, the heartbeat scope above retired its age gauge, and
+        # the shard flush publishes this member's manifest + metrics.
+        rt.close(drain=worker.drain)
+        _ev.emit("serving", action="member_down", member=member,
+                 drain=worker.drain, served=worker.served)
+        _ev.flush_telemetry()
+    return {"member": int(member), "served": worker.served,
+            "drain": worker.drain}
+
+
+def main() -> int:
+    """Spawn-mode entry (``python -m spark_rapids_ml_tpu.serving.worker``)."""
+    rendezvous = env_str(RENDEZVOUS_ENV)
+    member = env_int(MEMBER_ENV)
+    if not rendezvous or member is None:
+        raise SystemExit(
+            f"{RENDEZVOUS_ENV} and {MEMBER_ENV} must be set for a spawned "
+            "serving member"
+        )
+    serve_member(member, rendezvous)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

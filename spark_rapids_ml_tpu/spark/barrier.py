@@ -228,3 +228,39 @@ def gang_coordinates(ctx, port: int = DEFAULT_COORDINATOR_PORT) -> dict:
         "num_processes": len(infos),
         "process_id": int(ctx.partitionId()),
     }
+
+
+def serving_gang_run(
+    rdd,
+    rendezvous: str,
+    policy: Optional[RetryPolicy] = None,
+) -> list:
+    """Run serving-tier members as ONE barrier stage: each partition's
+    task body is :func:`serving.worker.serve_member` — publish a contact
+    card into ``rendezvous``, accept the router connection, serve until
+    shutdown. Partition elements are member ids (ints); an empty
+    partition falls back to its partition id, so the common
+    ``parallelize(range(n), n)`` roster works with either convention.
+
+    Blocks until the whole gang drains (the router's ``close``), so the
+    router runs it on a background thread. All of
+    :func:`barrier_gang_run`'s machinery — launch barrier, whole-stage
+    relaunch, per-member heartbeats, the trace/telemetry carrier —
+    applies unchanged; the PR 7 carrier is what merges every member's
+    serving events into the router's trace. NOTE: the contract stub runs
+    barrier tasks sequentially on the driver, so only a single-member
+    gang is testable under the stub — a real cluster schedules members
+    concurrently.
+    """
+    from spark_rapids_ml_tpu.serving.worker import serve_member
+
+    def task(ctx, it):
+        members = sorted(int(i) for i in it)
+        if not members:
+            try:
+                members = [int(ctx.partitionId())] if ctx is not None else [0]
+            except Exception:
+                members = [0]
+        return [serve_member(m, rendezvous) for m in members]
+
+    return barrier_gang_run(rdd, task, policy=policy)

@@ -180,6 +180,22 @@ KNOBS: Dict[str, Knob] = _knob_table(
     Knob("TPUML_SERVE_MEM_BUDGET", "int", "serving-runtime",
          "device-memory admission budget in bytes (0 = gate off)",
          default=0),
+    # distributed serving tier (serving/router.py + serving/worker.py)
+    Knob("TPUML_ROUTER_WORKERS", "int", "serving-router",
+         "member processes a RoutingRuntime launches", default=2),
+    Knob("TPUML_ROUTER_RENDEZVOUS", "str", "serving-router",
+         "rendezvous directory of member-<id>.json contact cards "
+         "(set by the router for spawned members)", default=None),
+    Knob("TPUML_ROUTER_MEMBER", "int", "serving-router",
+         "this process's member index in the serving gang "
+         "(set by the router for spawned members)", default=None),
+    Knob("TPUML_ROUTER_CONNECT_TIMEOUT", "float", "serving-router",
+         "seconds the router waits for member rendezvous/acks and a "
+         "member waits for the router connection", default=120.0),
+    Knob("TPUML_ROUTER_SHARD_ROWS", "int", "serving-router",
+         "requests with at least this many rows bypass members for the "
+         "router's mesh-sharded path (0 = budget-driven only)",
+         default=0),
     # concurrency sanitizer (utils/lockcheck.py)
     Knob("TPUML_LOCKCHECK", "choice", "lockcheck",
          "off: plain threading primitives; warn: instrumented locks "

@@ -12,11 +12,21 @@ serving-latency posture: each worker's next arrival waits for its last
 answer, so queueing delay shows up in the numbers instead of in an
 unbounded backlog.
 
+``--workers N`` drives the DISTRIBUTED serving tier instead: a
+:class:`RoutingRuntime` spreading the same closed loop across N worker
+member processes (serving/router.py). The summary then adds a per-member
+section (rows/s, shed count, routed/completed as the router saw them)
+and reads the latency percentiles from the MERGED per-process metric
+shards (each member flushes ``metrics-<pid>.json`` into the telemetry
+dir on drain), so p50/p95/p99 cover every member's histogram, not just
+the router process's.
+
 Examples::
 
     python tools/tpuml_loadgen.py --family kmeans --threads 16 --requests 200
     python tools/tpuml_loadgen.py --family logreg --rows 4 --max-batch 128 \
         --delay-ms 2 --json
+    python tools/tpuml_loadgen.py --workers 4 --threads 16 --requests 100
 """
 
 from __future__ import annotations
@@ -71,6 +81,26 @@ from spark_rapids_ml_tpu.observability.metrics import (  # noqa: E402,F401
 )
 
 
+def _merged_member_metrics(telemetry_dir):
+    """The gang's ``serving.request.latency_ms`` histogram and summed
+    counters, merged across every member's flushed metric shard
+    (``observability.trace.assemble`` does the bucket-wise merge; JSON
+    round-trips bucket edges as strings, so they are floated back)."""
+    from spark_rapids_ml_tpu.observability.trace import assemble
+
+    merged = assemble(telemetry_dir)["metrics"]["merged"]
+    hist = {"buckets": {}, "sum": 0.0, "count": 0}
+    for cell in merged.get("histograms", {}).get(
+        "serving.request.latency_ms", {}
+    ).values():
+        for le, cum in cell.get("buckets", {}).items():
+            le = float(le)
+            hist["buckets"][le] = hist["buckets"].get(le, 0) + cum
+        hist["sum"] += cell.get("sum", 0.0)
+        hist["count"] += cell.get("count", 0)
+    return hist, merged.get("counters", {})
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--family", default="kmeans",
@@ -92,6 +122,9 @@ def main() -> None:
                         help="per-request deadline in seconds")
     parser.add_argument("--warm", action="store_true",
                         help="pre-compile the expected row buckets before timing")
+    parser.add_argument("--workers", type=int, default=0,
+                        help="serving member processes (0 = one in-process "
+                             "runtime; N >= 1 drives a RoutingRuntime)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--json", action="store_true",
                         help="machine-readable one-line summary only")
@@ -111,16 +144,41 @@ def main() -> None:
     rng = np.random.default_rng(args.seed + 1)
     probes = rng.normal(size=(args.threads, args.requests, args.rows, args.features))
 
-    rt = ServingRuntime(
-        max_batch=args.max_batch,
-        max_delay_ms=args.delay_ms,
-        queue_limit=args.queue,
-        mem_budget=args.mem_budget,
-    )
+    telemetry_dir = None
+    if args.workers >= 1:
+        from spark_rapids_ml_tpu.observability import events as _ev
+        from spark_rapids_ml_tpu.serving.batcher import DEFAULT_MAX_BATCH
+        from spark_rapids_ml_tpu.serving.router import RoutingRuntime
+
+        # Member latency histograms live in the WORKER processes; a
+        # telemetry dir is what brings them home as metric shards.
+        telemetry_dir = _ev.telemetry_dir()
+        if telemetry_dir is None:
+            import tempfile
+
+            telemetry_dir = tempfile.mkdtemp(prefix="tpuml-loadgen-")
+            os.environ["TPUML_TELEMETRY_DIR"] = telemetry_dir
+            _ev.configure()
+        rt = RoutingRuntime(
+            workers=args.workers,
+            max_batch=args.max_batch,
+            max_delay_ms=args.delay_ms,
+            queue_limit=args.queue,
+            mem_budget=args.mem_budget,
+        )
+        max_batch = args.max_batch or DEFAULT_MAX_BATCH
+    else:
+        rt = ServingRuntime(
+            max_batch=args.max_batch,
+            max_delay_ms=args.delay_ms,
+            queue_limit=args.queue,
+            mem_budget=args.mem_budget,
+        )
+        max_batch = rt.max_batch
     rt.register(args.family, model)
     if args.warm:
         # Every bucket the run can hit: rows per request up to a full batch.
-        rt.warm(args.family, buckets=(args.rows, rt.max_batch))
+        rt.warm(args.family, buckets=(args.rows, max_batch))
 
     errors = {"overloaded": 0, "deadline": 0, "other": 0}
     ok = [0] * args.threads
@@ -158,12 +216,23 @@ def main() -> None:
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
-    rt.close()
+    router_snapshot = rt.snapshot() if args.workers >= 1 else None
+    rt.close()  # members drain and flush their metric shards
 
     completed = sum(ok)
     rows_done = completed * args.rows
-    hist = _latency_hist().value()
-    dispatches = counter_value("serving.batch.dispatch") - c_dispatch0
+    if args.workers >= 1:
+        hist, merged_counters = _merged_member_metrics(telemetry_dir)
+        dispatches = merged_counters.get("serving.batch.dispatch", 0)
+        shed_queue = merged_counters.get("serving.shed.queue", 0)
+        shed_memory = merged_counters.get("serving.shed.memory", 0)
+        deadline_expired = merged_counters.get("serving.deadline.expired", 0)
+    else:
+        hist = _latency_hist().value()
+        dispatches = counter_value("serving.batch.dispatch") - c_dispatch0
+        shed_queue = counter_value("serving.shed.queue")
+        shed_memory = counter_value("serving.shed.memory")
+        deadline_expired = counter_value("serving.deadline.expired")
     summary = {
         "family": args.family,
         "threads": args.threads,
@@ -177,11 +246,29 @@ def main() -> None:
         "p99_ms": round(percentile_from_histogram(hist, 0.99), 3),
         "batches": dispatches,
         "mean_batch_requests": round(completed / dispatches, 2) if dispatches else 0,
-        "shed_queue": counter_value("serving.shed.queue"),
-        "shed_memory": counter_value("serving.shed.memory"),
-        "deadline_expired": counter_value("serving.deadline.expired"),
+        "shed_queue": shed_queue,
+        "shed_memory": shed_memory,
+        "deadline_expired": deadline_expired,
         "errors": errors,
     }
+    if router_snapshot is not None:
+        summary["workers"] = args.workers
+        summary["router_shed"] = counter_value("serving.router.shed")
+        summary["router_retries"] = counter_value("serving.router.retry")
+        summary["router_rejected"] = counter_value("serving.router.rejected")
+        summary["router_oversized"] = counter_value("serving.router.oversized")
+        summary["per_member"] = [
+            {
+                "member": m["member"],
+                "completed": m["completed"],
+                "rows_per_s": round(m["completed"] * args.rows / wall, 1)
+                if wall > 0
+                else 0.0,
+                "shed": m["shed"],
+                "routed": m["routed"],
+            }
+            for m in router_snapshot["members"]
+        ]
     if args.json:
         print(json.dumps(summary))
         return
@@ -195,6 +282,16 @@ def main() -> None:
     print(f"  shed:        queue={summary['shed_queue']} "
           f"memory={summary['shed_memory']} "
           f"deadline={summary['deadline_expired']}")
+    if router_snapshot is not None:
+        print(f"  router:      {args.workers} workers, "
+              f"shed={summary['router_shed']} "
+              f"retries={summary['router_retries']} "
+              f"rejected={summary['router_rejected']} "
+              f"oversized={summary['router_oversized']}")
+        for m in summary["per_member"]:
+            print(f"    member {m['member']}: rows/s={m['rows_per_s']} "
+                  f"completed={m['completed']} routed={m['routed']} "
+                  f"shed={m['shed']}")
     if any(errors.values()):
         print(f"  errors:      {errors}")
 
