@@ -1,0 +1,162 @@
+"""Config 19: the ledger-driven autotuner's closed loop, end to end.
+
+Two measured claims (ISSUE 14), one JSON line:
+
+1. **Tuned block rows beat the static default.** A bulk-scoring stream
+   over a host matrix is measured through ``measure_and_commit`` at the
+   static ``fit_block_rows`` default and at smaller candidates. The
+   pow-2 bucketing makes the winner a matter of arithmetic, not luck:
+   40k rows through the 65,536-row default is ONE 65,536-row bucket
+   (64% padded rows), while pow-2-aligned 8,192-row blocks compute
+   40,960 rows — 1.6x less padded compute. The incumbent's
+   metric is ledgered wall per row; commit-or-revert guarantees the
+   committed decision is never worse than the measured default, and
+   ``fit_block_rows()`` then returns the committed value.
+
+2. **The learned ladder cuts padded rows on skewed traffic.** A steady
+   stream of 37-row requests pads to the 64-row pow-2 bucket until the
+   traffic histogram proves the size hot; then the ladder admits an
+   exact 37-row rung and the remaining requests pad nothing. Both
+   padded-row counts come from the ledger (rows × invocations per
+   program), so the claim is deterministic.
+
+The tune store lands at ``TPUML_TUNE_STORE`` (CI uploads it as an
+artifact); ``tools/tpuml_prof.py tune <store>`` renders the decisions.
+``benchmarks/cost_ledger_scenario.py`` runs with the tuner OFF, so
+``cost_baseline.json`` is unaffected by this config.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Before any package import: the tuner configures itself from the
+# environment at import time.
+os.environ.setdefault("TPUML_AUTOTUNE", "on")
+os.environ.setdefault("TPUML_AUTOTUNE_HOT_MIN", "6")
+os.environ.setdefault(
+    "TPUML_TUNE_STORE", os.path.join(tempfile.gettempdir(), "tpuml-tune.json")
+)
+
+from benchmarks.common import emit
+from spark_rapids_ml_tpu.utils.envknobs import env_int
+
+# 40k rows through the 65,536-row default = ONE 65,536-row bucket
+# (64% padded rows); a pow-2-aligned 8,192-row block computes 40,960.
+# Wide enough (d=128, k=64) that the padded compute dominates per-call
+# dispatch overhead, so the arithmetic shows up in measured wall.
+ROWS = env_int("TPUML_BENCH_ROWS", 40_000)
+D = env_int("TPUML_BENCH_COLS", 128)
+K = env_int("TPUML_BENCH_K", 64)
+
+BLOCK_FAMILY = "bench.block.score"
+LADDER_FAMILY = "bench.ladder.score"
+LADDER_N = 37          # hot exact size; pow-2-only would pad to 64
+LADDER_REQUESTS = 30
+TRIAL_REPEATS = 3
+
+
+def main() -> None:
+    import numpy as np
+
+    from spark_rapids_ml_tpu.core.data import DEFAULT_FIT_BLOCK_ROWS, fit_block_rows
+    from spark_rapids_ml_tpu.core.serving import serve_rows, serve_stream
+    from spark_rapids_ml_tpu.observability import autotune, costs
+    from spark_rapids_ml_tpu.utils.tracing import counter_value
+
+    from spark_rapids_ml_tpu.utils.envknobs import env_str
+
+    # A fresh store per run: this benchmark measures the search itself,
+    # not a warm start from a previous run's decisions.
+    store_path = env_str("TPUML_TUNE_STORE", "")
+    if os.path.exists(store_path):
+        os.remove(store_path)
+    autotune.reset_for_tests()
+    tuner = autotune.active()
+    assert tuner is not None, "TPUML_AUTOTUNE=on did not arm the tuner"
+    assert costs.active() is not None, "the tuner must arm the cost ledger"
+
+    rng = np.random.default_rng(19)
+    import jax.numpy as jnp
+
+    # --- claim 1: measure-and-commit finds better block rows ----------
+    x = rng.normal(size=(ROWS, D)).astype(np.float32)
+    w = jnp.asarray(rng.normal(size=(D, K)).astype(np.float32))
+
+    def score_at(block: int) -> None:
+        blocks = (x[i:i + block] for i in range(0, ROWS, block))
+        for _ in serve_stream(
+            lambda b, ww: b @ ww, blocks, (w,), name=BLOCK_FAMILY
+        ):
+            pass
+
+    candidates = [DEFAULT_FIT_BLOCK_ROWS, 16384, 8192]
+    metrics: dict[int, float] = {}
+    for block in candidates:
+        score_at(block)  # compile the buckets outside the measured trial
+        _, metric, _ = tuner.measure_and_commit(
+            "fit_block_rows", BLOCK_FAMILY, block,
+            lambda: [score_at(block) for _ in range(TRIAL_REPEATS)],
+            rows=TRIAL_REPEATS * ROWS,
+        )
+        metrics[block] = metric
+
+    decision = tuner.store.get("fit_block_rows", BLOCK_FAMILY)
+    assert decision is not None, "no committed block-rows decision"
+    tuned_block = int(decision["value"])
+    # Commit-or-revert invariant: the incumbent beat (or is) every
+    # measured candidate, the static default included.
+    assert decision["metric"] == min(metrics.values())
+    assert decision["metric"] <= metrics[DEFAULT_FIT_BLOCK_ROWS]
+    assert decision["evidence"], "ledgered evidence must back the decision"
+    assert fit_block_rows(BLOCK_FAMILY) == tuned_block, (
+        "fit_block_rows must return the committed decision"
+    )
+    block_speedup = metrics[DEFAULT_FIT_BLOCK_ROWS] / decision["metric"]
+
+    # --- claim 2: the learned ladder cuts padded rows -----------------
+    wl = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    probe = rng.normal(size=(LADDER_N, 32)).astype(np.float32)
+    base = costs.active().invocation_snapshot()
+    for _ in range(LADDER_REQUESTS):
+        serve_rows(lambda b, ww: b @ ww, probe, (wl,), name=LADDER_FAMILY)
+    assert counter_value("autotune.ladder.grow") >= 1, "ladder never grew"
+
+    inv = {}  # bucket rows -> invocations of this family since `base`
+    for e in costs.active().entries():
+        if e.family == LADDER_FAMILY and e.rows:
+            d = e.invocations - base.get(e.key, (0, 0.0, 0))[0]
+            if d > 0:
+                inv[e.rows] = inv.get(e.rows, 0) + d
+    pad_static = LADDER_REQUESTS * (64 - LADDER_N)
+    pad_with_ladder = inv.get(64, 0) * (64 - LADDER_N)
+    assert inv.get(LADDER_N, 0) > 0, "no request ran in the exact bucket"
+    assert pad_with_ladder < pad_static, "the ladder cut no padding"
+    pad_cut = 1.0 - pad_with_ladder / pad_static
+
+    ladder_dec = tuner.store.get("serving_ladder", f"{LADDER_FAMILY}|32")
+    assert ladder_dec is not None and LADDER_N in ladder_dec["value"]
+    assert os.path.exists(store_path), "tune store never persisted"
+
+    emit(
+        f"autotune_closed_loop_{ROWS}x{D}",
+        block_speedup,
+        "x vs static block rows",
+        tuned_block_rows=tuned_block,
+        default_block_rows=DEFAULT_FIT_BLOCK_ROWS,
+        default_s_per_row=float(f"{metrics[DEFAULT_FIT_BLOCK_ROWS]:.3e}"),
+        tuned_s_per_row=float(f"{decision['metric']:.3e}"),
+        ladder_admitted=LADDER_N,
+        pad_rows_static=pad_static,
+        pad_rows_with_ladder=pad_with_ladder,
+        pad_rows_cut=round(pad_cut, 3),
+        tune_store=store_path,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,7 @@ CONFIGS = [
     ("config16_server.py", {}),
     ("config17_kmeans_packed.py", {}),
     ("config18_router.py", {}),
+    ("config19_autotune.py", {}),
 ]
 
 

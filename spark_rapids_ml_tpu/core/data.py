@@ -39,11 +39,37 @@ DEFAULT_FIT_BLOCK_ROWS = 65536
 FIT_BLOCK_ROWS_ENV = "TPUML_FIT_BLOCK_ROWS"
 
 
-def fit_block_rows() -> int:
+def fit_block_rows(
+    family: Optional[str] = None,
+    *,
+    width: Optional[int] = None,
+    itemsize: int = 4,
+) -> int:
     """Rows per block for the fit-path block readers (``TPUML_FIT_BLOCK_ROWS``):
     the block size auto-degraded streaming fits start from, and the default
-    batch size :class:`ArrowBlockReader` reads parquet at."""
-    return env_int(FIT_BLOCK_ROWS_ENV, DEFAULT_FIT_BLOCK_ROWS, minimum=1)
+    batch size :class:`ArrowBlockReader` reads parquet at.
+
+    An explicitly set env knob always wins. Otherwise, when the
+    ledger-driven autotuner is on (``TPUML_AUTOTUNE=on``), the DEFAULT is
+    replaced by the tuner's recommendation for ``family`` — the largest
+    block fitting measured HBM headroom, or a committed tune-store
+    decision — sized with ``width``/``itemsize`` when the caller knows
+    the matrix shape. Off (the default) is today's value bit-for-bit."""
+    import os as _os
+
+    if _os.environ.get(FIT_BLOCK_ROWS_ENV) is not None:
+        return env_int(FIT_BLOCK_ROWS_ENV, DEFAULT_FIT_BLOCK_ROWS, minimum=1)
+    from spark_rapids_ml_tpu.observability import autotune as _autotune
+
+    tuner = _autotune.active()
+    if tuner is None:
+        return DEFAULT_FIT_BLOCK_ROWS
+    return tuner.recommend_block_rows(
+        family or "fit",
+        default=DEFAULT_FIT_BLOCK_ROWS,
+        width=width,
+        itemsize=itemsize,
+    )
 
 
 class SparseVector:
@@ -521,7 +547,15 @@ class HostArrayBlockReader:
             raise ValueError(
                 f"HostArrayBlockReader needs a 2-D matrix, got {self._x.ndim}-D"
             )
-        self.block_rows = int(block_rows) if block_rows else fit_block_rows()
+        self.block_rows = (
+            int(block_rows)
+            if block_rows
+            else fit_block_rows(
+                "fit.host_matrix",
+                width=int(self._x.shape[1]),
+                itemsize=int(self._x.dtype.itemsize),
+            )
+        )
         if self.block_rows < 1:
             raise ValueError("block_rows must be >= 1")
 
@@ -578,7 +612,6 @@ class ArrowBlockReader:
         if not columns:
             raise ValueError("ArrowBlockReader needs at least one feature column")
         self.columns = list(columns)
-        self.block_rows = int(block_rows) if block_rows else fit_block_rows()
         if dtype is not None:
             self._dtype = np.dtype(dtype)
         else:
@@ -594,6 +627,17 @@ class ArrowBlockReader:
 
             all_f32 = all(_leaf(t) == pa.float32() for t in feats)
             self._dtype = np.dtype(np.float32 if all_f32 else np.float64)
+        # Width for tuned sizing: column count is a lower bound (a packed
+        # vector column is wider) — good enough for the headroom estimate.
+        self.block_rows = (
+            int(block_rows)
+            if block_rows
+            else fit_block_rows(
+                "fit.arrow",
+                width=len(self.columns),
+                itemsize=int(self._dtype.itemsize),
+            )
+        )
 
     @property
     def dtype(self):

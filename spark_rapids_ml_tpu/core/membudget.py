@@ -270,6 +270,19 @@ def fit_memory_guard(
 
         dtype = default_dtype()
     declared = padded_input_bytes(n, d, dtype) + int(extra_bytes)
+    # Decision (d) of the autotuner: when on AND the family has a fitted
+    # bytes model, price the candidate through the measured model —
+    # argument + temp + output bytes at this row count — instead of
+    # re-deriving the padding arithmetic from the declared shape. Tuner
+    # off, or no model yet: the static pricing bit-for-bit.
+    from spark_rapids_ml_tpu.observability import autotune as _autotune
+
+    tuner = _autotune.active()
+    if tuner is not None:
+        model_priced = tuner.price_input_bytes(family, n)
+        if model_priced is not None:
+            bump_counter("fit.admission.model_priced")
+            declared = model_priced + int(extra_bytes)
     measured = ledger_measured_bytes(*ledger_families) if ledger_families else None
     # Input placement is unavoidable either way; the ledger measurement
     # bounds the solver's temp+output working set ON TOP of it.
@@ -340,13 +353,40 @@ def run_streaming_with_recovery(
     would, so an undisturbed degraded fit is bit-identical to the
     explicit one."""
     from spark_rapids_ml_tpu.core.data import HostArrayBlockReader, fit_block_rows
+    from spark_rapids_ml_tpu.observability import autotune as _autotune
 
-    block = int(block_rows) if block_rows else fit_block_rows()
+    tuner = _autotune.active()
+    if block_rows:
+        block = int(block_rows)
+        tuner = None  # caller-pinned block: nothing to tune or record
+    else:
+        block = fit_block_rows(
+            family,
+            width=int(matrix.shape[1]),
+            itemsize=int(np.dtype(matrix.dtype).itemsize),
+        )
     attempts = fit_oom_retries()
     last: Optional[BaseException] = None
     for attempt in range(attempts):
         try:
-            result = fit_with_reader(HostArrayBlockReader(matrix, block_rows=block))
+            if tuner is not None:
+                # Measure-and-commit: the fit runs under the ledger and
+                # its seconds-per-row either commits this block size as
+                # the family incumbent or is recorded as a rejected
+                # candidate — a regression is never accepted.
+                result, _, _ = tuner.measure_and_commit(
+                    "fit_block_rows",
+                    family,
+                    block,
+                    lambda: fit_with_reader(
+                        HostArrayBlockReader(matrix, block_rows=block)
+                    ),
+                    rows=int(matrix.shape[0]),
+                )
+            else:
+                result = fit_with_reader(
+                    HostArrayBlockReader(matrix, block_rows=block)
+                )
             if attempt:
                 bump_counter("fit.oom.recovered")
                 emit(
@@ -362,6 +402,10 @@ def run_streaming_with_recovery(
             last = exc
             bump_counter("fit.oom.events")
             _reclaim()
+            if tuner is not None:
+                # Ledgered evidence this block OOMed: the tuner will
+                # never propose a block at or above it again.
+                tuner.note_oom(family, block)
             if attempt + 1 < attempts:
                 block = max(MIN_BLOCK_ROWS, block // 2)
                 bump_counter("fit.oom.block_halved")

@@ -65,6 +65,7 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.observability import autotune as _autotune
 from spark_rapids_ml_tpu.observability import costs as _costs
 from spark_rapids_ml_tpu.observability.events import emit, run_scope
 from spark_rapids_ml_tpu.observability.metrics import ROW_BUCKETS, histogram
@@ -120,6 +121,25 @@ def bucket_rows(n: int, min_bucket: int = MIN_ROW_BUCKET) -> int:
     if n <= min_bucket:
         return min_bucket
     return 1 << (n - 1).bit_length()
+
+
+def ladder_bucket_rows(
+    n: int, *, name: str, width: int, observe: bool = True
+) -> int:
+    """The bucket one serving request of ``n`` rows executes at: the
+    pow-2 :func:`bucket_rows` value unless the autotuner's learned
+    per-(model, width) ladder has an exact-fit rung (which may sit below
+    the 8-row pow-2 minimum for proven-hot tiny batches). ``observe=True``
+    also feeds the request into the ladder's traffic histogram; admission
+    pricing peeks with ``observe=False`` so one request is not counted
+    twice. With the tuner off this IS ``bucket_rows`` — one None check."""
+    bucket = bucket_rows(n)
+    tuner = _autotune.active()
+    if tuner is None:
+        return bucket
+    if observe:
+        return tuner.serving_bucket(name, width, n, bucket)
+    return tuner.peek_serving_bucket(name, width, n, bucket)
 
 
 # ---------------------------------------------------------------------------
@@ -552,7 +572,7 @@ def _serve_rows_impl(
             x = x[None, :]
         n, d = int(x.shape[0]), int(x.shape[1])
         _observe_batch(n)
-        bucket = bucket_rows(n)
+        bucket = ladder_bucket_rows(n, name=name, width=d)
         if bucket == n:
             x_pad, owned = x, False
         else:
@@ -569,7 +589,7 @@ def _serve_rows_impl(
             raise ValueError(f"serving input must be 2-D, got {x_host.ndim}-D")
         n, d = x_host.shape
         _observe_batch(n)
-        bucket = bucket_rows(n)
+        bucket = ladder_bucket_rows(n, name=name, width=d)
         dtype = _compute_dtype(x_host.dtype)
         # A FRESH padded scratch per call: jax may alias (zero-copy) a
         # numpy buffer on the CPU backend and H2D transfers may read it
@@ -642,7 +662,7 @@ def serve_stream(
             continue
         n, d = x_host.shape
         _observe_batch(n)
-        bucket = bucket_rows(n)
+        bucket = ladder_bucket_rows(n, name=name, width=d)
         blk_dtype = np.dtype(dtype) if dtype is not None else _compute_dtype(x_host.dtype)
         pad_host = np.zeros((bucket, d), dtype=blk_dtype)
         pad_host[:n] = x_host
@@ -691,6 +711,35 @@ def serve_stream(
 
     if pending is not None:
         yield _slice_outputs(pending[0], pending[1], pending[2], True)
+
+
+def prefetch_blocks(
+    blocks: Iterable[Any], prepare: Callable[[Any], Any]
+) -> Iterator[Any]:
+    """One-ahead double buffering for the TRAINING streaming loops —
+    :func:`serve_stream`'s overlap pattern lifted out for the fit paths.
+
+    ``prepare`` does the per-block host work + async H2D upload
+    (densify, ``ascontiguousarray``, ``device_put``/``jnp.asarray``).
+    Block k is yielded only after block k+1's ``prepare`` has run, so
+    the host-side decode and the H2D transfer of the next block are in
+    flight before the consumer blocks on computing the current one.
+    Values are exactly ``prepare(block)`` in order — bit-identical to
+    the unprefetched loop — and every overlapped hand-off bumps
+    ``fit.stream.prefetched`` (the counter the parity tests assert).
+
+    NOTE: no run_scope here for the same reason as :func:`serve_stream`
+    — a generator's contextvar writes leak into the consuming context.
+    """
+    pending = _SENTINEL = object()
+    for blk in blocks:
+        current = prepare(blk)
+        if pending is not _SENTINEL:
+            bump_counter("fit.stream.prefetched")
+            yield pending
+        pending = current
+    if pending is not _SENTINEL:
+        yield pending
 
 
 # ---------------------------------------------------------------------------

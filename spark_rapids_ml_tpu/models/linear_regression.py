@@ -475,7 +475,16 @@ def _streaming_blocks(dataset):
         start = 0
         for xb in blocks:
             xb = _block_to_dense(xb)
-            yield xb, y_arr[start : start + xb.shape[0]]
+            yb = y_arr[start : start + xb.shape[0]]
+            # Check the slice HERE, not downstream: the double-buffered
+            # accumulator prepares pair k+1 before consuming pair k, so a
+            # short tail must fail when it is produced to fail at all.
+            if yb.shape[0] != xb.shape[0]:
+                raise ValueError(
+                    f"block rows mismatch: X block has {xb.shape[0]} rows "
+                    f"but only {yb.shape[0]} labels remain"
+                )
+            yield xb, yb
             start += xb.shape[0]
         if start != y_arr.shape[0]:
             raise ValueError(

@@ -99,12 +99,39 @@ class RetraceStormWarning(UserWarning):
     bypassed and compiles are eating the run."""
 
 
+#: Installed by observability.autotune (which imports this module, so
+#: the dependency is inverted into a hook): extra row counts that ARE
+#: legitimate buckets — the learned exact-fit ladder rungs. None = off.
+_ROW_BUCKET_PROBE: Optional[Callable[[int], bool]] = None
+
+#: Installed by observability.autotune: called (family, rows, seconds)
+#: after every ledgered invocation — the wall-sample feed for the p95
+#: estimates behind the batcher deadline and the router shard cutoff.
+_INVOCATION_OBSERVER: Optional[Callable[[str, int, float], None]] = None
+
+
+def set_row_bucket_probe(probe: Optional[Callable[[int], bool]]) -> None:
+    global _ROW_BUCKET_PROBE
+    _ROW_BUCKET_PROBE = probe
+
+
+def set_invocation_observer(
+    observer: Optional[Callable[[str, int, float], None]]
+) -> None:
+    global _INVOCATION_OBSERVER
+    _INVOCATION_OBSERVER = observer
+
+
 def _is_row_bucket(rows: int) -> bool:
     """Whether ``rows`` is a value ``core.serving.bucket_rows`` can
     return (a power of two >= the minimum bucket) — duplicated here
     instead of imported because core.serving imports this module.
-    A compile at any OTHER row count means bucketing was bypassed."""
-    return rows >= 8 and (rows & (rows - 1)) == 0
+    A compile at any OTHER row count means bucketing was bypassed,
+    UNLESS the autotuner's learned ladder admitted that exact size."""
+    if rows >= 8 and (rows & (rows - 1)) == 0:
+        return True
+    probe = _ROW_BUCKET_PROBE
+    return probe is not None and bool(probe(rows))
 
 
 def _memory_fields(mem) -> Dict[str, int]:
@@ -283,6 +310,12 @@ class Ledger:
             entry.invocations += 1
             entry.wall_seconds += float(seconds)
             entry.rows_served += int(rows)
+            family = entry.family
+        # Outside self._lock: the observer (the autotuner) takes its own
+        # lock and must never nest inside the ledger's.
+        observer = _INVOCATION_OBSERVER
+        if observer is not None:
+            observer(family, int(rows), float(seconds))
 
     # --- the retrace watchdog ------------------------------------------
 

@@ -95,6 +95,7 @@ SCHEMA: Dict[str, frozenset] = {
     "serving": frozenset({"action"}),
     "fit_admission": frozenset({"action", "family"}),
     "compile": frozenset({"classification", "kernel"}),
+    "autotune": frozenset({"action"}),
     "report": frozenset({"kind", "summary"}),
     "profile": frozenset({"action", "dir"}),
     "distributed": frozenset({"action"}),

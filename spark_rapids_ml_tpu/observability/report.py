@@ -379,6 +379,13 @@ def serving_report() -> dict:
         # the full per-program ledger plus its per-family rollup.
         out["costs"] = ledger_doc
         out["cost_rollup"] = _costs.family_rollup(ledger_doc)
+    from spark_rapids_ml_tpu.observability import autotune as _autotune
+
+    tune_doc = _autotune.tuner_snapshot()
+    if tune_doc is not None:
+        # What the ledger DECIDED: committed knob values, the learned
+        # bucket ladders, and the fitted per-family cost models.
+        out["autotune"] = tune_doc
     try:
         from spark_rapids_ml_tpu.serving import batcher as _batcher
         from spark_rapids_ml_tpu.serving.server import runtime_snapshots

@@ -111,11 +111,15 @@ def shifted_block_scan(blocks, center: bool, gram_fn, min_rows: int = 2):
     ``(shift, gram, s, n)`` — finish with :func:`finalize_shifted_gram`.
     """
     from spark_rapids_ml_tpu.core.data import _block_to_dense
+    from spark_rapids_ml_tpu.core.serving import prefetch_blocks
 
     shift = gram = s = None
     n = 0
-    for blk in blocks:
-        b = _block_to_dense(blk)
+    # Double-buffered at the densify level: block k+1's host decode
+    # (parquet batch → ndarray) overlaps block k's Gram program. The
+    # shift itself comes from the FIRST block, so centering and upload
+    # stay in the loop — values and order are bit-identical.
+    for b in prefetch_blocks(blocks, _block_to_dense):
         if b.shape[0] == 0:
             continue
         if shift is None:

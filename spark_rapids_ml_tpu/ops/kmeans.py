@@ -117,9 +117,23 @@ def lloyd_step(x, mask, centers, x2, prec, cosine: bool = False,
 def _auto_block_rows(n: int, k: int, data_shards: int, block_rows):
     """Resolve ``block_rows=None`` — shared by the monolithic
     :func:`lloyd` and the segmented :func:`lloyd_resumable` so both
-    pick the identical blocking (a prerequisite for bit-identity)."""
+    pick the identical blocking (a prerequisite for bit-identity).
+
+    With ``TPUML_AUTOTUNE=on`` the block is sized from MEASURED HBM
+    headroom instead of the static 9 GB guess. Inside the jitted
+    :func:`lloyd` this resolves at trace time, so a tuned value freezes
+    into the trace keyed on ``block_rows=None`` — stale-but-correct if
+    the tune store moves mid-process; ``lloyd_resumable`` re-resolves on
+    every fit. Off is the static heuristic bit-for-bit."""
     if block_rows is not None:
         return block_rows
+    from spark_rapids_ml_tpu.observability import autotune as _autotune
+
+    tuner = _autotune.active()
+    if tuner is not None:
+        tuned = tuner.recommend_kmeans_block_rows(n, k, data_shards)
+        if tuned is not None:
+            return tuned
     # Per-device (n, k) fp32 temporary vs the HBM budget.
     if 4 * n * k // max(data_shards, 1) > 9_000_000_000:
         # Block sized so block*k*4B stays ~1 GB (no larger floor: a
@@ -432,15 +446,24 @@ def lloyd_streaming(
     k, d = centers.shape
     np_dtype = np.dtype(dtype) if dtype is not None else np.dtype(centers.dtype)
 
+    def _upload(blk):
+        b = _block_to_dense(blk, dtype=np_dtype)
+        if b.shape[0] == 0:
+            return None
+        xb = jnp.asarray(b)
+        if cosine:
+            xb = normalize_rows(xb)
+        return xb
+
     def blocks_dev():
-        for blk in blocks_factory():
-            b = _block_to_dense(blk, dtype=np_dtype)
-            if b.shape[0] == 0:
-                continue
-            xb = jnp.asarray(b)
-            if cosine:
-                xb = normalize_rows(xb)
-            yield xb
+        # Double-buffered: block k+1 densifies and uploads while block
+        # k's suff-stats program runs (serve_stream's overlap pattern via
+        # prefetch_blocks); values and order are bit-identical.
+        from spark_rapids_ml_tpu.core.serving import prefetch_blocks
+
+        for xb in prefetch_blocks(blocks_factory(), _upload):
+            if xb is not None:
+                yield xb
 
     def one_pass(cs):
         fault_point("solver.segment")

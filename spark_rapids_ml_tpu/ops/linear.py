@@ -393,15 +393,27 @@ def normal_eq_stats_streaming(block_pairs, dtype=None, precision: str = "highest
 
     from spark_rapids_ml_tpu.robustness.faults import fault_point
 
-    acc = None
-    d = None
-    for xb, yb in block_pairs:
+    def _upload(pair):
+        xb, yb = pair
         if getattr(xb, "shape", (1,))[0] == 0:
             # Empty partitions densify to (0, 0) — no rows, no width info.
+            return None
+        return (
+            jnp.asarray(np.ascontiguousarray(xb), dtype=dtype),
+            jnp.asarray(np.ascontiguousarray(yb), dtype=dtype),
+        )
+
+    from spark_rapids_ml_tpu.core.serving import prefetch_blocks
+
+    acc = None
+    d = None
+    # Double-buffered: pair k+1 densifies/uploads while pair k's moment
+    # program runs; accumulation order is unchanged (bit-identical).
+    for pair in prefetch_blocks(block_pairs, _upload):
+        if pair is None:
             continue
+        xj, yj = pair
         fault_point("solver.segment")
-        xj = jnp.asarray(np.ascontiguousarray(xb), dtype=dtype)
-        yj = jnp.asarray(np.ascontiguousarray(yb), dtype=dtype)
         if d is None:
             d = xj.shape[1]
         elif xj.shape[1] != d:

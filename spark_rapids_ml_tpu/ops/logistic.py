@@ -838,9 +838,19 @@ def fit_logistic_streaming(
         tot = jnp.zeros((), dtype)
         gw_acc = jnp.zeros((d, c), dtype)
         gb_acc = jnp.zeros((c,), dtype)
-        for xb, yb in pairs_factory():
-            xj = jnp.asarray(np.ascontiguousarray(xb, dtype=np_dtype))
-            yj = jnp.asarray(np.asarray(yb).ravel().astype(np.int32))
+
+        def _upload(pair):
+            xb, yb = pair
+            return (
+                jnp.asarray(np.ascontiguousarray(xb, dtype=np_dtype)),
+                jnp.asarray(np.asarray(yb).ravel().astype(np.int32)),
+            )
+
+        from spark_rapids_ml_tpu.core.serving import prefetch_blocks
+
+        # Double-buffered: pair k+1 densifies/uploads while pair k's
+        # value+grad program runs; accumulation order is unchanged.
+        for xj, yj in prefetch_blocks(pairs_factory(), _upload):
             v, gw, gb = _stream_block_value_grad(
                 xj, yj, wj, bj, offset_j, scale_j, c, fit_intercept,
                 precision, fused,

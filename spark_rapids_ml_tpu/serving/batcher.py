@@ -30,6 +30,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from spark_rapids_ml_tpu.observability import autotune as _autotune
 from spark_rapids_ml_tpu.observability.events import emit, trace_scope
 from spark_rapids_ml_tpu.observability.metrics import histogram
 from spark_rapids_ml_tpu.utils.lockcheck import make_lock
@@ -152,7 +153,7 @@ class MicroBatcher:
         until the batch fills."""
         batch = [first]
         rows = first.n
-        flush_at = first.enqueue_mono + self.max_delay_s
+        flush_at = first.enqueue_mono + self._delay_s_for(first)
         while rows < self.max_batch:
             for req in self._queue.drain_compatible(first.key, self.max_batch - rows):
                 if self._fail_if_expired(req):
@@ -172,6 +173,19 @@ class MicroBatcher:
                         rows += req.n
                 break
         return batch
+
+    def _delay_s_for(self, first: Request) -> float:
+        """The coalescing window for the batch forming behind ``first``:
+        the static ``TPUML_SERVE_MAX_DELAY_MS`` unless the autotuner has
+        measured p95 program wall for this model's serving kernel — a
+        batch should wait about the time one dispatch saves, so the
+        deadline tracks the measured program, not a guess."""
+        tuner = _autotune.active()
+        if tuner is None:
+            return self.max_delay_s
+        return tuner.recommend_delay_s(
+            first.version.signature.name, self.max_delay_s
+        )
 
     def _fail_if_expired(self, req: Request) -> bool:
         now = time.monotonic()

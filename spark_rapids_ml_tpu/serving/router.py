@@ -54,6 +54,7 @@ from typing import Any, Dict, Iterable, List, Optional, Set
 import numpy as np
 
 from spark_rapids_ml_tpu.core.serving import _compute_dtype, bucket_rows
+from spark_rapids_ml_tpu.observability import autotune as _autotune
 from spark_rapids_ml_tpu.observability.events import (
     begin_trace,
     current_trace_context,
@@ -631,7 +632,16 @@ class RoutingRuntime:
         return min(budgets) if budgets else 0
 
     def _is_oversized(self, mv: ModelVersion, n: int, dtype) -> bool:
-        if self.shard_rows and n >= self.shard_rows:
+        shard_rows = self.shard_rows
+        if not shard_rows:
+            # No explicit cutoff: with the autotuner on, derive one from
+            # the fitted wall model — shard a request whose predicted
+            # single-program wall would monopolize a member for several
+            # batch windows of the hot bucket.
+            tuner = _autotune.active()
+            if tuner is not None:
+                shard_rows = tuner.recommend_shard_rows(mv.signature.name) or 0
+        if shard_rows and n >= shard_rows:
             return True
         floor = self._member_budget_floor()
         if not floor:

@@ -24,7 +24,7 @@ from typing import Any, Iterable, List, Optional
 
 import numpy as np
 
-from spark_rapids_ml_tpu.core.serving import _compute_dtype, bucket_rows
+from spark_rapids_ml_tpu.core.serving import _compute_dtype, ladder_bucket_rows
 from spark_rapids_ml_tpu.observability import costs as _costs
 from spark_rapids_ml_tpu.observability.events import (
     begin_trace,
@@ -221,7 +221,12 @@ class ServingRuntime:
         dtype = _compute_dtype(xh.dtype)
         xh = np.ascontiguousarray(xh, dtype=dtype)
         n = int(xh.shape[0])
-        bucket = bucket_rows(max(n, 1))
+        # observe=False: the execution path (serve_rows) feeds the ladder
+        # histogram; pricing must agree on the bucket without counting
+        # the request twice.
+        bucket = ladder_bucket_rows(
+            max(n, 1), name=sig.name, width=sig.n_features, observe=False
+        )
         # Admission pricing: once the bucket's program has compiled under
         # the cost ledger, its MEASURED temp+output bytes (what XLA
         # actually allocates per execution) replace the declared-spec

@@ -144,6 +144,19 @@ KNOBS: Dict[str, Knob] = _knob_table(
          "declared device peak FLOP/s for roofline utilization estimates"),
     Knob("TPUML_PEAK_BYTES_PER_SEC", "float", "observability",
          "declared device peak HBM bytes/s for roofline utilization"),
+    # ledger-driven autotuner (observability/autotune.py)
+    Knob("TPUML_AUTOTUNE", "choice", "autotune",
+         "on = measured-cost models drive block rows, the serving "
+         "bucket ladder, the batcher deadline, the router shard cutoff "
+         "and admission pricing (implies the cost ledger); off = every "
+         "static heuristic unchanged bit-for-bit",
+         default="off", choices=("off", "on")),
+    Knob("TPUML_TUNE_STORE", "str", "autotune",
+         "persistent JSON of accepted autotune decisions (atomic "
+         "writes; corrupt files fall back to an empty store)"),
+    Knob("TPUML_AUTOTUNE_HOT_MIN", "int", "autotune",
+         "sightings of one exact batch size before the serving ladder "
+         "admits it as an exact-fit bucket", default=16),
     # hot-path kernel backend selection
     Knob("TPUML_UMAP_SCATTER", "choice", "kernels",
          "UMAP tail scatter backend: pallas = bucketed-accumulation "

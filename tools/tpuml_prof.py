@@ -18,6 +18,14 @@ Modes::
                                          # CI perf gate: exit 1 when a
                                          # family's total flops or bytes
                                          # grew more than 25%
+    tpuml_prof.py tune STORE             # the autotuner's accepted
+                                         # decisions: per-knob incumbent
+                                         # vs rejected candidates with
+                                         # measured deltas
+    tpuml_prof.py tune STORE --explain FAMILY --ledger LEDGER
+                                         # fitted cost-model coefficients
+                                         # + the evidence entries behind
+                                         # each committed decision
 
 ``--diff`` compares per-family TOTALS (analyzed flops/bytes × run
 invocations) so it gates what the workload actually executed, not just
@@ -171,7 +179,167 @@ def diff_ledgers(
     return regressions, notes
 
 
+def _import_autotune():
+    """Checkout-safe import of the autotuner (same seam as _import_costs)."""
+    try:
+        from spark_rapids_ml_tpu.observability import autotune
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from spark_rapids_ml_tpu.observability import autotune
+    return autotune
+
+
+def load_tune_store(path: str) -> Tuple[List[dict], List[str]]:
+    """Decode a tune store JSON into its decision list. Returns
+    (decisions, problems)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [], [f"unreadable tune store {path}: {exc}"]
+    decisions = doc.get("decisions")
+    if not isinstance(decisions, dict):
+        return [], [f"{path}: 'decisions' missing or not an object"]
+    return list(decisions.values()), []
+
+
+def _fmt_metric(value, name) -> str:
+    if value is None:
+        return "n/a"
+    return f"{value:.4g} {name or ''}".rstrip()
+
+
+def render_tune(decisions: List[dict]) -> str:
+    """Per-knob incumbent vs candidates with measured deltas."""
+    if not decisions:
+        return "tune store is empty (no accepted decisions yet)"
+    lines = [f"{len(decisions)} accepted decision(s):"]
+    for dec in sorted(
+        decisions, key=lambda d: (str(d.get("knob")), str(d.get("key")))
+    ):
+        lines.append(
+            f"  {dec.get('knob')}[{dec.get('key')}] = {dec.get('value')!r}"
+            f"  ({_fmt_metric(dec.get('metric'), dec.get('metric_name'))}, "
+            f"{dec.get('trials', 0)} trial(s))"
+        )
+        inc_metric = dec.get("metric")
+        for cand in dec.get("rejected") or []:
+            delta = ""
+            c_metric = cand.get("metric")
+            if inc_metric and c_metric is not None:
+                delta = f" ({(c_metric - inc_metric) / inc_metric * 100.0:+.1f}% vs incumbent)"
+            lines.append(
+                f"    rejected {cand.get('value')!r}: "
+                f"{_fmt_metric(c_metric, dec.get('metric_name'))}"
+                f"{delta} [{cand.get('reason', '?')}]"
+            )
+        ev = dec.get("evidence") or []
+        if ev:
+            lines.append(f"    evidence: {', '.join(str(e) for e in ev[:6])}"
+                         + (f" … +{len(ev) - 6} more" if len(ev) > 6 else ""))
+    return "\n".join(lines)
+
+
+def render_explain(
+    family: str, decisions: List[dict], ledger_doc: Optional[dict]
+) -> str:
+    """Fitted cost-model coefficients for ``family`` plus the evidence
+    ledger entries behind each committed decision touching it."""
+    autotune = _import_autotune()
+    lines = [f"family {family!r}:"]
+    if ledger_doc is not None:
+        entries = [
+            _EntryView(e) for e in ledger_doc.get("entries", [])
+            if family in (e.get("family") or "")
+            or (e.get("family") or "").startswith(family)
+        ]
+        models = autotune.fit_cost_models(entries)
+        if not models:
+            lines.append("  no fittable ledger entries (need rows + invocations)")
+        for fam, m in sorted(models.items()):
+            lines.append(f"  model {fam} ({m.points} point(s)):")
+            if m.wall_a is not None:
+                lines.append(
+                    f"    wall(rows)  = {m.wall_a:.4g}·rows + {m.wall_b:.4g} s"
+                    " (compile-amortized)"
+                )
+            if m.bytes_a is not None:
+                lines.append(
+                    f"    bytes(rows) = {m.bytes_a:.4g}·rows + {m.bytes_b:.4g}"
+                )
+            for key in m.evidence[:8]:
+                lines.append(f"    evidence: {key}")
+    else:
+        lines.append("  (no --ledger given: coefficients unavailable)")
+    hits = [
+        d for d in decisions
+        if family in str(d.get("key", "")) or str(d.get("key", "")) in family
+    ]
+    if hits:
+        lines.append("  committed decisions:")
+        lines.extend("  " + ln for ln in render_tune(hits).splitlines()[1:])
+    else:
+        lines.append("  no committed decisions touch this family")
+    return "\n".join(lines)
+
+
+class _EntryView:
+    """Attribute view over a serialized ledger entry dict, so
+    ``fit_cost_models`` (written against live ProgramCost objects) fits
+    dumped documents too."""
+
+    def __init__(self, d: dict):
+        self._d = d
+
+    def __getattr__(self, name):
+        return self._d.get(name)
+
+
+def tune_main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpuml_prof.py tune",
+        description="Render the autotuner's accepted-decision store.",
+    )
+    parser.add_argument("store", help="TPUML_TUNE_STORE JSON path")
+    parser.add_argument(
+        "--explain", metavar="FAMILY", default=None,
+        help="print fitted cost-model coefficients + evidence for FAMILY",
+    )
+    parser.add_argument(
+        "--ledger", default=None,
+        help="ledger file/telemetry dir to fit --explain models from",
+    )
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    args = parser.parse_args(argv)
+
+    decisions, problems = load_tune_store(args.store)
+    for p in problems:
+        print(f"INVALID {p}", file=sys.stderr)
+    if problems:
+        return 2
+    if args.format == "json":
+        print(json.dumps(decisions, indent=2, default=str))
+        return 0
+    if args.explain is not None:
+        ledger_doc = None
+        if args.ledger is not None:
+            ledger_doc, lp = load_ledger(args.ledger)
+            for p in lp:
+                print(f"INVALID {p}", file=sys.stderr)
+        print(render_explain(args.explain, decisions, ledger_doc))
+        return 0
+    print(render_tune(decisions))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # Subcommand-style dispatch for the tune store, keeping every legacy
+    # flag invocation (a path is never literally "tune") untouched.
+    if argv and argv[0] == "tune":
+        return tune_main(argv[1:])
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
         "path", nargs="?", default=None,
