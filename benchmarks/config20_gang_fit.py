@@ -1,0 +1,178 @@
+"""Config 20: gang-parallel fit scaling, 1 -> 2 member processes.
+
+The tentpole claim of ISSUE 15, closed-loop: the SAME public ``fit()``
+call, deployed as a gang of 2 OS processes (jax.distributed over gloo,
+each member feeding only its slice), must beat the 1-member deployment
+in global rows/s. The workload is a pinned-init KMeans Lloyd fit —
+fixed iteration count (no convergence luck), per-iteration compute
+``n*k*d`` against a psum of just ``(k, d)`` center stats, so the
+scaling headroom is real compute, not benchmark theater.
+
+Per-member silicon is held CONSTANT across the sweep: every member is
+pinned to ``ncpu // 2`` cores (member p to its own half), so the
+2-process run uses 2x the cores of the 1-process run — weak scaling of
+silicon, the chip-per-executor story. On hosts with >= 4 CPUs the
+acceptance bar is > 1.5x rows/s; below that the members share cores
+and the bar is the non-collapse floor (>= 0.5x — gloo + a shared core
+must not wedge the fit).
+
+One JSON line: ``gang_fit.scaling.speedup`` with per-deployment rows/s.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MEMBER_ENV = "TPUML_BENCH_GANG_MEMBER"
+
+
+def _member() -> None:
+    """One gang member: pin cores, join via the public fit(), report wall."""
+    from spark_rapids_ml_tpu.utils.envknobs import env_str
+
+    cores = env_str("TPUML_BENCH_GANG_CORES")
+    if cores and hasattr(os, "sched_setaffinity"):
+        os.sched_setaffinity(0, {int(c) for c in cores.split(",")})
+
+    import numpy as np
+
+    import jax
+
+    from spark_rapids_ml_tpu.utils.envknobs import env_int
+
+    n = env_int("TPUML_BENCH_ROWS", 120_000)
+    d = env_int("TPUML_BENCH_COLS", 32)
+    k = env_int("TPUML_BENCH_K", 16)
+    n_proc = env_int("TPUML_NUM_PROCESSES", 1)
+    pid = env_int("TPUML_PROCESS_ID", 0)
+
+    jax.config.update("jax_platforms", "cpu")
+    if n_proc > 1:
+        # Cross-process CPU collectives need gloo; a 1-member deployment
+        # must NOT request it (it requires a distributed client).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # newer jax: gloo is the default
+            pass
+
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    init = np.ascontiguousarray(x[:k], dtype=np.float64)
+    bounds = np.linspace(0, n, n_proc + 1).astype(int)
+    local = x[bounds[pid] : bounds[pid + 1]]
+
+    def fit():
+        model = (
+            KMeans().setK(k).setMaxIter(10).setInitialModel(init)
+            .setDeployMode("gang").fit(local)
+        )
+        # The model's host views are lazy — materialize INSIDE the wall,
+        # or the timer reads async dispatch latency, not the fit.
+        return np.asarray(model.clusterCenters())
+
+    fit()  # warm: compile + distributed bring-up stay out of the wall
+    t0 = time.monotonic()
+    centers = fit()
+    wall = time.monotonic() - t0
+    assert centers.shape == (k, d)
+    print(f"FIT_WALL {wall:.4f}", flush=True)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_deployment(n_proc: int, rows: int) -> float:
+    """Spawn an n_proc gang of this script; return global rows/s."""
+    ncpu = os.cpu_count() or 1
+    cores_per_member = max(1, ncpu // 2)
+    port = _free_port()
+    procs = []
+    for pid in range(n_proc):
+        lo = (pid * cores_per_member) % ncpu
+        cores = ",".join(
+            str((lo + i) % ncpu) for i in range(cores_per_member)
+        )
+        env = {
+            **os.environ,
+            MEMBER_ENV: "1",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "TPUML_NUM_PROCESSES": str(n_proc),
+            "TPUML_PROCESS_ID": str(pid),
+            "TPUML_BENCH_GANG_CORES": cores,
+        }
+        if n_proc > 1:
+            env["TPUML_COORDINATOR"] = f"127.0.0.1:{port}"
+        else:
+            env.pop("TPUML_COORDINATOR", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env,
+            )
+        )
+    walls = []
+    for pid, p in enumerate(procs):
+        out, err = p.communicate(timeout=600)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"gang member {pid}/{n_proc} failed:\n{err[-3000:]}"
+            )
+        walls.append(
+            float(next(l for l in out.splitlines() if l.startswith("FIT_WALL"))
+                  .split()[1])
+        )
+    # The gang is done when its SLOWEST member is done.
+    return rows / max(walls)
+
+
+def main() -> None:
+    from benchmarks.common import emit
+    from spark_rapids_ml_tpu.utils.envknobs import env_int
+
+    rows = env_int("TPUML_BENCH_ROWS", 120_000)
+    rows_s = {n: _run_deployment(n, rows) for n in (1, 2)}
+    speedup = rows_s[2] / rows_s[1]
+
+    ncpu = os.cpu_count() or 1
+    # >= 4 CPUs: each member really gets its own silicon — the scaling
+    # claim applies. Fewer: members share cores; only the non-collapse
+    # floor is meaningful (gloo + oversubscription must not wedge).
+    floor = 1.5 if ncpu >= 4 else 0.5
+    emit(
+        "gang_fit.scaling.speedup",
+        speedup,
+        "x",
+        rows_per_s_1proc=round(rows_s[1], 1),
+        rows_per_s_2proc=round(rows_s[2], 1),
+        rows=rows,
+        ncpu=ncpu,
+        floor=floor,
+    )
+    assert speedup > floor, (
+        f"2-process gang fit speedup {speedup:.2f}x below the "
+        f"{'scaling target' if ncpu >= 4 else 'non-collapse floor'} "
+        f"{floor}x ({rows_s[1]:.0f} -> {rows_s[2]:.0f} rows/s on "
+        f"{ncpu} CPUs)"
+    )
+
+
+if __name__ == "__main__":
+    from spark_rapids_ml_tpu.utils.envknobs import env_choice
+
+    if env_choice(MEMBER_ENV, ("0", "1"), "0") == "1":
+        _member()
+    else:
+        main()

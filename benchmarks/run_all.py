@@ -37,6 +37,7 @@ CONFIGS = [
     ("config17_kmeans_packed.py", {}),
     ("config18_router.py", {}),
     ("config19_autotune.py", {}),
+    ("config20_gang_fit.py", {}),
 ]
 
 

@@ -42,6 +42,65 @@ class Transformer(Params):
 
 
 class Estimator(Params):
+    #: Fit deployment mode: ``"single"`` (default) fits on this process's
+    #: devices alone; ``"gang"`` makes this process one MEMBER of a
+    #: multi-process gang — every member calls the same public ``fit``
+    #: with its LOCAL rows, the ingest funnel assembles one globally
+    #: sharded array, and XLA collectives merge the reductions, so every
+    #: member returns the identical whole-dataset model. The env twin is
+    #: ``TPUML_GANG_FIT=1`` (a barrier launcher flips it without touching
+    #: estimator code).
+    deployMode = Param(
+        "_", "deployMode",
+        "fit deployment mode: 'single' or 'gang'", toString,
+    )
+
+    def getDeployMode(self) -> str:
+        if self.isDefined(self.deployMode):
+            return self.getOrDefault(self.deployMode)
+        from spark_rapids_ml_tpu.utils.envknobs import env_str
+
+        return "gang" if env_str("TPUML_GANG_FIT", "0") == "1" else "single"
+
+    def setDeployMode(self, value: str):
+        if value not in ("single", "gang"):
+            raise ValueError(
+                f"deployMode must be 'single' or 'gang', got {value!r}"
+            )
+        return self.set(self.deployMode, value)
+
+    def _join_gang(self) -> None:
+        """Gang-member bring-up, run once at the top of a gang-mode fit:
+        join the jax.distributed cohort (idempotent — a member that
+        already initialized, e.g. fitting a second estimator in the same
+        task, just revalidates its coordinates) and default this
+        estimator's mesh to the GLOBAL device set. A gang of one (the
+        stub Spark runner executes barrier tasks sequentially in one
+        process, so locally-launched gangs are single-member —
+        ``serving_gang_run`` documents the same limit) skips the runtime
+        bring-up entirely: jax.distributed can only form a cohort once
+        per process, and a 1-process cohort would wedge any later real
+        gang this process joins."""
+        import jax
+
+        from spark_rapids_ml_tpu.parallel import distributed as dist
+        from spark_rapids_ml_tpu.utils.envknobs import env_int, env_str
+
+        num = env_int("TPUML_NUM_PROCESSES", minimum=1)
+        if (num is not None and num > 1) or env_str("TPUML_COORDINATOR"):
+            dist.initialize()
+        if hasattr(self, "mesh") and getattr(self, "mesh") is None:
+            self.mesh = dist.global_mesh()
+        from spark_rapids_ml_tpu.observability.events import emit
+
+        emit(
+            "gang_fit",
+            action="join",
+            estimator=type(self).__name__,
+            num_processes=jax.process_count(),
+            process_id=jax.process_index(),
+        )
+
     def fit(self, dataset: Any):
         """Fit, instrumented: the whole call runs under a ``fit`` run
         scope (observability/) — a fresh ``run_id`` standalone, the
@@ -65,6 +124,8 @@ class Estimator(Params):
 
         with RunRecorder("fit", type(self).__name__) as rec:
             try:
+                if self.getDeployMode() == "gang":
+                    self._join_gang()
                 model = self._fit(dataset)
             except RuntimeError as exc:
                 from spark_rapids_ml_tpu.core.membudget import reraise_if_oom

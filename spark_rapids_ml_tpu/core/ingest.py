@@ -114,6 +114,13 @@ def _prepare_rows_impl(
         shard_rows_from_partitions,
     )
 
+    if mesh is not None and jax.process_count() > 1 and is_device_array(rows):
+        # Gang mode hands each process its LOCAL rows; a member's device
+        # array is a single-process artifact, so it rejoins the host path
+        # and enters the global array through the process-local funnel
+        # (the pull is one local shard, never the global dataset).
+        rows = np.asarray(rows)
+
     if is_device_array(rows):
         if rows.ndim != 2:
             raise ValueError(f"device-array input must be 2-D, got {rows.ndim}-D")
@@ -157,13 +164,46 @@ def _prepare_rows_impl(
     n = sum(p.shape[0] for p in parts)
     d = parts[0].shape[1]
     m_dtype = _mask_dtype(np_dtype)
+    if mesh is not None and jax.process_count() > 1:
+        # Gang deploy mode: `parts` are THIS PROCESS's rows only. The
+        # process-local funnel allgathers the counts, pads every member to
+        # the agreed per-process block, and assembles ONE global
+        # row-sharded array — n/d below become the GLOBAL true counts, so
+        # downstream reductions (which XLA psums across processes) report
+        # whole-dataset results on every member.
+        from spark_rapids_ml_tpu.parallel.distributed import (
+            shard_rows_process_local,
+            shard_vector_process_local,
+        )
+
+        n_local = n
+        x, mask, n, d = shard_rows_process_local(parts, mesh, dtype=np_dtype)
+        if m_dtype != mask.dtype:
+            mask = mask.astype(m_dtype)
+        if weights is not None:
+            # weightCol weights are local like the rows: length-check
+            # against the LOCAL count, shard into the same layout, and
+            # fold into the mask here (the single-process combine below
+            # checks against the global count and must not see them).
+            w_host = np.asarray(weights).ravel()
+            if w_host.shape[0] != n_local:
+                raise ValueError(
+                    f"weight vector has {w_host.shape[0]} entries but this "
+                    f"process's data has {n_local} rows"
+                )
+            w = shard_vector_process_local(
+                w_host, mesh, int(x.shape[0]), dtype=m_dtype
+            )
+            mask = mask * w
+            weights = None
+        return PreparedRows(x, mask, n, d)
     if mesh is not None:
         x, mask, _ = shard_rows_from_partitions(parts, mesh, dtype=np_dtype)
         if m_dtype != x.dtype:
             mask = mask.astype(m_dtype)
     else:
         x_host = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
-        device = jax.devices()[device_id] if device_id >= 0 else None
+        device = jax.local_devices()[device_id] if device_id >= 0 else None
 
         def _place():
             fault_point("ingest.device_put")
@@ -264,6 +304,25 @@ def prepare_labels(y: Any, n_pad: int, n_true: Optional[int] = None, mesh=None, 
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 
     dtype = dtype or default_dtype()
+    if mesh is not None and jax.process_count() > 1:
+        # Gang deploy mode: y holds THIS PROCESS's labels. Shard them into
+        # the exact P(data) layout prepare_rows produced (local values
+        # first in each process's block, zeros in the padding) and verify
+        # the GLOBAL label count matches the rows' true count — the
+        # length-mismatch guard below can only see local lengths.
+        from spark_rapids_ml_tpu.parallel.distributed import (
+            _allgather_counts_and_width,
+            shard_vector_process_local,
+        )
+
+        y_arr = np.asarray(y).ravel()
+        counts, _ = _allgather_counts_and_width(int(y_arr.shape[0]), 0)
+        if n_true is not None and int(counts.sum()) != n_true:
+            raise ValueError(
+                f"label vectors total {int(counts.sum())} entries across "
+                f"the gang but the data has {n_true} rows"
+            )
+        return shard_vector_process_local(y_arr, mesh, n_pad, dtype=dtype)
     if is_device_array(y):
         ys = y.ravel().astype(dtype) if y.dtype != dtype else y.ravel()
         if n_true is not None and int(ys.shape[0]) != n_true:

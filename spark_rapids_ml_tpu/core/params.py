@@ -215,10 +215,16 @@ class Params:
         return that
 
     def _copyValues(self, to: "Params", extra: Optional[Dict[Param, Any]] = None) -> "Params":
+        # Spark's copyValues contract: only params the TARGET defines are
+        # copied (an estimator-only param like deployMode does not belong
+        # on the fitted model). Explicit `extra` entries still raise on an
+        # unknown name — those are caller-specified, not inherited.
         for param, value in self._defaultParamMap.items():
-            to._defaultParamMap[to.getParam(param.name)] = value
+            if param.name in to._params:
+                to._defaultParamMap[to.getParam(param.name)] = value
         for param, value in self._paramMap.items():
-            to._paramMap[to.getParam(param.name)] = value
+            if param.name in to._params:
+                to._paramMap[to.getParam(param.name)] = value
         if extra:
             for param, value in extra.items():
                 to._paramMap[to.getParam(param.name)] = value

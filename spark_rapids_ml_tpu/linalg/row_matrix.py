@@ -263,7 +263,10 @@ class RowMatrix:
         return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
     def _device(self):
-        devices = jax.devices()
+        # local_devices, not devices: under a multi-process gang the global
+        # list includes peers' non-addressable chips, and device_put to one
+        # of those raises. Identical in single-process runs.
+        devices = jax.local_devices()
         if self.device_id >= 0:
             return devices[self.device_id]
         return devices[0]

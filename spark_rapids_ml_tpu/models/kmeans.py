@@ -331,6 +331,11 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                     cosine=cosine, data_shards=shards,
                     precision=self.getPrecision(), mesh=self.mesh,
                 )
+                from spark_rapids_ml_tpu.parallel.distributed import (
+                    replicate_for_host,
+                )
+
+                centers = replicate_for_host(self.mesh, centers)
                 model = KMeansModel(
                     self.uid, centers[:, :d], trainingCost=cost, numIter=n_iter
                 )
@@ -378,6 +383,11 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                     precision=self.getPrecision(),
                 )
 
+        # Gang fits can hand back sharded results; host reads (the model's
+        # lazy float64 pulls) need them fully replicated on every member.
+        from spark_rapids_ml_tpu.parallel.distributed import replicate_for_host
+
+        centers = replicate_for_host(self.mesh, centers)
         # Strip model-axis feature padding (device slice, stays async);
         # host float64 conversion happens lazily inside KMeansModel.
         model = KMeansModel(

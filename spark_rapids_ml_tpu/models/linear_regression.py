@@ -367,6 +367,14 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
             if w_host is None and self.mesh is None:
                 mask = None
             stats = normal_eq_stats(xs, ys, mask, precision=prec)
+            # Gang deploy mode: the solve below reads the O(d²) statistics
+            # on the host — replicate them so every member solves the
+            # identical whole-dataset normal equations (no-op otherwise).
+            from spark_rapids_ml_tpu.parallel.distributed import (
+                replicate_for_host,
+            )
+
+            stats = replicate_for_host(self.mesh, *stats)
             coef, intercept = self._solve_from_stats(stats, d)
 
         # Solve outputs stay device-resident; the model's host float64

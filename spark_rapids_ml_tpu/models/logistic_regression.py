@@ -302,6 +302,18 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
         # class count defines shapes, so a sync is inherent; what never
         # happens is an O(n) pull of the label vector).
         y_int, n_classes = validate_int_labels(y_in)
+        import jax
+
+        if self.mesh is not None and jax.process_count() > 1:
+            # Gang deploy mode: each member counted classes from its LOCAL
+            # labels, but n_classes is a trace-time shape — members must
+            # agree globally or they trace different programs and deadlock
+            # in the first collective.
+            from spark_rapids_ml_tpu.parallel.distributed import (
+                allgather_host_max,
+            )
+
+            n_classes = allgather_host_max(n_classes)
         family = self.getFamily()
         if family == "auto":
             family = "binomial" if n_classes <= 2 else "multinomial"
@@ -391,12 +403,19 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                     multinomial=use_multinomial,
                     fused=fused,
                 )
+        # Gang fits can hand back sharded results; replicate them so every
+        # member's host reads see identical values.
+        from spark_rapids_ml_tpu.parallel.distributed import replicate_for_host
+
+        weights, intercepts = replicate_for_host(
+            self.mesh, result.weights, result.intercepts
+        )
         # Strip model-axis feature padding (device slice, stays async);
         # host float64 conversion happens lazily inside the model.
         model = LogisticRegressionModel(
             self.uid,
-            result.weights[:d],
-            result.intercepts,
+            weights[:d],
+            intercepts,
             numClasses=n_classes,
             numIter=result.n_iter,
         )

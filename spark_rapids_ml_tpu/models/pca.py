@@ -431,7 +431,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
                 k,
                 jax.random.key(0),
                 center=self.getMeanCentering(),
-                device=jax.devices()[gpu_id] if gpu_id >= 0 else None,
+                device=jax.local_devices()[gpu_id] if gpu_id >= 0 else None,
             )
             return self._copyValues(PCAModel(self.uid, comps, ratio))
         mask = None
@@ -471,10 +471,22 @@ class PCA(_PCAParams, Estimator, MLReadable):
 
             parts = as_partitions(rows)
             dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-            x, mask, n_true = shard_rows_from_partitions(
-                parts, self.mesh, dtype=np.dtype(dtype)
-            )
-            d = parts[0].shape[1]
+            if jax.process_count() > 1:
+                # Gang deploy mode: these partitions are one member's LOCAL
+                # rows — assemble the global sketch input through the
+                # process-local funnel (same masked-padding semantics).
+                from spark_rapids_ml_tpu.parallel.distributed import (
+                    shard_rows_process_local,
+                )
+
+                x, mask, n_true, d = shard_rows_process_local(
+                    parts, self.mesh, dtype=np.dtype(dtype)
+                )
+            else:
+                x, mask, n_true = shard_rows_from_partitions(
+                    parts, self.mesh, dtype=np.dtype(dtype)
+                )
+                d = parts[0].shape[1]
             if not 1 <= k <= min(n_true, d):
                 raise ValueError(f"k must be in [1, {min(n_true, d)}], got {k}")
             if x.shape[1] != d:
@@ -493,7 +505,11 @@ class PCA(_PCAParams, Estimator, MLReadable):
             # (RowMatrix._device); the sketch SEED stays fixed so the fitted
             # model never depends on placement.
             gpu_id = self.getGpuId()
-            device = jax.devices()[gpu_id] if gpu_id >= 0 else jax.devices()[0]
+            device = (
+                jax.local_devices()[gpu_id]
+                if gpu_id >= 0
+                else jax.local_devices()[0]
+            )
             # Guarded placement: the whole-dataset upload goes through the
             # ingest.device_put chokepoint (fault point, OOM retry + cache
             # reclaim) instead of a bare device_put.
@@ -508,6 +524,11 @@ class PCA(_PCAParams, Estimator, MLReadable):
             mask=mask,
             n_true=n_true,
         )
+        # Gang fits can hand back sharded results; the model's lazy host
+        # pulls need them fully replicated (no-op otherwise).
+        from spark_rapids_ml_tpu.parallel.distributed import replicate_for_host
+
+        comps, ratio = replicate_for_host(self.mesh, comps, ratio)
         model = PCAModel(self.uid, comps, ratio)
         return self._copyValues(model)
 

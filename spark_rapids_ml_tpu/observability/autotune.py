@@ -737,7 +737,18 @@ def configure(enable: Optional[bool] = None) -> Optional[Autotuner]:
         if enable:
             if _TUNER is None:
                 _costs.configure(enable=True)
-                store = TuneStore(env_str(TUNE_STORE_ENV))
+                store_path = env_str(TUNE_STORE_ENV)
+                proc = env_str("TPUML_PROCESS_ID")
+                if store_path and proc not in (None, "", "0"):
+                    # Gang members each persist to their OWN store file:
+                    # N processes committing through one path would race
+                    # the whole-file atomic rewrite (each process loads
+                    # decisions once at start, so the last writer drops
+                    # its peers' commits). Member 0 keeps the bare path —
+                    # the file tooling reads by default — and peers
+                    # suffix their rank.
+                    store_path = f"{store_path}.p{proc}"
+                store = TuneStore(store_path)
                 _TUNER = Autotuner(
                     store,
                     hot_min=env_int(HOT_MIN_ENV, DEFAULT_HOT_MIN, minimum=1),

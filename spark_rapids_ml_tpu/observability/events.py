@@ -99,6 +99,7 @@ SCHEMA: Dict[str, frozenset] = {
     "report": frozenset({"kind", "summary"}),
     "profile": frozenset({"action", "dir"}),
     "distributed": frozenset({"action"}),
+    "gang_fit": frozenset({"action"}),
     "persistence": frozenset({"action", "path"}),
     "telemetry": frozenset({"action", "path"}),
     "lockcheck": frozenset({"action", "lock"}),

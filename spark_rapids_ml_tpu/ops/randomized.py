@@ -207,7 +207,7 @@ def randomized_pca_streaming(
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     if device is None:
-        device = jax.devices()[0]
+        device = jax.local_devices()[0]
 
     # Pass 0 — moments: mean and centered total variance via a shifted
     # fp64 host accumulation (exact; the shift kills the cancellation a

@@ -369,6 +369,88 @@ def shard_rows_process_local(
     return xs, ms, n_true, d
 
 
+def shard_vector_process_local(
+    v_local, mesh: Mesh, n_pad_global: int, dtype=None
+) -> jax.Array:
+    """Place a per-process LOCAL vector (labels, sample weights) into the
+    GLOBAL ``P(data)`` layout of :func:`shard_rows_process_local`: that
+    function puts each process's true rows first in its contiguous
+    ``n_pad_global / process_count`` row block, so the companion vector
+    pads the same way and rides the same sharding — row i of the global
+    matrix and element i of the global vector always belong to the same
+    original sample.
+
+    ``n_pad_global`` is the padded global row count the matrix came back
+    with (``x.shape[0]``); the local values must fit this process's block.
+    """
+    v = np.asarray(v_local)
+    if dtype is not None:
+        v = v.astype(dtype, copy=False)
+    n_proc = jax.process_count()
+    if n_pad_global % n_proc != 0:
+        raise ValueError(
+            f"padded global length {n_pad_global} must divide evenly "
+            f"across {n_proc} processes"
+        )
+    per_proc = n_pad_global // n_proc
+    if v.shape[0] > per_proc:
+        raise ValueError(
+            f"local vector has {v.shape[0]} values but this process's row "
+            f"block holds {per_proc}; pass the rows and the vector from "
+            "the same local partitions"
+        )
+    pad = np.zeros((per_proc,) + v.shape[1:], dtype=v.dtype)
+    pad[: v.shape[0]] = v
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.make_array_from_process_local_data(
+        sharding, pad, (n_pad_global,) + v.shape[1:]
+    )
+
+
+def allgather_host_max(value) -> int:
+    """Global max of a per-process host scalar (one tiny allgather) —
+    e.g. the label-derived class count, which each gang member computes
+    from LOCAL labels but every member must agree on before tracing a
+    shape-dependent solver."""
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([int(value)], dtype=np.int64)
+    )
+    return int(np.asarray(gathered).max())
+
+
+@_functools.lru_cache(maxsize=4)
+def _replicate_identity_jit(mesh: Mesh):
+    """One cached jitted replicated-identity per mesh (same cache
+    discipline as :func:`_replicated_sum_jit`); the single P() sharding
+    broadcasts across however many outputs a call passes."""
+    return jax.jit(
+        lambda *xs: xs, out_shardings=NamedSharding(mesh, P())
+    )
+
+
+def replicate_for_host(mesh: Optional[Mesh], *arrays):
+    """Make fit results safe to read on the host from EVERY gang member.
+
+    Outputs of an SPMD fit over globally-sharded inputs can come back
+    row- or column-sharded; ``np.asarray`` on such an array raises (or
+    worse, sees one shard) on a multi-process runtime. This reshards each
+    array fully replicated — XLA lowers the move to an all-gather — so
+    the per-member model construction reads identical host values
+    everywhere. Identity when single-process (or mesh-less): the
+    monolithic path pays nothing.
+
+    Returns the arrays in order (a single array unwrapped).
+    """
+    if mesh is None or jax.process_count() <= 1 or not arrays:
+        return arrays if len(arrays) > 1 else arrays[0]
+    import jax.numpy as jnp
+
+    out = _replicate_identity_jit(mesh)(*[jnp.asarray(a) for a in arrays])
+    return tuple(out) if len(arrays) > 1 else out[0]
+
+
 def streaming_covariance_process_local(
     blocks, center: bool = True, dtype=None, precision: str = "highest",
     mesh: Optional[Mesh] = None, merge: str = "auto",
@@ -596,10 +678,13 @@ from spark_rapids_ml_tpu.robustness.checkpoint import (  # noqa: E402
 
 __all__ = [
     "GangReinitWarning",
+    "allgather_host_max",
     "initialize",
     "bringup_executor",
     "global_mesh",
+    "replicate_for_host",
     "replicate_state_onto_mesh",
     "shard_rows_process_local",
+    "shard_vector_process_local",
     "streaming_covariance_process_local",
 ]

@@ -44,7 +44,7 @@ def make_mesh(
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
-    device = device or jax.devices()[0]
+    device = device or jax.local_devices()[0]
     return Mesh(np.asarray([device]).reshape(1, 1), (DATA_AXIS, MODEL_AXIS))
 
 

@@ -206,7 +206,7 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
                 from spark_rapids_ml_tpu.ops.eigh import eigh_descending
 
                 ordinal = resolve_device_ordinal(self.getOrDefault(self.gpuId))
-                devices = jax.devices()
+                devices = jax.local_devices()
                 if ordinal >= len(devices):
                     raise ValueError(
                         f"gpuId/task resource resolved to chip {ordinal}, but only "

@@ -230,6 +230,111 @@ def gang_coordinates(ctx, port: int = DEFAULT_COORDINATOR_PORT) -> dict:
     }
 
 
+def _as_feature_row(value):
+    """One partition element as a dense numpy feature row (pyspark Vectors
+    expose ``toArray``; anything else must already be array-like)."""
+    import numpy as np
+
+    return np.asarray(
+        value.toArray() if hasattr(value, "toArray") else value,
+        dtype=np.float64,
+    )
+
+
+def _gang_extract(it, labeled: bool):
+    """Materialize one member's partition as its LOCAL fit dataset:
+    a (rows, d) matrix, or an ``(x, y)`` pair when ``labeled`` (elements
+    are (features, label) sequences — the ``select(features, label).rdd``
+    row shape)."""
+    import numpy as np
+
+    xs, ys = [], []
+    for r in it:
+        if labeled:
+            xs.append(_as_feature_row(r[0]))
+            ys.append(float(r[1]))
+        else:
+            xs.append(_as_feature_row(r[0] if isinstance(r, (tuple, list)) else r))
+    x = np.stack(xs) if xs else np.zeros((0, 0))
+    return (x, np.asarray(ys)) if labeled else x
+
+
+def gang_fit(
+    estimator,
+    rdd,
+    labeled: bool = False,
+    extract: Optional[Callable[[Iterator], object]] = None,
+    port: Optional[int] = None,
+    policy: Optional[RetryPolicy] = None,
+    checkpoint_dir: Optional[str] = None,
+) -> list:
+    """Fit ``estimator`` gang-parallel: one barrier stage, one gang member
+    per partition, each calling the PUBLIC ``fit()`` on its local rows.
+
+    This is the chip-per-executor deployment of the core estimators
+    (ROADMAP item 4) as a driver-side one-liner::
+
+        models = gang_fit(PCA().setK(2), df.rdd.map(lambda r: r[0]))
+
+    Per member: the partition materializes as that member's LOCAL dataset
+    (``labeled`` switches to (x, y) extraction; ``extract`` overrides the
+    whole mapping), :func:`gang_coordinates` derives the member's
+    jax.distributed coordinates from the barrier roster, and — for gangs
+    of more than one member — they export as the ``TPUML_COORDINATOR`` /
+    ``TPUML_NUM_PROCESSES`` / ``TPUML_PROCESS_ID`` knobs for the fit's
+    lifetime. The member then copies the estimator, sets
+    ``deployMode='gang'``, and calls ``fit`` — ``Estimator._join_gang``
+    brings up the cohort, the ingest funnel assembles the globally
+    sharded array, and the solver's reductions psum across members, so
+    every member returns the identical whole-dataset model (the driver
+    conventionally keeps ``models[0]``).
+
+    All of :func:`barrier_gang_run`'s machinery rides along unchanged:
+    whole-stage relaunch with fresh coordinator ports per attempt, the
+    trace/telemetry carrier (each member writes its own shard; the merged
+    trace shows one gang fit), per-member heartbeats, and the
+    ``checkpoint_dir`` elastic-resume handoff. ``port`` defaults to the
+    ``TPUML_GANG_PORT`` knob. NOTE: the contract stub runs barrier tasks
+    sequentially on the driver, so only single-member gangs (one
+    partition) are testable under the stub — a multi-member stub gang
+    would deadlock in the bring-up; real clusters schedule members
+    concurrently (tests/multiproc_gang_fit_worker.py is the real
+    2-process proof).
+    """
+    if port is None:
+        port = env_int("TPUML_GANG_PORT", DEFAULT_COORDINATOR_PORT, minimum=1)
+    do_extract = extract if extract is not None else (
+        lambda it: _gang_extract(it, labeled)
+    )
+
+    def task(ctx, it):
+        local = do_extract(it)
+        gang_env = {}
+        if ctx is not None and hasattr(ctx, "getTaskInfos"):
+            coords = gang_coordinates(ctx, port)
+            if int(coords["num_processes"]) > 1:
+                gang_env = {
+                    "TPUML_COORDINATOR": coords["coordinator_address"],
+                    "TPUML_NUM_PROCESSES": str(coords["num_processes"]),
+                    "TPUML_PROCESS_ID": str(coords["process_id"]),
+                }
+        saved = {k: os.environ.get(k) for k in gang_env}
+        os.environ.update(gang_env)
+        try:
+            member = estimator.copy().setDeployMode("gang")
+            return [member.fit(local)]
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    return barrier_gang_run(
+        rdd, task, policy=policy, checkpoint_dir=checkpoint_dir
+    )
+
+
 def serving_gang_run(
     rdd,
     rendezvous: str,

@@ -66,6 +66,14 @@ KNOBS: Dict[str, Knob] = _knob_table(
          "this process's rank in the gang (also stamps event envelopes)"),
     Knob("TPUML_HEARTBEAT_TIMEOUT", "int", "distributed",
          "seconds before a dead peer fails survivors' collectives"),
+    # gang deploy mode (public fit() through a barrier stage)
+    Knob("TPUML_GANG_FIT", "choice", "distributed",
+         "1 routes Estimator.fit through gang deploy mode (each process "
+         "feeds its local rows; collectives merge) — the env twin of "
+         "setDeployMode('gang')", default="0", choices=("0", "1")),
+    Knob("TPUML_GANG_PORT", "int", "distributed",
+         "base coordinator port gang_fit derives member coordinates "
+         "from (stage attempt number offsets it)", default=8476),
     # robustness: fault injection / retry / degradation
     Knob("TPUML_FAULTS", "str", "robustness",
          "deterministic fault-injection spec (site=N[:fatal|:torn];...)"),
@@ -234,6 +242,14 @@ KNOBS: Dict[str, Knob] = _knob_table(
          "client thread count for the server benchmark"),
     Knob("TPUML_BENCH_REQUESTS", "int", "benchmarks",
          "per-thread request count for the server benchmark"),
+    Knob("TPUML_BENCH_GANG_MEMBER", "choice", "benchmarks",
+         "1 marks a config20 process as a spawned gang member (internal "
+         "to the benchmark's self-spawn protocol)",
+         default="0", choices=("0", "1")),
+    Knob("TPUML_BENCH_GANG_CORES", "str", "benchmarks",
+         "comma-separated CPU core list a config20 gang member pins "
+         "itself to (holds per-member silicon constant across the "
+         "1->2-process sweep)"),
 )
 
 

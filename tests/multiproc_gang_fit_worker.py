@@ -1,0 +1,138 @@
+"""Worker for the REAL 2-process gang-fit acceptance test (gang deploy
+mode through the public estimator API).
+
+Launched N times by tests/test_gang_fit.py with TPUML_COORDINATOR /
+TPUML_NUM_PROCESSES / TPUML_PROCESS_ID in the environment — the member
+coordinates a barrier stage (spark/barrier.py::gang_fit) exports. Unlike
+tests/multiproc_pca_worker.py this worker never calls dist.initialize()
+itself: ``setDeployMode("gang")`` on a plain estimator must do the whole
+bring-up (join the gang, build the global mesh, shard the LOCAL rows into
+the global batch) inside ``fit()``. Each member holds a different slice
+of a deterministic global dataset; the fitted models must match the
+single-process full-data fit at the documented tolerances:
+
+  - PCA / LinearRegression: deterministic merges (moment psum order is
+    fixed) — 1e-6 under x64;
+  - KMeans with a pinned initial model: assignments are stable on
+    separated blobs — 1e-6;
+  - LogisticRegression: L-BFGS amplifies summation-order noise — 1e-3.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:  # newer jax: gloo is the default, the knob may be gone
+    pass
+_x64 = os.environ.get("TPUML_TEST_NO_X64") != "1"
+jax.config.update("jax_enable_x64", _x64)
+
+from spark_rapids_ml_tpu.utils.envknobs import env_int
+
+
+def main() -> None:
+    n_proc = env_int("TPUML_NUM_PROCESSES")
+    pid = env_int("TPUML_PROCESS_ID")
+
+    # Deterministic global dataset; every member derives the same one and
+    # takes a DIFFERENT (deliberately uneven) slice as its local data.
+    rng = np.random.default_rng(0)
+    n = int(os.environ.get("TPUML_TEST_ROWS", "403"))
+    d = int(os.environ.get("TPUML_TEST_D", "8"))
+    dtype = np.float64 if _x64 else np.float32
+    x = (rng.normal(size=(n, d)) * np.linspace(1.0, 2.0, d)).astype(dtype)
+    bounds = np.linspace(0, n, n_proc + 1).astype(int)
+    local = x[bounds[pid] : bounds[pid + 1]]
+
+    tol = 1e-6 if _x64 else 1e-3
+    iter_tol = 1e-3 if _x64 else 3e-2  # L-BFGS amplifies sum-order noise
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.regression import LinearRegression
+    from spark_rapids_ml_tpu.utils.testing import assert_components_close
+
+    # --- PCA: the FIRST gang fit does the entire bring-up ---------------
+    model = PCA().setK(3).setDeployMode("gang").fit([local])
+    assert jax.process_count() == n_proc, jax.process_count()
+    assert jax.process_index() == pid, jax.process_index()
+    ref = PCA().setK(3).setDeployMode("single").fit([x])
+    assert_components_close(model.pc, np.asarray(ref.pc), tol)
+    np.testing.assert_allclose(
+        model.explainedVariance, ref.explainedVariance, atol=tol
+    )
+    print(f"PCA_OK {pid}")
+
+    # --- LinearRegression via the TPUML_GANG_FIT env twin ---------------
+    beta = np.arange(1.0, d + 1.0, dtype=dtype)
+    y = x @ beta + 0.01 * rng.normal(size=n).astype(dtype)
+    y_local = y[bounds[pid] : bounds[pid + 1]]
+    os.environ["TPUML_GANG_FIT"] = "1"
+    try:
+        lm = LinearRegression().fit((local, y_local))
+    finally:
+        del os.environ["TPUML_GANG_FIT"]
+    lref = LinearRegression().setDeployMode("single").fit((x, y))
+    np.testing.assert_allclose(
+        np.asarray(lm.coefficients), np.asarray(lref.coefficients), atol=tol
+    )
+    np.testing.assert_allclose(lm.intercept, lref.intercept, atol=tol)
+    print(f"LINEAR_OK {pid}")
+
+    # --- LogisticRegression: psum'd fused loss+grad ----------------------
+    y_cls = (x[:, 0] + 0.25 * x[:, 1] > 0).astype(dtype)
+    clf = (
+        LogisticRegression()
+        .setMaxIter(60)
+        .setDeployMode("gang")
+        .fit((local, y_cls[bounds[pid] : bounds[pid + 1]]))
+    )
+    cref = (
+        LogisticRegression().setMaxIter(60).setDeployMode("single")
+        .fit((x, y_cls))
+    )
+    np.testing.assert_allclose(
+        np.asarray(clf.coefficients), np.asarray(cref.coefficients),
+        atol=iter_tol,
+    )
+    assert np.array_equal(np.asarray(clf.predict(x)), np.asarray(cref.predict(x)))
+    print(f"LOGISTIC_OK {pid}")
+
+    # --- KMeans: per-member assign+stats, psum'd centers ------------------
+    blobs = np.concatenate(
+        [
+            rng.normal(loc=-4.0, scale=0.3, size=(n // 2, d)),
+            rng.normal(loc=4.0, scale=0.3, size=(n - n // 2, d)),
+        ]
+    ).astype(dtype)
+    perm = rng.permutation(n)  # interleave so every slice sees both blobs
+    blobs = blobs[perm]
+    init = np.stack([blobs[0], blobs[1]])  # pinned: init is row-position
+    km = (
+        KMeans().setK(2).setMaxIter(10).setInitialModel(init)
+        .setDeployMode("gang").fit(blobs[bounds[pid] : bounds[pid + 1]])
+    )
+    kref = (
+        KMeans().setK(2).setMaxIter(10).setInitialModel(init)
+        .setDeployMode("single").fit(blobs)
+    )
+    np.testing.assert_allclose(
+        np.asarray(km.clusterCenters()), np.asarray(kref.clusterCenters()),
+        atol=tol,
+    )
+    print(f"KMEANS_OK {pid}")
+
+    print(f"OK process {pid}/{n_proc}")
+
+
+if __name__ == "__main__":
+    main()

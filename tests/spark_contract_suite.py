@@ -1178,3 +1178,128 @@ class TestBarrierGangRecovery:
         host1, _, port1 = addr_attempt1.rpartition(":")
         assert host1 == host0
         assert int(port1) == int(port0) + 1  # port + attempt
+
+
+class TestGangFitPublicAPI:
+    """The hand-written per-partition moments gangs above, MIGRATED to
+    the public API: ``spark.barrier.gang_fit`` runs one barrier stage
+    whose members each call the ordinary ``Estimator.fit`` with
+    ``deployMode='gang'``. The stub (and local-master pyspark) runs
+    barrier tasks sequentially in one process, so these drive
+    SINGLE-member gangs (one partition) — the full member lifecycle
+    (coordinate derivation, deploy-mode switch, carrier/telemetry
+    propagation, whole-stage relaunch) minus the cross-process
+    collectives; tests/multiproc_gang_fit_worker.py proves those.
+    Single-member merges are order-deterministic, so parity with the
+    single-process fit holds to near-machine tolerance (1e-12 — the
+    member's rows arrive as re-stacked partition blocks, whose GEMM
+    blocking differs from the monolithic array in the last bit)."""
+
+    def test_gang_fit_linear_matches_single_process(self, spark_env, rng):
+        adapter, spark = spark_env
+        from spark_rapids_ml_tpu.regression import LinearRegression
+        from spark_rapids_ml_tpu.spark.barrier import gang_fit
+
+        n, d = 120, 5
+        x = rng.normal(size=(n, d))
+        y = x @ rng.normal(size=d) + 0.01 * rng.normal(size=n)
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=1)
+
+        models = gang_fit(
+            LinearRegression(), df.select("features", "label").rdd,
+            labeled=True,
+        )
+        assert len(models) == 1
+        ref = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(
+            np.asarray(models[0].coefficients),
+            np.asarray(ref.coefficients), atol=1e-12, rtol=0,
+        )
+        np.testing.assert_allclose(
+            models[0].intercept, ref.intercept, atol=1e-12, rtol=0
+        )
+
+    def test_gang_fit_pca_merged_trace_strict_clean(
+        self, spark_env, rng, tmp_path, monkeypatch
+    ):
+        """One gang fit through the public API leaves ONE merged trace
+        that assembles strict-clean (no problems, no orphans): the
+        barrier stage span, the member's fit run, and the gang_fit join
+        events all share the driver's trace id."""
+        adapter, spark = spark_env
+        from spark_rapids_ml_tpu.feature import PCA
+        from spark_rapids_ml_tpu.observability import events
+        from spark_rapids_ml_tpu.observability import trace as tracelib
+        from spark_rapids_ml_tpu.spark.barrier import gang_fit
+
+        tdir = tmp_path / "telemetry"
+        monkeypatch.setenv(events.TELEMETRY_DIR_ENV, str(tdir))
+        events.configure()
+        try:
+            x = rng.normal(size=(90, 6)) * np.linspace(1, 2, 6)
+            df = _vector_df(spark, x, n_parts=1)
+            models = gang_fit(PCA().setK(2), df.select("features").rdd)
+            events.flush_telemetry()
+        finally:
+            monkeypatch.delenv(events.TELEMETRY_DIR_ENV)
+            events.configure()
+
+        ref = PCA().setK(2).fit([x])
+        np.testing.assert_allclose(
+            np.asarray(models[0].pc), np.asarray(ref.pc),
+            atol=1e-12, rtol=0,
+        )
+
+        merged = tracelib.assemble(str(tdir))
+        assert merged["problems"] == []
+        assert merged["orphan_problems"] == []
+        assert len(merged["traces"]) == 1
+        (cell,) = merged["traces"].values()
+        names = {
+            s["name"] for s in merged["trace_cells"][cell["trace_id"]]["spans"]
+        }
+        assert "barrier gang" in names
+        joins = [
+            r for r in merged["trace_cells"][cell["trace_id"]]["events"]
+            if r["event"] == "gang_fit"
+        ]
+        assert any(r.get("action") == "join" for r in joins)
+        # The tpuml_trace CLI itself is exercised against a gang-fit shard
+        # set (strict, as a subprocess) by the 2-process acceptance test
+        # in tests/test_gang_fit.py and by the CI "Gang fit" step; no need
+        # to pay a second interpreter bring-up here.
+
+    def test_gang_fit_relaunches_whole_stage_and_refits(
+        self, spark_env, rng, tmp_path
+    ):
+        """The recovery story of TestBarrierGangRecovery, through the
+        public surface: a member that dies on its first attempt relaunches
+        the whole stage and the REFIT through fit() comes out correct."""
+        import os
+
+        adapter, spark = spark_env
+        from spark_rapids_ml_tpu.regression import LinearRegression
+        from spark_rapids_ml_tpu.spark.barrier import _gang_extract, gang_fit
+
+        n, d = 100, 4
+        x = rng.normal(size=(n, d))
+        y = x @ rng.normal(size=d)
+        df = _vector_df(spark, x, extra={"label": list(y)}, n_parts=1)
+        sentinel = str(tmp_path / "gang_fit_fault")
+
+        def extract(it):
+            if not os.path.exists(sentinel):
+                open(sentinel, "w").close()
+                raise RuntimeError("injected member death mid-extract")
+            return _gang_extract(it, labeled=True)
+
+        models = gang_fit(
+            LinearRegression(), df.select("features", "label").rdd,
+            extract=extract,
+        )
+        assert os.path.exists(sentinel)
+        ref = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(
+            np.asarray(models[0].coefficients),
+            np.asarray(ref.coefficients), atol=1e-12, rtol=0,
+        )

@@ -1,0 +1,253 @@
+"""Gang deploy mode (gang-parallel fit through the public estimator API).
+
+Three tiers of proof:
+
+  - SINGLE-member gangs in-process: ``setDeployMode("gang")`` (and its
+    ``TPUML_GANG_FIT`` env twin) routes through the same fit path and
+    must reproduce the single-deploy model exactly — no jax.distributed
+    bring-up for a gang of one;
+  - the autotuner TUNE-STORE under gangs: N members persisting through
+    one path lose commits to the whole-file atomic rewrite (the race,
+    demonstrated), so ``autotune.configure`` gives every non-zero rank
+    its own ``.p<rank>`` store (the fix, counter-asserted);
+  - the ACCEPTANCE case: a REAL 2-process gang (jax.distributed over
+    gloo) where each member feeds only ITS rows to the public ``fit()``
+    and the fitted PCA / linear / logistic / KMeans models match the
+    single-process full-data fit at the documented tolerances, with the
+    members' telemetry shards merging into one strict-clean trace.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+TRACE_CLI = REPO / "tools" / "tpuml_trace.py"
+
+
+# --- single-member gangs (the in-process contract) ----------------------
+
+
+class TestSingleMemberGang:
+    def test_deploy_mode_param_and_env_twin(self, monkeypatch):
+        from spark_rapids_ml_tpu.feature import PCA
+
+        est = PCA()
+        assert est.getDeployMode() == "single"
+        est.setDeployMode("gang")
+        assert est.getDeployMode() == "gang"
+        with pytest.raises(ValueError):
+            est.setDeployMode("fleet")
+        # The env twin covers estimators the caller can't reach (inside
+        # pipelines/tuners); an explicit param outranks it.
+        monkeypatch.setenv("TPUML_GANG_FIT", "1")
+        assert PCA().getDeployMode() == "gang"
+        assert PCA().setDeployMode("single").getDeployMode() == "single"
+
+    def test_gang_of_one_matches_single_deploy(self, rng):
+        """deployMode='gang' without gang env is a gang of one: same
+        model to near-machine tolerance (the gang path computes over the
+        local mesh, whose GEMM blocking differs in the last bit), and no
+        jax.distributed bring-up (process_count stays 1)."""
+        import jax
+
+        from spark_rapids_ml_tpu.clustering import KMeans
+        from spark_rapids_ml_tpu.feature import PCA
+        from spark_rapids_ml_tpu.regression import LinearRegression
+
+        x = rng.normal(size=(80, 6))
+        m = PCA().setK(3).setDeployMode("gang").fit([x[:30], x[30:]])
+        ref = PCA().setK(3).fit([x[:30], x[30:]])
+        np.testing.assert_allclose(
+            np.asarray(m.pc), np.asarray(ref.pc), atol=1e-12, rtol=0
+        )
+        assert jax.process_count() == 1
+
+        y = x @ np.arange(1.0, 7.0)
+        lm = LinearRegression().setDeployMode("gang").fit((x, y))
+        lref = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(
+            np.asarray(lm.coefficients), np.asarray(lref.coefficients),
+            atol=1e-12, rtol=0,
+        )
+
+        km = KMeans().setK(2).setSeed(0).setDeployMode("gang").fit(x)
+        kref = KMeans().setK(2).setSeed(0).fit(x)
+        np.testing.assert_allclose(
+            np.asarray(km.clusterCenters()),
+            np.asarray(kref.clusterCenters()), atol=1e-12, rtol=0,
+        )
+
+    def test_deploy_mode_not_copied_onto_model(self, rng):
+        """deployMode is an ESTIMATOR param: _copyValues must not push it
+        onto the fitted model (Spark only copies params the target has)."""
+        from spark_rapids_ml_tpu.feature import PCA
+
+        x = rng.normal(size=(40, 5))
+        model = PCA().setK(2).setDeployMode("gang").fit([x])
+        assert not model.hasParam("deployMode")
+        model.copy()  # and the model stays copyable
+
+    def test_copy_preserves_deploy_mode(self):
+        """Tuners/pipelines fit COPIES — the gang switch must survive."""
+        from spark_rapids_ml_tpu.feature import PCA
+
+        est = PCA().setK(2).setDeployMode("gang")
+        assert est.copy().getDeployMode() == "gang"
+
+
+# --- the tune-store under gangs (the race + the fix) --------------------
+
+
+class TestGangTuneStore:
+    def _decision(self, knob, ident, value):
+        return {"knob": knob, "key": ident, "value": value}
+
+    def test_shared_path_loses_commits_last_writer_wins(self, tmp_path):
+        """The RACE, demonstrated: two members (two TuneStore instances,
+        as two processes would hold) committing through ONE path — each
+        loaded the store before the other's commit, so the second
+        whole-file rewrite drops the first member's decision."""
+        from spark_rapids_ml_tpu.observability.autotune import TuneStore
+
+        path = str(tmp_path / "tune.json")
+        member0 = TuneStore(path)
+        member1 = TuneStore(path)
+        member0.put(self._decision("batch", "pca/f64", 256))
+        member1.put(self._decision("batch", "kmeans/f64", 512))
+        persisted = json.load(open(path))["decisions"]
+        assert len(persisted) == 1  # member0's commit is GONE
+
+    def test_configure_gives_each_rank_its_own_store(
+        self, tmp_path, monkeypatch
+    ):
+        """The FIX, counter-asserted: under gang env, configure() routes
+        every non-zero rank to <path>.p<rank> (member 0 keeps the bare
+        path the file tooling reads), so N members' commits all survive —
+        total persisted decisions equals total commits."""
+        from spark_rapids_ml_tpu.observability import autotune
+        from spark_rapids_ml_tpu.observability import costs
+
+        path = str(tmp_path / "tune.json")
+        monkeypatch.setenv(autotune.AUTOTUNE_ENV, "on")
+        monkeypatch.setenv(autotune.TUNE_STORE_ENV, path)
+        stores = {}
+        try:
+            for rank in ("0", "1"):
+                monkeypatch.setenv("TPUML_PROCESS_ID", rank)
+                autotune.reset_for_tests()
+                stores[rank] = autotune.active().store
+        finally:
+            monkeypatch.delenv("TPUML_PROCESS_ID")
+            monkeypatch.delenv(autotune.AUTOTUNE_ENV)
+            monkeypatch.delenv(autotune.TUNE_STORE_ENV)
+            autotune.reset_for_tests()
+            # Arming the tuner armed the cost ledger as a side effect
+            # (autotune.configure -> costs.configure(enable=True));
+            # resetting autotune does NOT disarm it, and a live ledger
+            # flips serving admission from declared-spec to measured
+            # pricing for every later test in the process.
+            costs.configure(enable=False)
+
+        assert stores["0"].path == path
+        assert stores["1"].path == f"{path}.p1"
+        stores["0"].put(self._decision("batch", "pca/f64", 256))
+        stores["1"].put(self._decision("batch", "kmeans/f64", 512))
+        committed = 2
+        persisted = sum(
+            len(json.load(open(p))["decisions"])
+            for p in (path, f"{path}.p1")
+        )
+        assert persisted == committed  # nobody's commit was dropped
+
+
+# --- the acceptance case: a REAL 2-process gang fit ---------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestTwoProcessGangFit:
+    def test_two_process_gang_fit_matches_single_process(self, tmp_path):
+        """ISSUE 15 acceptance: 2 OS processes (jax.distributed, gloo on
+        CPU), each feeding only ITS slice through the PUBLIC fit() with
+        deployMode='gang', produce PCA / linear / logistic / KMeans
+        models matching the single-process full-data fit — and their
+        telemetry shards merge into ONE strict-clean trace."""
+        from spark_rapids_ml_tpu.observability import events
+        from spark_rapids_ml_tpu.observability import trace as tracelib
+
+        tdir = tmp_path / "telemetry"
+        n_proc = 2
+        port = _free_port()
+        carrier = events.inject_env({})
+        procs = []
+        for pid in range(n_proc):
+            env = {
+                **os.environ,
+                **carrier,
+                "JAX_PLATFORMS": "cpu",
+                "JAX_ENABLE_X64": "1",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "TPUML_COORDINATOR": f"127.0.0.1:{port}",
+                "TPUML_NUM_PROCESSES": str(n_proc),
+                "TPUML_PROCESS_ID": str(pid),
+                "TPUML_TELEMETRY_DIR": str(tdir),
+            }
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        str(REPO / "tests" / "multiproc_gang_fit_worker.py"),
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    env=env,
+                    cwd=str(REPO),
+                )
+            )
+        outs = [p.communicate(timeout=500) for p in procs]
+        for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {pid} failed:\n{err[-3000:]}"
+            for family in ("PCA", "LINEAR", "LOGISTIC", "KMEANS"):
+                assert f"{family}_OK {pid}" in out, out
+            assert f"OK process {pid}/{n_proc}" in out
+
+        # The members' shards merge into ONE trace (the driver carrier),
+        # every span's parent resolvable, both processes represented.
+        merged = tracelib.assemble(str(tdir))
+        assert merged["problems"] == [], merged["problems"]
+        assert merged["orphan_problems"] == [], merged["orphan_problems"]
+        assert len(merged["manifests"]) == n_proc
+        assert len(merged["traces"]) == 1
+        (cell,) = merged["traces"].values()
+        assert cell["trace_id"] == carrier[events.TRACE_ID_ENV]
+        assert cell["processes"] == [0, 1]
+        assert cell["orphans"] == []
+        # Every family's fit ran AS a gang on both members.
+        joins = [
+            r
+            for r in merged["trace_cells"][cell["trace_id"]]["events"]
+            if r["event"] == "gang_fit" and r.get("action") == "join"
+        ]
+        assert {r["process"] for r in joins} == {0, 1}
+        assert len(joins) >= 2 * 4  # 4 gang fits per member
+
+        # The CLI is the oracle: strict validation stays green.
+        r = subprocess.run(
+            [sys.executable, str(TRACE_CLI), str(tdir),
+             "--validate", "--strict"],
+            capture_output=True, text=True, cwd=str(REPO),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert r.returncode == 0, r.stdout + r.stderr

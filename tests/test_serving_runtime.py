@@ -314,7 +314,9 @@ def test_shed_on_memory_budget_and_release(models):
     # Completion releases the reservation: after the drain, fresh
     # requests are admitted again.
     rt.start()
-    time.sleep(0.2)
+    deadline = time.monotonic() + 30.0
+    while rt.snapshot()["reserved_bytes"] != 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
     assert rt.snapshot()["reserved_bytes"] == 0
     fut = rt.submit("km", np.zeros(D))
     assert fut.result(timeout=30) is not None

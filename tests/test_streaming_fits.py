@@ -187,9 +187,9 @@ class TestStreamingBoundedMemory:
         return growth_kb
 
     def test_kmeans_streaming_bounded_rss(self):
-        # 2M x 64 f64 = 1.0 GB if materialized; blocks are recomputed on
-        # demand so RSS growth must stay a small multiple of one block
-        # (16 MB) + compile workspace.
+        # 48 x 32768 x 64 f64 = 0.75 GB if materialized; blocks are
+        # recomputed on demand so RSS growth must stay a small multiple
+        # of one block (16 MB) + compile workspace.
         script = f"""
 import resource, sys
 sys.path.insert(0, {REPO!r})
@@ -198,22 +198,28 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 from spark_rapids_ml_tpu.clustering import KMeans
 
-n_blocks, bs, d = 64, 32768, 64
+n_blocks, bs, d = 48, 32768, 64
 def blocks():
     for i in range(n_blocks):
         rng = np.random.default_rng(200 + i)
         yield rng.normal(size=(bs, d)) + (i % 4) * 8.0
 
 base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-model = KMeans().setK(4).setMaxIter(5).fit(blocks)
+model = KMeans().setK(4).setMaxIter(3).fit(blocks)
 peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 assert model.clusterCenters().shape == (4, d)
 print("GROWTH_KB", peak - base)
 """
         growth_kb = self._run(script)
-        assert growth_kb < 400_000, f"RSS grew {growth_kb} kB (dataset is 1 GB)"
+        assert growth_kb < 400_000, (
+            f"RSS grew {growth_kb} kB (dataset is 0.75 GB)"
+        )
 
     def test_logreg_streaming_bounded_rss(self):
+        # 48 x 32768 x 64 f64 = 0.75 GB if materialized; the L-BFGS path
+        # re-streams every block per evaluation, so iteration count is
+        # the wall-clock knob — 8 is past convergence on this separable
+        # data and keeps the RSS property (growth << dataset) intact.
         script = f"""
 import resource, sys
 sys.path.insert(0, {REPO!r})
@@ -222,7 +228,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 from spark_rapids_ml_tpu.classification import LogisticRegression
 
-n_blocks, bs, d = 64, 32768, 64
+n_blocks, bs, d = 48, 32768, 64
 rng_w = np.random.default_rng(0)
 w = rng_w.normal(size=(d,))
 def blocks():
@@ -239,7 +245,7 @@ def labels():
 
 y = labels()
 base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-model = LogisticRegression().setRegParam(0.01).setMaxIter(20).fit((blocks, y))
+model = LogisticRegression().setRegParam(0.01).setMaxIter(8).fit((blocks, y))
 peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 assert model.weights.shape == (d, 1)
 acc = model.evaluate((np.asarray(next(blocks())), y[:bs]))["accuracy"]
@@ -247,4 +253,6 @@ assert acc > 0.9, acc
 print("GROWTH_KB", peak - base)
 """
         growth_kb = self._run(script)
-        assert growth_kb < 400_000, f"RSS grew {growth_kb} kB (dataset is 1 GB)"
+        assert growth_kb < 400_000, (
+            f"RSS grew {growth_kb} kB (dataset is 0.75 GB)"
+        )
