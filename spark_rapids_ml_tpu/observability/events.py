@@ -100,6 +100,8 @@ SCHEMA: Dict[str, frozenset] = {
     "profile": frozenset({"action", "dir"}),
     "distributed": frozenset({"action"}),
     "gang_fit": frozenset({"action"}),
+    "elastic": frozenset({"action"}),
+    "gang_resize": frozenset({"action", "from_members", "to_members"}),
     "persistence": frozenset({"action", "path"}),
     "telemetry": frozenset({"action", "path"}),
     "lockcheck": frozenset({"action", "lock"}),

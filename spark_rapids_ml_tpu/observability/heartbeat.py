@@ -51,15 +51,23 @@ class GangHeartbeat:
     """
 
     def __init__(self, process_id: int = 0, interval: Optional[float] = None,
-                 what: str = "gang"):
+                 what: str = "gang", manual: bool = False):
         self.process_id = int(process_id)
         self.interval = heartbeat_interval() if interval is None else float(interval)
         self.what = what
+        # Manual mode: no beat thread — the OWNER's loop calls beat(), so
+        # the age gauge measures THAT loop's liveness, not a thread that
+        # would happily keep beating while the loop is wedged. Beats can
+        # arrive much faster than ``interval``; heartbeat EVENTS are
+        # throttled to one per interval (0 disables events entirely, the
+        # same contract as the threaded mode — the gauge stays live).
+        self.manual = bool(manual)
         # The beat thread and the caller's thread (beat 1, stop, gauge
         # scrapes) both touch the beat state: one lock owns it.
         self._lock = make_lock("heartbeat.state")
         self.seq = 0  # guarded-by: _lock
         self._last = time.monotonic()  # guarded-by: _lock
+        self._last_emit = float("-inf")  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._registered = False
@@ -74,8 +82,13 @@ class GangHeartbeat:
         # its own locking and must not nest inside ours.
         with self._lock:
             self.seq += 1
-            self._last = time.monotonic()
+            now = time.monotonic()
+            self._last = now
             seq = self.seq
+            if self.manual:
+                if self.interval <= 0 or now - self._last_emit < self.interval:
+                    return
+                self._last_emit = now
         emit(
             "heartbeat",
             seq=seq,
@@ -85,13 +98,17 @@ class GangHeartbeat:
         )
 
     def start(self) -> "GangHeartbeat":
-        if self.interval <= 0 or self._thread is not None:
+        if self._thread is not None or (not self.manual and self.interval <= 0):
+            return self
+        if self._registered:
             return self
         gauge(
             AGE_GAUGE, "seconds since this process's last gang heartbeat"
         ).set_function(self.age_seconds, process=str(self.process_id))
         self._registered = True
         self.beat()  # beat 1 lands immediately: liveness from t=0
+        if self.manual:
+            return self  # the owner's loop beats from here on
 
         def _loop():
             while not self._stop.wait(self.interval):
@@ -122,9 +139,9 @@ class GangHeartbeat:
 
 @contextlib.contextmanager
 def heartbeat_scope(process_id: int = 0, interval: Optional[float] = None,
-                    what: str = "gang"):
+                    what: str = "gang", manual: bool = False):
     """Heartbeats for the duration of a block (the barrier task body)."""
-    hb = GangHeartbeat(process_id, interval, what=what)
+    hb = GangHeartbeat(process_id, interval, what=what, manual=manual)
     hb.start()
     try:
         yield hb

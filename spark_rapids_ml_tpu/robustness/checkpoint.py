@@ -113,10 +113,26 @@ def params_hash(instance) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()
 
 
+#: Fixed-point scale for fingerprint quantization: 2**13 keeps dyadic
+#: test data exact and any realistic feature magnitude inside int32.
+_FP_SCALE = 8192.0
+
+
 def data_fingerprint(*arrays) -> str:
-    """Cheap deterministic fingerprint of the fit inputs: shape, dtype,
-    per-column sums, and (when the array is fully addressable) sampled
-    rows — O(n·d) reduction work on device, O(d) bytes pulled to host.
+    """Cheap deterministic fingerprint of the fit inputs — and a
+    SHARDING-INVARIANT one, so a gang resumed on a DIFFERENT member
+    count recognizes its own checkpoint.
+
+    Per array: trailing dims + dtype (the leading row axis is elided —
+    padding to a mesh multiple varies with the member count), then three
+    per-column integer moments of the fixed-point-quantized values.
+    Integer reductions are associative, so the digest is bit-stable
+    under any resharding or reduction order, and zero pad rows
+    contribute zero to every moment — two worlds padding the same
+    logical rows differently still agree. The moments are
+    row-permutation-invariant on purpose: the full-batch solvers
+    checkpointed here (Lloyd, L-BFGS, FISTA) are themselves
+    row-order-invariant, while any CONTENT change moves a moment.
     A checkpoint from different data must never be resumed: the solver
     state would be valid algebra over the wrong dataset."""
     import jax.numpy as jnp
@@ -127,19 +143,39 @@ def data_fingerprint(*arrays) -> str:
             h.update(b"<none>")
             continue
         a_shape = tuple(getattr(a, "shape", ()))
-        h.update(repr((a_shape, str(getattr(a, "dtype", "?")))).encode())
+        h.update(
+            repr(("*",) + a_shape[1:] + (str(getattr(a, "dtype", "?")),)).encode()
+        )
         if not a_shape:
             h.update(np.asarray(a, dtype=np.float64).tobytes())
             continue
-        # Column sums survive sharding (a global-array reduction works on
-        # every process); row samples need addressable rows.
-        sums = np.asarray(jnp.sum(jnp.asarray(a), axis=0), dtype=np.float64)
-        h.update(sums.tobytes())
-        if getattr(a, "is_fully_addressable", True):
-            n = a_shape[0]
-            for i in {0, n // 2, n - 1}:
-                h.update(np.asarray(a[i], dtype=np.float64).tobytes())
+        # Quantize (clip + nan-scrub keeps the float->int conversion
+        # defined), then integer column moments — exact on device, O(d)
+        # bytes to host, and a global-array reduction works on every
+        # process of a multi-controller gang.
+        q = jnp.round(
+            jnp.nan_to_num(
+                jnp.clip(
+                    jnp.asarray(a).astype(jnp.float32) * _FP_SCALE,
+                    -(2.0 ** 30), 2.0 ** 30,
+                )
+            )
+        ).astype(jnp.int32)
+        for moment in (q, q * q, q * q * q):
+            col = jnp.sum(moment, axis=0, dtype=jnp.int32)
+            h.update(np.asarray(col, dtype=np.int64).tobytes())
     return h.hexdigest()
+
+
+def _world_size() -> int:
+    """The gang's member count as THIS process sees it (1 outside any
+    distributed bring-up)."""
+    import jax
+
+    try:
+        return int(jax.process_count())
+    except Exception:  # pragma: no cover - uninitialized backends
+        return 1
 
 
 def _tree_flatten(state) -> Tuple[list, Any]:
@@ -269,6 +305,18 @@ class FitCheckpointer:
             bump_counter("checkpoint.restore.steps", step)
             emit("checkpoint", action="restore", step=step, path=path,
                  uid=self.uid, solver=self.solver)
+            world_then = meta.get("world")
+            world_now = _world_size()
+            if world_then is not None and int(world_then) != world_now:
+                # The elastic-resume shape: host state restores here,
+                # replicate_state_onto_mesh reshards it onto the NEW
+                # mesh between segments (the segmented drivers call it).
+                bump_counter("checkpoint.gang_resize")
+                emit(
+                    "gang_resize", action="resume",
+                    from_members=int(world_then), to_members=world_now,
+                    uid=self.uid, solver=self.solver, step=step,
+                )
             return step, tree_util.tree_unflatten(treedef, leaves)
         return None
 
@@ -324,6 +372,9 @@ class FitCheckpointer:
                 "solver": self.solver,
                 "step": step,
                 "n_leaves": len(host),
+                # Gang membership at write time: restore compares it to
+                # the CURRENT world and flags an elastic resize.
+                "world": _world_size(),
             }
             buf = io.BytesIO()
             np.savez(

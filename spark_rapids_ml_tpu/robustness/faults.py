@@ -27,12 +27,26 @@ Sites (the complete vocabulary — a spec naming anything else is an error):
                                 segments (the segmented-fit drivers)
   - ``solver.segment``          one solver segment / streaming-pass
                                 execution (the fit-path OOM chokepoint)
+  - ``ipc.send``                one serving-tier frame send
+                                (serving/ipc.py — router or member side)
+  - ``ipc.recv``                one serving-tier frame receive
+                                (serving/ipc.py — a member armed here
+                                dies mid-conversation, the router sees a
+                                clean EOF)
+  - ``member.launch``           spawning one elastic serving member
+                                (serving/router.py ``add_member``)
+  - ``member.join``             the join replay/warm protocol for one
+                                elastic member (serving/router.py)
 
 Schedules are counters, not random draws — the same spec always fails the
 same invocations, so a chaos test is exactly reproducible:
 
   - ``site=N``           fail the first N invocations, then succeed
   - ``site=always``      fail every invocation
+  - ``site=N@K``         skip the first K invocations, then fail the
+                         next N (``always@K``: every invocation from the
+                         K-th on) — lets a spawned member handshake and
+                         join cleanly, then fail mid-conversation
   - append ``:fatal``    raise a fault classified FATAL (never retried)
   - append ``:torn``     a TORN write: the site is killed mid-file, so a
                          truncated artifact lands at the FINAL path (only
@@ -43,6 +57,12 @@ same invocations, so a chaos test is exactly reproducible:
                          marker, so the fit-path OOM recovery (cache
                          reclaim, block halving, streaming fallback)
                          classifies injected and real OOMs identically
+  - append ``:stall``    FREEZE instead of raise: the site blocks (in
+                         small sleeps, bounded by ``STALL_MAX_S``) until
+                         the plan is disarmed or the process is killed —
+                         the stuck-but-alive failure mode a socket EOF
+                         never models. Exercises the heartbeat-driven
+                         stall-retire path (serving/elastic.py)
 
 Specs come from the ``TPUML_FAULTS`` env var (semicolon- or
 comma-separated entries, e.g. ``persistence.write=1;barrier.attempt=2``)
@@ -69,8 +89,16 @@ KNOWN_SITES = frozenset(
         "checkpoint.restore",
         "checkpoint.segment",
         "solver.segment",
+        "ipc.send",
+        "ipc.recv",
+        "member.launch",
+        "member.join",
     }
 )
+
+# Upper bound on one :stall freeze, so an un-retired stalled process (or
+# a test that forgot to kill it) parks for a bounded time, never forever.
+STALL_MAX_S = 60.0
 
 ALWAYS = -1  # sentinel count: fail every invocation
 
@@ -109,9 +137,9 @@ class InjectedFault(RuntimeError):
 
 
 class Schedule:
-    """One site's failure schedule: fail invocations [0, count) — or all
-    of them for ``count=ALWAYS`` — raising fatal, transient, or torn
-    faults."""
+    """One site's failure schedule: fail invocations [skip, skip+count)
+    — or every invocation from ``skip`` on for ``count=ALWAYS`` —
+    raising fatal, transient, or torn faults (or freezing, for stall)."""
 
     def __init__(
         self,
@@ -119,23 +147,34 @@ class Schedule:
         fatal: bool = False,
         torn: bool = False,
         oom: bool = False,
+        stall: bool = False,
+        skip: int = 0,
     ):
         if count != ALWAYS and count < 0:
             raise ValueError(f"schedule count must be >= 0 or ALWAYS, got {count}")
+        if skip < 0:
+            raise ValueError(f"schedule skip must be >= 0, got {skip}")
         self.count = count
         self.fatal = fatal
         self.torn = torn
         self.oom = oom
+        self.stall = stall
+        self.skip = skip
 
     def should_fail(self, invocation: int) -> bool:
-        return self.count == ALWAYS or invocation < self.count
+        if invocation < self.skip:
+            return False
+        return self.count == ALWAYS or invocation < self.skip + self.count
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         n = "always" if self.count == ALWAYS else str(self.count)
+        if self.skip:
+            n += f"@{self.skip}"
         flags = (
             (", fatal" if self.fatal else "")
             + (", torn" if self.torn else "")
             + (", oom" if self.oom else "")
+            + (", stall" if self.stall else "")
         )
         return f"Schedule({n}{flags})"
 
@@ -150,7 +189,8 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
         if "=" not in entry:
             raise ValueError(
                 f"malformed fault entry {entry!r}: expected "
-                "site=N | site=always, optionally suffixed :fatal|:torn|:oom"
+                "site=N | site=always, optionally suffixed "
+                ":fatal|:torn|:oom|:stall"
             )
         site, _, sched = entry.partition("=")
         site = site.strip()
@@ -160,7 +200,7 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
                 f"{sorted(KNOWN_SITES)}"
             )
         sched = sched.strip()
-        fatal = torn = oom = False
+        fatal = torn = oom = stall = False
         while True:
             if sched.endswith(":fatal"):
                 fatal = True
@@ -171,8 +211,25 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
             elif sched.endswith(":oom"):
                 oom = True
                 sched = sched[: -len(":oom")]
+            elif sched.endswith(":stall"):
+                stall = True
+                sched = sched[: -len(":stall")]
             else:
                 break
+        skip = 0
+        if "@" in sched:
+            sched, _, skip_s = sched.partition("@")
+            try:
+                skip = int(skip_s)
+            except ValueError:
+                raise ValueError(
+                    f"malformed skip offset {skip_s!r} for site {site!r}: "
+                    "expected site=N@K with integer K"
+                ) from None
+            if skip < 0:
+                raise ValueError(
+                    f"skip offset for site {site!r} must be >= 0, got {skip}"
+                )
         if sched == "always":
             count = ALWAYS
         else:
@@ -187,7 +244,9 @@ def parse_spec(spec: str) -> Dict[str, Schedule]:
                 raise ValueError(
                     f"schedule count for site {site!r} must be >= 0, got {count}"
                 )
-        plan[site] = Schedule(count, fatal=fatal, torn=torn, oom=oom)
+        plan[site] = Schedule(
+            count, fatal=fatal, torn=torn, oom=oom, stall=stall, skip=skip
+        )
     return plan
 
 
@@ -215,14 +274,28 @@ class FaultPlan:
         with self._lock:
             invocation = self._counts.get(site, 0)
             self._counts[site] = invocation + 1
-            if sched.should_fail(invocation):
-                self.fired.append((site, invocation))
-                emit("fault", action="fire", site=site, invocation=invocation,
-                     fatal=sched.fatal, torn=sched.torn, oom=sched.oom)
-                raise InjectedFault(
-                    site, invocation, fatal=sched.fatal, torn=sched.torn,
-                    oom=sched.oom,
-                )
+            if not sched.should_fail(invocation):
+                return
+            self.fired.append((site, invocation))
+            emit("fault", action="fire", site=site, invocation=invocation,
+                 fatal=sched.fatal, torn=sched.torn, oom=sched.oom,
+                 stall=sched.stall)
+        if sched.stall:
+            # Freeze OUTSIDE the lock (other sites keep injecting): the
+            # stuck-but-alive failure mode. Wakes only when the plan is
+            # disarmed/replaced or the bound expires — in the serving
+            # tier the stalled member is killed by the heartbeat retire
+            # long before either.
+            import time
+
+            deadline = time.monotonic() + STALL_MAX_S
+            while _active is self and time.monotonic() < deadline:
+                time.sleep(0.05)
+            return
+        raise InjectedFault(
+            site, invocation, fatal=sched.fatal, torn=sched.torn,
+            oom=sched.oom,
+        )
 
 
 # The active plan. None (the production state) makes fault_point a single

@@ -20,6 +20,7 @@ from spark_rapids_ml_tpu.serving.admission import (
     Overloaded,
 )
 from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
+from spark_rapids_ml_tpu.serving.elastic import ElasticScaler
 from spark_rapids_ml_tpu.serving.registry import ModelRegistry, ModelVersion
 from spark_rapids_ml_tpu.serving.router import RoutingRuntime, router_snapshots
 from spark_rapids_ml_tpu.serving.server import ServingRuntime, runtime_snapshots
@@ -28,6 +29,7 @@ from spark_rapids_ml_tpu.serving.signature import ServingSignature
 __all__ = [
     "AdmissionQueue",
     "DeadlineExceeded",
+    "ElasticScaler",
     "MicroBatcher",
     "ModelRegistry",
     "ModelVersion",

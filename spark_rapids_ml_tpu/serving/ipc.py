@@ -29,6 +29,8 @@ import struct
 import tempfile
 from typing import Any, Optional
 
+from spark_rapids_ml_tpu.robustness.faults import fault_point
+
 _LEN = struct.Struct(">I")
 
 #: Frames above this are refused before allocation — a corrupt length
@@ -51,7 +53,13 @@ def loads_model(blob: bytes) -> Any:
 
 
 def send_msg(sock: socket.socket, msg: dict) -> None:
-    """One framed message. The caller serializes access per socket."""
+    """One framed message. The caller serializes access per socket.
+
+    ``ipc.send`` is a chaos site: an armed plan makes this frame die
+    before any byte hits the wire — the peer sees a clean EOF when the
+    faulted process exits, exactly the half-written-conversation shape a
+    crash between frames produces."""
+    fault_point("ipc.send")
     payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
@@ -67,7 +75,13 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def recv_msg(sock: socket.socket) -> Optional[dict]:
-    """The next framed message, or None on orderly EOF."""
+    """The next framed message, or None on orderly EOF.
+
+    ``ipc.recv`` is a chaos site, checked BEFORE the blocking read: a
+    member armed with ``ipc.recv=1`` dies mid-conversation (its serve
+    loop re-raises), ``ipc.recv=always:stall`` freezes the frame loop —
+    the stuck-member shape the heartbeat retire path exists for."""
+    fault_point("ipc.recv")
     header = _recv_exact(sock, _LEN.size)
     if header is None:
         return None

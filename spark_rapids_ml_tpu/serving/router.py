@@ -39,6 +39,18 @@ Three mechanisms carry the design:
 PR 7's trace carrier rides every routed request, so the router's route
 event and the member's enqueue/dispatch/complete events merge into ONE
 trace per request across the process hop (``tools/tpuml_trace.py``).
+
+**Elastic membership.** The gang is not static: :meth:`add_member` grows
+it under live load — spawn, connect, replay the retained lsn-ordered op
+log (replay ≡ live application, the replication invariant above), and
+only then admit the member to the selection set, so a join sheds zero
+requests. :meth:`retire_member` is the inverse, drain-then-detach: stop
+selecting, let outstanding work finish, shut the worker down, and retire
+its gauges/series. Every member's frame loop reports its heartbeat age
+over the wire (``beat`` frames); :meth:`retire_stalled` force-detaches a
+member whose age says STUCK before its socket ever EOFs — the
+stuck-but-alive failure mode. ``serving/elastic.py`` drives all three
+from the load signals the router already tracks.
 """
 
 from __future__ import annotations
@@ -64,6 +76,7 @@ from spark_rapids_ml_tpu.observability.events import (
     trace_scope,
 )
 from spark_rapids_ml_tpu.observability.metrics import gauge, histogram
+from spark_rapids_ml_tpu.robustness.faults import fault_point
 from spark_rapids_ml_tpu.serving import ipc
 from spark_rapids_ml_tpu.serving.admission import (
     DEFAULT_RETRY_AFTER_MS,
@@ -129,6 +142,24 @@ class _Member:
         self.retries = 0
         self.mem_budget = 0
         self.queue_limit = 0
+        # Elastic lifecycle. joining: connected but the op-log replay
+        # hasn't finished — invisible to selection. retiring: draining
+        # out — no NEW selections, broadcasts skip it (it never returns).
+        self.joining = False
+        self.retiring = False
+        self.down_reason = "connection lost"
+        # Frame-loop liveness as the member last reported it (``beat``
+        # frames): its heartbeat age plus WHEN we heard it, so the
+        # effective age keeps growing if the reporter itself dies.
+        self.reported_age = 0.0
+        self.age_at = 0.0
+
+    def effective_age(self, now: float) -> Optional[float]:
+        """Seconds since the member's frame loop last provably moved
+        (None until the first beat report). guarded-by: router _lock."""
+        if self.age_at <= 0.0:
+            return None
+        return self.reported_age + (now - self.age_at)
 
     def send(self, msg: dict) -> None:
         with self.send_lock:
@@ -202,6 +233,12 @@ class RoutingRuntime:
         self._pending: Dict[int, dict] = {}  # guarded-by: _lock
         self._next_id = 0  # guarded-by: _lock
         self._lsn = 0  # guarded-by: _op_lock
+        # The retained op log: every broadcast registry op in lsn order,
+        # each with the version the gang assigned (register ops). A
+        # joining member replays it from lsn 0 — the PR 13 invariant
+        # (identical log order => identical version numbers) makes
+        # replay indistinguishable from having been there all along.
+        self._oplog: List[dict] = []  # guarded-by: _op_lock
         self._members: Dict[int, _Member] = {}
         self._barrier_thread: Optional[threading.Thread] = None
         self._barrier_result: list = []
@@ -272,54 +309,60 @@ class RoutingRuntime:
             self._members[i] = _Member(i, {}, sock=None)
 
     def _connect_members(self) -> None:
-        import socket as _socket
-
         deadline = time.monotonic() + self.connect_timeout
         for member in self._members.values():
-            card = None
-            while card is None:
-                card = ipc.read_member(self.rendezvous, member.id)
-                if card is None:
-                    if time.monotonic() > deadline:
-                        raise TimeoutError(
-                            f"serving member {member.id} did not publish "
-                            f"into {self.rendezvous!r} within "
-                            f"{self.connect_timeout:.0f}s "
-                            f"({CONNECT_TIMEOUT_ENV})"
-                        )
-                    if member.proc is not None and member.proc.poll() is not None:
-                        raise RuntimeError(
-                            f"serving member {member.id} exited with code "
-                            f"{member.proc.returncode} before publishing"
-                        )
-                    time.sleep(0.05)
-            member.card = card
-            sock = _socket.create_connection(
-                (card["host"], card["port"]),
-                timeout=max(1.0, deadline - time.monotonic()),
-            )
-            sock.settimeout(None)
-            member.sock = sock
-            member.recv_thread = threading.Thread(
-                target=self._recv_loop, args=(member,),
-                name=f"tpuml-router-recv-{member.id}", daemon=True,
-            )
-            member.recv_thread.start()
-            hello = self._request(member, {"t": "hello"})
-            member.mem_budget = int(hello.get("mem_budget") or 0)
-            member.queue_limit = int(hello.get("queue_limit") or 0)
-            gauge(
-                "serving.router.member.depth",
-                "per-member queue depth as last reported to the router",
-            ).set_function(
-                lambda m=member: m.last_depth,
-                router=self.router_id, member=str(member.id),
-            )
-            emit(
-                "serving", action="member_up", router=self.router_id,
-                member=member.id, pid=card.get("pid"),
-                mem_budget=member.mem_budget,
-            )
+            self._connect_one(member, deadline)
+
+    def _connect_one(self, member: _Member, deadline: float) -> None:
+        import socket as _socket
+
+        card = None
+        while card is None:
+            card = ipc.read_member(self.rendezvous, member.id)
+            if card is None:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"serving member {member.id} did not publish "
+                        f"into {self.rendezvous!r} within "
+                        f"{self.connect_timeout:.0f}s "
+                        f"({CONNECT_TIMEOUT_ENV})"
+                    )
+                if member.proc is not None and member.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"serving member {member.id} exited with code "
+                        f"{member.proc.returncode} before publishing"
+                    )
+                time.sleep(0.05)
+        member.card = card
+        sock = _socket.create_connection(
+            (card["host"], card["port"]),
+            timeout=max(1.0, deadline - time.monotonic()),
+        )
+        sock.settimeout(None)
+        member.sock = sock
+        member.recv_thread = threading.Thread(
+            target=self._recv_loop, args=(member,),
+            name=f"tpuml-router-recv-{member.id}", daemon=True,
+        )
+        member.recv_thread.start()
+        hello = self._request(
+            member, {"t": "hello"},
+            timeout=max(1.0, deadline - time.monotonic()),
+        )
+        member.mem_budget = int(hello.get("mem_budget") or 0)
+        member.queue_limit = int(hello.get("queue_limit") or 0)
+        gauge(
+            "serving.router.member.depth",
+            "per-member queue depth as last reported to the router",
+        ).set_function(
+            lambda m=member: m.last_depth,
+            router=self.router_id, member=str(member.id),
+        )
+        emit(
+            "serving", action="member_up", router=self.router_id,
+            member=member.id, pid=card.get("pid"),
+            mem_budget=member.mem_budget,
+        )
 
     # --- wire plumbing --------------------------------------------------
 
@@ -355,7 +398,20 @@ class RoutingRuntime:
             if msg is None:
                 self._member_lost(member)
                 return
+            if msg.get("t") == "beat":
+                self._note_beat(member, msg)
+                continue
             self._handle_reply(member, msg)
+
+    def _note_beat(self, member: _Member, msg: dict) -> None:
+        """A member's liveness report: its frame-loop heartbeat age (plus
+        a free queue-depth refresh — idle members stay current without
+        traffic)."""
+        with self._lock:
+            member.reported_age = float(msg.get("age") or 0.0)
+            member.age_at = time.monotonic()
+            if "depth" in msg:
+                member.last_depth = int(msg["depth"])
 
     def _member_lost(self, member: _Member) -> None:
         """EOF from a member: fail or re-route everything it owed."""
@@ -375,7 +431,7 @@ class RoutingRuntime:
         if not self._closed:
             emit(
                 "serving", action="member_down", router=self.router_id,
-                member=member.id, reason="connection lost",
+                member=member.id, reason=member.down_reason,
             )
         for _, entry in orphans:
             if entry.get("kind") == "submit":
@@ -448,7 +504,8 @@ class RoutingRuntime:
         with self._lock:
             candidates = [
                 m for m in self._members.values()
-                if not m.dead and m.id not in tried and m.backoff_until <= now
+                if not m.dead and not m.joining and not m.retiring
+                and m.id not in tried and m.backoff_until <= now
             ]
             if not candidates:
                 return None
@@ -465,7 +522,10 @@ class RoutingRuntime:
         now = time.monotonic()
         with self._lock:
             self._rejected += 1
-            alive = [m for m in self._members.values() if not m.dead]
+            alive = [
+                m for m in self._members.values()
+                if not m.dead and not m.joining and not m.retiring
+            ]
             hints = [
                 (m.backoff_until - now) * 1e3
                 for m in alive
@@ -752,9 +812,22 @@ class RoutingRuntime:
     def _broadcast_op(self, op: dict, timeout: Optional[float] = None) -> List[dict]:
         """Send one op frame to every live member and gather the acks.
         Caller holds _op_lock, so ops hit every member in one global
-        order — the determinism the version numbering relies on."""
+        order — the determinism the version numbering relies on.
+
+        A member that dies between send and ack is classified SKIPPED,
+        not fatal: it left the gang mid-broadcast (its orphaned control
+        future fails when ``_member_lost`` fires), the survivors carry
+        the op. Every surviving ack must echo the op's lsn — a
+        discontinuity means a member applied ops out of order, which
+        breaks version determinism and is worth crashing on. Members
+        joining (the replay path covers them) or retiring (they never
+        take another request) are excluded up front. The op is retained
+        in the lsn-ordered ``_oplog`` for future joins."""
         with self._lock:
-            alive = [m for m in self._members.values() if not m.dead]
+            alive = [
+                m for m in self._members.values()
+                if not m.dead and not m.joining and not m.retiring
+            ]
         if not alive:
             raise RuntimeError("serving router has no live members")
         futs = []
@@ -766,15 +839,48 @@ class RoutingRuntime:
             frame = dict(op)
             frame["t"] = "op"
             frame["id"] = mid
-            member.send(frame)
+            try:
+                member.send(frame)
+            except OSError:
+                with self._lock:
+                    self._pending.pop(mid, None)
+                self._member_lost(member)
+                continue
             futs.append((member, fut))
         replies = []
         budget = timeout if timeout is not None else self.connect_timeout
         for member, fut in futs:
-            reply = fut.result(timeout=budget)
+            try:
+                reply = fut.result(timeout=budget)
+            except Exception:
+                with self._lock:
+                    dead = member.dead
+                if not dead:
+                    raise  # a live member that won't ack is a real hang
+                emit(
+                    "serving", action="replicate_skip",
+                    router=self.router_id, member=member.id,
+                    op=op.get("op"), lsn=op.get("lsn"),
+                )
+                continue
             if not reply.get("ok"):
                 raise decode_error(reply["error"])
+            acked = reply.get("lsn")
+            if (
+                acked is not None
+                and op.get("lsn") is not None
+                and int(acked) != int(op["lsn"])
+            ):
+                raise RuntimeError(
+                    f"lsn discontinuity on serving member {member.id}: "
+                    f"op lsn {op['lsn']}, acked {acked}"
+                )
             replies.append(reply)
+        if not replies:
+            raise RuntimeError(
+                "no serving member survived the registry op broadcast"
+            )
+        self._oplog.append({"frame": dict(op)})
         return replies
 
     def _next_lsn(self) -> int:
@@ -809,6 +915,9 @@ class RoutingRuntime:
                     f"registry divergence for {name!r}: router assigned "
                     f"v{mv.version}, members assigned {sorted(got)}"
                 )
+            # A future join's replay must land the SAME version on the
+            # new member — remember what the gang assigned.
+            self._oplog[-1]["expect_version"] = mv.version
             emit(
                 "serving", action="replicate", router=self.router_id,
                 op="register", lsn=lsn, model=name, version=mv.version,
@@ -884,6 +993,257 @@ class RoutingRuntime:
                 "serving", action="replicate", router=self.router_id,
                 op="retire", lsn=lsn, model=name, version=int(version),
             )
+
+    # --- elastic membership ---------------------------------------------
+
+    def live_member_ids(self) -> List[int]:
+        """Members currently in (or joining toward) the selection set."""
+        with self._lock:
+            return sorted(
+                m.id for m in self._members.values()
+                if not m.dead and not m.retiring
+            )
+
+    def add_member(self, *, timeout: Optional[float] = None) -> int:
+        """Grow the gang by one member under live load, shedding nothing.
+
+        The join protocol: spawn (``member.launch`` chaos site), connect
+        and handshake exactly like launch-time members, then — holding
+        ``_op_lock`` so no live op can interleave (``member.join`` chaos
+        site) — replay the retained op log from lsn 0 into the new
+        member and verify every register ack against the version the
+        gang originally assigned. Warm ops are IN the log, so replay
+        leaves the member's program cache as hot as its peers'. Only
+        then does the member become selectable; until that instant
+        ``_pick_member`` cannot see it, so no request is ever routed to
+        a half-caught-up member and the join sheds zero requests. A
+        failed join tears the member down without ever having touched
+        the selection set."""
+        if self._closed:
+            raise RuntimeError("serving router is closed")
+        if self.launch != "spawn":
+            raise RuntimeError(
+                f"add_member needs launch='spawn' members the router owns; "
+                f"this router launched {self.launch!r}"
+            )
+        from spark_rapids_ml_tpu.parallel.distributed import member_env
+
+        budget = timeout if timeout is not None else self.connect_timeout
+        with self._lock:
+            member_id = max(self._members, default=-1) + 1
+            gang_size = len(self._members) + 1
+        fault_point("member.launch")
+        with trace_scope(self._launch_trace):
+            env = member_env(member_id, gang_size)
+            env[RENDEZVOUS_ENV] = self.rendezvous
+            env[MEMBER_ENV] = str(member_id)
+            for knob, value in self._serve_knobs.items():
+                if value is not None:
+                    env[knob] = str(value)
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    "from spark_rapids_ml_tpu.serving.worker import main; "
+                    "raise SystemExit(main())",
+                ],
+                env=env,
+            )
+            member = _Member(member_id, {"pid": proc.pid}, sock=None)
+            member.proc = proc
+            member.joining = True
+            with self._lock:
+                self._members[member_id] = member
+            try:
+                self._connect_one(member, time.monotonic() + budget)
+                with self._op_lock:
+                    fault_point("member.join")
+                    replayed = self._replay_oplog(member, budget)
+                    # Admit while STILL holding _op_lock: there is no
+                    # instant where a new op could miss both the replay
+                    # and the live broadcast.
+                    with self._lock:
+                        member.joining = False
+                    lsn = self._lsn
+                emit(
+                    "serving", action="member_join", router=self.router_id,
+                    member=member_id, lsn=lsn, ops_replayed=replayed,
+                )
+            except BaseException:
+                self._abort_join(member)
+                raise
+        return member_id
+
+    def _replay_oplog(self, member: _Member, budget: float) -> int:
+        """Replay every retained op, in lsn order, to ONE member.
+        Caller holds _op_lock."""
+        for rec in self._oplog:
+            frame = dict(rec["frame"])
+            frame["t"] = "op"
+            reply = self._request(member, frame, timeout=budget)
+            acked = reply.get("lsn")
+            if acked is not None and int(acked) != int(frame["lsn"]):
+                raise RuntimeError(
+                    f"join replay lsn discontinuity on member {member.id}: "
+                    f"sent {frame['lsn']}, acked {acked}"
+                )
+            expect = rec.get("expect_version")
+            if expect is not None and int(reply.get("version", -1)) != int(expect):
+                raise RuntimeError(
+                    f"join replay divergence on member {member.id}: "
+                    f"{frame.get('name')!r} got v{reply.get('version')}, "
+                    f"gang assigned v{expect}"
+                )
+        return len(self._oplog)
+
+    def _abort_join(self, member: _Member) -> None:
+        """A join that failed before admission: erase the member as if
+        it never existed — it was never selectable, so nothing routed."""
+        with self._lock:
+            member.dead = True
+            member.down_reason = "join failed"
+            self._members.pop(member.id, None)
+        gauge("serving.router.member.depth", "").remove(
+            router=self.router_id, member=str(member.id)
+        )
+        if member.sock is not None:
+            try:
+                member.sock.close()
+            except OSError:
+                pass
+        if member.proc is not None:
+            member.proc.kill()
+            try:
+                member.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        emit(
+            "serving", action="member_down", router=self.router_id,
+            member=member.id, reason="join failed",
+        )
+
+    def retire_member(self, member_id: int, *,
+                      timeout: Optional[float] = None) -> None:
+        """Shrink the gang by one member, drain-then-detach: stop
+        selecting it, wait for its outstanding requests to finish, then
+        a draining shutdown (the worker quiesces its op log and queue,
+        acks, and exits — flushing its telemetry shard and retiring its
+        own gauges; EOF here retires the router-side depth series). The
+        last live member cannot be retired — the gang must keep serving."""
+        budget = timeout if timeout is not None else self.connect_timeout
+        with self._lock:
+            member = self._members.get(int(member_id))
+            if member is None:
+                raise KeyError(f"no serving member {member_id}")
+            if member.dead or member.retiring:
+                return
+            others = [
+                m for m in self._members.values()
+                if not m.dead and not m.retiring and m.id != member.id
+            ]
+            if not others:
+                raise RuntimeError(
+                    "cannot retire the last live serving member"
+                )
+            member.retiring = True
+            member.down_reason = "retired"
+        emit(
+            "serving", action="member_retire", router=self.router_id,
+            member=member.id,
+        )
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            with self._lock:
+                if member.outstanding <= 0 or member.dead:
+                    break
+            time.sleep(0.01)
+        try:
+            self._request(member, {"t": "shutdown", "drain": True},
+                          timeout=budget)
+        except Exception:  # noqa: BLE001 - it may already be gone
+            pass
+        if member.recv_thread is not None:
+            member.recv_thread.join(timeout=budget)
+        if member.sock is not None:
+            try:
+                member.sock.close()
+            except OSError:
+                pass
+        if member.proc is not None:
+            try:
+                member.proc.wait(timeout=budget)
+            except subprocess.TimeoutExpired:
+                member.proc.kill()
+                member.proc.wait(timeout=10)
+        with self._lock:
+            already = member.dead
+            member.dead = True
+        if not already:
+            gauge("serving.router.member.depth", "").remove(
+                router=self.router_id, member=str(member.id)
+            )
+            emit(
+                "serving", action="member_down", router=self.router_id,
+                member=member.id, reason="retired",
+            )
+
+    def stalled_members(self, max_age: float) -> List[int]:
+        """Members whose reported frame-loop heartbeat age exceeds
+        ``max_age`` — alive at the socket level, provably stuck."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for m in self._members.values():
+                if m.dead or m.joining or m.retiring:
+                    continue
+                age = m.effective_age(now)
+                if age is not None and age > max_age:
+                    out.append(m.id)
+        return sorted(out)
+
+    def retire_stalled(self, max_age: float) -> List[int]:
+        """Force-detach every stalled member BEFORE its socket EOFs: the
+        stuck-but-alive failure mode a connection-loss detector never
+        sees. Outstanding requests redispatch through the normal
+        lost-member ladder; the process is killed, not drained — a
+        frozen frame loop cannot drain."""
+        retired = []
+        now = time.monotonic()
+        for mid in self.stalled_members(max_age):
+            with self._lock:
+                member = self._members.get(mid)
+                if member is None or member.dead:
+                    continue
+                age = member.effective_age(now)
+                member.down_reason = "stalled"
+                member.retiring = True
+            emit(
+                "serving", action="member_stalled", router=self.router_id,
+                member=mid, age_s=round(age or 0.0, 3),
+                max_age_s=max_age,
+            )
+            if member.proc is not None:
+                member.proc.kill()
+            if member.sock is not None:
+                # Wake the blocked recv thread: shutdown() interrupts a
+                # blocked recv where close() alone may not.
+                import socket as _socket
+
+                try:
+                    member.sock.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    member.sock.close()
+                except OSError:
+                    pass
+            if member.proc is not None:
+                try:
+                    member.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            retired.append(mid)
+        return retired
 
     # --- lifecycle ------------------------------------------------------
 
@@ -965,6 +1325,13 @@ class RoutingRuntime:
                     "member": m.id,
                     "pid": m.card.get("pid"),
                     "dead": m.dead,
+                    "joining": m.joining,
+                    "retiring": m.retiring,
+                    "heartbeat_age_s": (
+                        round(m.effective_age(now), 3)
+                        if m.effective_age(now) is not None
+                        else None
+                    ),
                     "depth": m.last_depth,
                     "outstanding": m.outstanding,
                     "backoff_remaining_ms": round(

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import os
 import queue
+import select
 import socket
 import threading
 import traceback
@@ -57,6 +58,12 @@ MEMBER_ENV = "TPUML_ROUTER_MEMBER"
 CONNECT_TIMEOUT_ENV = "TPUML_ROUTER_CONNECT_TIMEOUT"
 
 DEFAULT_CONNECT_TIMEOUT_S = 120.0
+
+#: How often the frame loop proves liveness (a manual heartbeat beat +
+#: a select() wake) and the reporter ships the age to the router. Small
+#: enough that a stall-retire threshold of ~0.5 s is testable; the beat
+#: frame is a few dozen bytes on an otherwise-idle loopback socket.
+BEAT_EVERY_S = 0.2
 
 
 def encode_error(exc: BaseException) -> dict:
@@ -238,18 +245,51 @@ class ServingWorker:
             },
         }
 
+    # --- frame-loop liveness ---
+
+    def _beat_reporter(self, hb: "GangHeartbeat",
+                       stop: threading.Event) -> None:
+        """Ship the frame loop's heartbeat age to the router every
+        ``BEAT_EVERY_S``. Its OWN thread on purpose: when the frame loop
+        wedges (a ``:stall`` fault, a GIL-holding bug), the beats it
+        reports keep flowing — with a growing age — which is exactly
+        what lets the router retire a stuck member whose socket never
+        EOFs."""
+        while not stop.wait(BEAT_EVERY_S):
+            self._reply(None, {
+                "t": "beat", "member": self.member,
+                "age": hb.age_seconds(),
+            })
+
     # --- the frame loop ---
 
-    def serve(self, conn: socket.socket) -> None:
-        """Serve one router connection until shutdown or EOF."""
+    def serve(self, conn: socket.socket,
+              hb: Optional["GangHeartbeat"] = None) -> None:
+        """Serve one router connection until shutdown or EOF.
+
+        With a (manual-mode) heartbeat the loop select()-gates the
+        blocking read so it beats every ``BEAT_EVERY_S`` even while
+        idle — an idle member and a wedged one must not look alike."""
         self._conn = conn
         self._op_thread = threading.Thread(
             target=self._op_loop, name=f"tpuml-member-{self.member}-ops",
             daemon=True,
         )
         self._op_thread.start()
+        stop_reporter = threading.Event()
+        if hb is not None:
+            threading.Thread(
+                target=self._beat_reporter, args=(hb, stop_reporter),
+                name=f"tpuml-member-{self.member}-beats", daemon=True,
+            ).start()
         try:
             while True:
+                if hb is not None:
+                    hb.beat()
+                    readable, _, _ = select.select([conn], [], [],
+                                                   BEAT_EVERY_S)
+                    if not readable:
+                        continue
                 msg = ipc.recv_msg(conn)
                 if msg is None:  # router vanished: drain and exit
                     break
@@ -285,6 +325,7 @@ class ServingWorker:
                                   "msg": f"unknown frame type {t!r}"},
                     })
         finally:
+            stop_reporter.set()
             if self._op_thread is not None:
                 self._ops.put(None)
                 self._op_thread.join(timeout=60.0)
@@ -324,7 +365,11 @@ def serve_member(
         ipc.publish_member(rendezvous, member, "127.0.0.1", port)
         _ev.emit("serving", action="member_up", member=member, port=port,
                  mem_budget=rt.mem_budget)
-        with heartbeat_scope(member, what="serving"):
+        # Manual-mode heartbeat: the FRAME LOOP beats it, so the age is
+        # a statement about the loop that serves requests — the one that
+        # a stall freezes — not about a side thread that would keep
+        # beating through the freeze.
+        with heartbeat_scope(member, what="serving", manual=True) as hb:
             try:
                 conn, _ = srv.accept()
             except socket.timeout:
@@ -333,7 +378,7 @@ def serve_member(
                     f"{timeout:.0f}s ({CONNECT_TIMEOUT_ENV})"
                 ) from None
             try:
-                worker.serve(conn)
+                worker.serve(conn, hb=hb)
             finally:
                 try:
                     conn.close()

@@ -844,244 +844,58 @@ if HAS_PYSPARK:  # pragma: no cover - no pyspark in the CI image
             return self._set(elasticNetParam=value)
 
         def _fit(self, dataset):
-            # Every path fits DISTRIBUTED (VERDICT r2 #3 — no full-dataset
-            # collect): the L2/unregularized path runs per-iteration
-            # executor loss/grad sums (Spark's treeAggregate-per-step
-            # structure) driving L-BFGS-B on the driver; nonzero effective
-            # L1 runs the same executor gradient sums driving FISTA with
-            # the proximal soft-threshold step on the driver (the OWL-QN
-            # structure of Spark's own elastic-net fit).
-            if (
-                self.getOrDefault(self.elasticNetParam) > 0.0
-                and self.getOrDefault(self.regParam) > 0.0
-            ):
-                return self._fit_distributed_elastic(dataset)
-            return self._fit_distributed(dataset)
+            # ONE distributed path (VERDICT r2 #3 — no full-dataset
+            # collect): the gang deploy switch. Partitions coalesce onto
+            # the gang roster (TPUML_GANG_FIT_MEMBERS), each barrier
+            # member materializes only ITS rows and calls the public
+            # core fit with deployMode='gang' — the solver's psum'd
+            # reductions produce the identical whole-dataset model on
+            # every member, for L2, elastic-net, and multinomial alike.
+            # This replaces the driver-orchestrated L-BFGS/FISTA twins
+            # that duplicated the core solvers in executor numpy.
+            from spark_rapids_ml_tpu.classification import (
+                LogisticRegression as CoreLogisticRegression,
+            )
+            from spark_rapids_ml_tpu.spark.barrier import (
+                _gang_extract,
+                gang_fit,
+            )
+            from spark_rapids_ml_tpu.utils.envknobs import env_int
+
+            f_col = self.getOrDefault(self.featuresCol)
+            l_col = self.getOrDefault(self.labelCol)
+            rdd = dataset.select(f_col, l_col).rdd
+            members = env_int("TPUML_GANG_FIT_MEMBERS", 1, minimum=1)
+            if rdd.getNumPartitions() != members:
+                rdd = rdd.coalesce(members)
+
+            def extract(it):
+                # Executor-side label validation (Spark rejects
+                # non-integer labels; silent truncation would fold 1.5
+                # into class 1) — the partition never leaves the member.
+                x, y = _gang_extract(it, labeled=True)
+                bad = (y != np.rint(y)) | (y < 0)
+                if np.any(bad):
+                    raise ValueError(
+                        "labels must be non-negative integers, got "
+                        f"{y[bad][0]!r}"
+                    )
+                return x, y
+
+            core = (
+                CoreLogisticRegression()
+                .setMaxIter(self.getOrDefault(self.maxIter))
+                .setRegParam(self.getOrDefault(self.regParam))
+                .setElasticNetParam(self.getOrDefault(self.elasticNetParam))
+            )
+            models = gang_fit(core, rdd, extract=extract)
+            return self._wrap(models[0])
 
         def _wrap(self, core):
             model = TpuLogisticRegressionModel(core)
             for p in ("featuresCol", "labelCol", "predictionCol", "probabilityCol", "rawPredictionCol"):
                 model._set(**{p: self.getOrDefault(getattr(self, p))})
             return model
-
-        @staticmethod
-        def _logistic_stats(rdd, d):
-            """Pass 1 (shared by both distributed fits): O(d) per-feature
-            moments for standardization + label range — count / sum /
-            sum-of-squares, not a d x d gram. Fractional or negative
-            labels raise (Spark rejects non-integer labels; silent
-            truncation would fold 1.5 into class 1)."""
-
-            def stat_op(rows, d=d):
-                n_loc = 0
-                s = np.zeros(d)
-                ss = np.zeros(d)
-                y_max = 0
-                for chunk in _row_batches(rows):
-                    xb = _dense_chunk(chunk)
-                    ys = np.asarray([float(r[1]) for r in chunk])
-                    if np.any(ys != np.rint(ys)) or np.any(ys < 0):
-                        raise ValueError(
-                            "labels must be non-negative integers, got "
-                            f"{ys[(ys != np.rint(ys)) | (ys < 0)][0]!r}"
-                        )
-                    y_max = max(y_max, int(ys.max()))
-                    n_loc += xb.shape[0]
-                    s += xb.sum(axis=0)
-                    ss += (xb * xb).sum(axis=0)
-                return [(n_loc, s, ss, y_max)]
-
-            n_i, s, ss, y_max = rdd.mapPartitions(stat_op).treeReduce(
-                lambda a, b: (
-                    a[0] + b[0], a[1] + b[1], a[2] + b[2], max(a[3], b[3])
-                )
-            )
-            n = float(n_i)
-            mean = s / n
-            # POPULATION variance, matching the core solver's scaler
-            # (ops/logistic._masked_feature_moments divides by n).
-            var = np.clip(ss / n - mean * mean, 0.0, None)
-            sigma = np.sqrt(var)
-            scale = np.where(sigma > 0, sigma, 1.0)
-            n_classes = max(y_max + 1, 2)
-            return n, mean, scale, n_classes
-
-        def _fit_distributed(self, dataset):
-            import scipy.optimize
-
-            from spark_rapids_ml_tpu.models.logistic_regression import (
-                LogisticRegressionModel,
-            )
-
-            f_col = self.getOrDefault(self.featuresCol)
-            l_col = self.getOrDefault(self.labelCol)
-            rdd = dataset.select(f_col, l_col).rdd
-            # The iterative fit re-reads the data every L-BFGS evaluation:
-            # persist once (Spark's own LogisticRegression caches its
-            # instances RDD the same way).
-            rdd.persist()
-            try:
-                d = len(rdd.first()[0].toArray())
-                n, offset, scale, n_classes = self._logistic_stats(rdd, d)
-                binomial = n_classes == 2
-                c = 1 if binomial else n_classes
-                reg = self.getOrDefault(self.regParam)
-
-                def objective(theta):
-                    w = theta[: d * c].reshape(d, c)
-                    b = theta[d * c :]
-
-                    def part_op(rows, w=w, b=b, offset=offset, scale=scale,
-                                binomial=binomial):
-                        from spark_rapids_ml_tpu.spark.executor_math import (
-                            logistic_loss_grad,
-                        )
-
-                        loss = 0.0
-                        gw = np.zeros_like(w)
-                        gb = np.zeros_like(b)
-                        for chunk in _row_batches(rows):
-                            xs = (_dense_chunk(chunk) - offset) / scale
-                            yb = np.asarray([int(r[1]) for r in chunk])
-                            ls, gws, gbs = logistic_loss_grad(w, b, xs, yb, binomial)
-                            loss += ls
-                            gw += gws
-                            gb += gbs
-                        return [(loss, gw, gb)]
-
-                    tot_l, tot_gw, tot_gb = rdd.mapPartitions(part_op).treeReduce(
-                        lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2])
-                    )
-                    loss = tot_l / n + 0.5 * reg * float(np.sum(w * w))
-                    grad = np.concatenate(
-                        [(tot_gw / n + reg * w).ravel(), tot_gb / n]
-                    )
-                    return loss, grad
-
-                res = scipy.optimize.minimize(
-                    objective,
-                    np.zeros(d * c + c),
-                    jac=True,
-                    method="L-BFGS-B",
-                    options={"maxiter": self.getOrDefault(self.maxIter), "gtol": 1e-6},
-                )
-            finally:
-                rdd.unpersist()
-            w_std = res.x[: d * c].reshape(d, c)
-            b_std = res.x[d * c :]
-            if c > 1 and reg == 0.0:
-                # Identifiability pivot, matching the core solver.
-                w_std = w_std - w_std.mean(axis=1, keepdims=True)
-                b_std = b_std - b_std.mean()
-            w_orig = w_std / scale[:, None]
-            b_orig = b_std - offset @ w_orig
-            core = LogisticRegressionModel(
-                None, w_orig, b_orig, numClasses=n_classes, numIter=int(res.nit)
-            )
-            return self._wrap(core)
-
-        def _fit_distributed_elastic(self, dataset):
-            """Elastic-net fit with NO dataset collect: per-iteration
-            executor gradient sums (the same mapPartitions+treeReduce unit
-            as the L2 path) drive FISTA on the driver — smooth gradient
-            step, then the L1 soft-threshold prox (intercept unpenalized).
-            Mirrors ops/logistic.fit_logistic_elastic_net: same objective
-            (Σloss/n + reg2/2·‖w‖² + reg1·‖w‖₁), same standardization,
-            same 1/L step from a power-iteration spectral bound — both
-            converge to the unique convex optimum, so coefficients agree
-            with the core solver to optimizer tolerance."""
-            from spark_rapids_ml_tpu.models.logistic_regression import (
-                LogisticRegressionModel,
-            )
-            from spark_rapids_ml_tpu.spark import executor_math as EM
-
-            f_col = self.getOrDefault(self.featuresCol)
-            l_col = self.getOrDefault(self.labelCol)
-            rdd = dataset.select(f_col, l_col).rdd
-            rdd.persist()
-            try:
-                d = len(rdd.first()[0].toArray())
-                n, offset, scale, n_classes = self._logistic_stats(rdd, d)
-                binomial = n_classes == 2
-                c = 1 if binomial else n_classes
-                reg = self.getOrDefault(self.regParam)
-                enet = self.getOrDefault(self.elasticNetParam)
-                reg1 = reg * enet
-                reg2 = reg * (1.0 - enet)
-
-                # Lipschitz bound: distributed power iteration on XsᵀXs
-                # (one pass per step; 8 steps + a 1.3 margin replace the
-                # core's 30 on-device steps + 1.1 — power iteration
-                # converges from below, so the larger margin keeps the
-                # fixed step safe).
-                v = np.random.default_rng(0).standard_normal(d)
-                v /= max(np.linalg.norm(v), 1e-30)
-                lam = 0.0
-                for _ in range(8):
-                    def pow_op(rows, v=v, offset=offset, scale=scale):
-                        u = np.zeros_like(v)
-                        for chunk in _row_batches(rows):
-                            xs = (_dense_chunk(chunk) - offset) / scale
-                            u += EM.gram_matvec_partial(xs, v)
-                        return [u]
-
-                    u = rdd.mapPartitions(pow_op).treeReduce(lambda a, b: a + b)
-                    lam = float(np.linalg.norm(u))
-                    v = u / max(lam, 1e-30)
-                curvature = 0.25 if binomial else 0.5
-                lip = 1.3 * lam * curvature / n + reg2 + 1e-12
-
-                def grad_pass(w, b):
-                    def part_op(rows, w=w, b=b, offset=offset, scale=scale,
-                                binomial=binomial):
-                        loss = 0.0
-                        gw = np.zeros_like(w)
-                        gb = np.zeros_like(b)
-                        for chunk in _row_batches(rows):
-                            xs = (_dense_chunk(chunk) - offset) / scale
-                            yb = np.asarray([int(r[1]) for r in chunk])
-                            ls, gws, gbs = EM.logistic_loss_grad(
-                                w, b, xs, yb, binomial
-                            )
-                            loss += ls
-                            gw += gws
-                            gb += gbs
-                        return [(loss, gw, gb)]
-
-                    return rdd.mapPartitions(part_op).treeReduce(
-                        lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2])
-                    )
-
-                w = np.zeros((d, c))
-                b = np.zeros(c)
-                zw, zb = w.copy(), b.copy()
-                t = 1.0
-                n_iter = 0
-                for n_iter in range(1, self.getOrDefault(self.maxIter) + 1):
-                    _, gw_sum, gb_sum = grad_pass(zw, zb)
-                    gw = gw_sum / n + reg2 * zw
-                    gb = gb_sum / n
-                    w_new = EM.soft_threshold(zw - gw / lip, reg1 / lip)
-                    b_new = zb - gb / lip
-                    t_new = (1.0 + np.sqrt(1.0 + 4.0 * t * t)) / 2.0
-                    mom = (t - 1.0) / t_new
-                    zw = w_new + mom * (w_new - w)
-                    zb = b_new + mom * (b_new - b)
-                    delta = max(
-                        float(np.max(np.abs(w_new - w))),
-                        float(np.max(np.abs(b_new - b))),
-                    )
-                    w, b, t = w_new, b_new, t_new
-                    if delta <= 1e-7:
-                        break
-            finally:
-                rdd.unpersist()
-            w_orig = w / scale[:, None]
-            b_orig = b - offset @ w_orig
-            core = LogisticRegressionModel(
-                None, w_orig, b_orig, numClasses=n_classes, numIter=n_iter
-            )
-            return self._wrap(core)
 
     class TpuLogisticRegressionModel(SparkModel, _TpuProbabilisticParams, _TpuCoreModelPersistence):
         def __init__(self, core_model=None):

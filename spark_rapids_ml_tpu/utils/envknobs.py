@@ -217,6 +217,37 @@ KNOBS: Dict[str, Knob] = _knob_table(
          "requests with at least this many rows bypass members for the "
          "router's mesh-sharded path (0 = budget-driven only)",
          default=0),
+    # elastic gang scaler (serving/elastic.py + router liveness)
+    Knob("TPUML_ELASTIC_MIN", "int", "serving-elastic",
+         "lower bound on live serving members the scaler may retire "
+         "down to", default=1),
+    Knob("TPUML_ELASTIC_MAX", "int", "serving-elastic",
+         "upper bound on live serving members the scaler may join up "
+         "to", default=4),
+    Knob("TPUML_ELASTIC_EVERY_MS", "float", "serving-elastic",
+         "milliseconds between scaler ticks (signal sample + decision)",
+         default=200.0),
+    Knob("TPUML_ELASTIC_HIGH", "float", "serving-elastic",
+         "mean per-member depth (outstanding + reported queue) above "
+         "which a tick votes scale-UP", default=4.0),
+    Knob("TPUML_ELASTIC_LOW", "float", "serving-elastic",
+         "mean per-member depth below which a tick votes scale-DOWN",
+         default=0.5),
+    Knob("TPUML_ELASTIC_HYSTERESIS", "int", "serving-elastic",
+         "consecutive agreeing ticks before a scale decision executes",
+         default=3),
+    Knob("TPUML_ELASTIC_COOLDOWN_MS", "float", "serving-elastic",
+         "milliseconds after a join/retire during which the scaler only "
+         "observes", default=1000.0),
+    Knob("TPUML_ELASTIC_STALL_S", "float", "serving-elastic",
+         "reported member heartbeat age above which the member is "
+         "force-retired as stalled (0 = stall retire off)", default=0.0),
+    # gang fit through the spark adapter (spark/adapter.py)
+    Knob("TPUML_GANG_FIT_MEMBERS", "int", "distributed",
+         "barrier gang members for adapter fits routed through the gang "
+         "deploy switch (input coalesces to this many partitions; 1 = "
+         "single-member gang, the only size a sequential local scheduler "
+         "can run)", default=1),
     # concurrency sanitizer (utils/lockcheck.py)
     Knob("TPUML_LOCKCHECK", "choice", "lockcheck",
          "off: plain threading primitives; warn: instrumented locks "

@@ -1,0 +1,66 @@
+"""Worker for the gang-RESIZE acceptance test (elastic gang membership).
+
+Launched twice by tests/test_gang_fit.py::TestGangResize with the
+jax.distributed coordinates, ``TPUML_GANG_FIT=1`` (the env twin — an
+explicit ``setDeployMode`` would change the param hash and orphan the
+checkpoint stream), the SHARED ``TPUML_CHECKPOINT_*`` knobs, and
+``TPUML_FAULTS=checkpoint.segment=1@2`` armed at import: each member
+feeds its slice of a deterministic dyadic dataset into one segmented
+KMeans gang fit and DIES at the third segment boundary, after the
+step-6 snapshot has landed in the shared dir. The launcher then resumes
+the same fit single-process over ALL rows — the dataset is dyadic
+(integers/4) so every cross-member sum is exact and the resumed model
+must match a cold single-process refit bit for bit.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:  # newer jax: gloo is the default, the knob may be gone
+    pass
+jax.config.update("jax_enable_x64", True)
+
+from spark_rapids_ml_tpu.utils.envknobs import env_int
+
+
+def main() -> None:
+    n_proc = env_int("TPUML_NUM_PROCESSES")
+    pid = env_int("TPUML_PROCESS_ID")
+
+    # The SAME dataset/estimator the launcher's cold and resumed refits
+    # use — the checkpoint identity (uid + params + data fingerprint)
+    # must line up across the member-count change.
+    rng = np.random.default_rng(7)
+    n, d = 160, 5
+    x = (rng.integers(-64, 64, size=(n, d)) / 4.0).astype(np.float64)
+    bounds = np.linspace(0, n, n_proc + 1).astype(int)
+    local = x[bounds[pid] : bounds[pid + 1]]
+    init = x[:4].copy()
+
+    from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+    (
+        KMeans(uid="resize-gang")
+        .setK(4)
+        .setMaxIter(10)
+        .setTol(0.0)
+        .setSeed(1)
+        .setInitialModel(init)
+        .fit(local)
+    )
+    # The seeded fault must kill the fit mid-solve; completing is a
+    # test bug (e.g. the solver converged before the third boundary).
+    print(f"UNEXPECTED_COMPLETE {pid}")
+
+
+if __name__ == "__main__":
+    main()

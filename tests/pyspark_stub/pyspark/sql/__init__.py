@@ -191,6 +191,20 @@ class RDD:
             acc = combOp(acc, x)
         return acc
 
+    def coalesce(self, numPartitions: int, shuffle: bool = False) -> "RDD":
+        """pyspark 3.5 RDD.coalesce(numPartitions): shrink to at most
+        numPartitions WITHOUT a shuffle — contiguous parent partitions
+        merge executor-side (order preserved, no driver fetch); asking
+        for more partitions than exist without shuffle=True keeps the
+        current partitioning (documented pyspark behavior)."""
+        n = max(1, int(numPartitions))
+        if n >= len(self._parts) and not shuffle:
+            return RDD(self._parts)
+        groups: List[list] = [[] for _ in range(n)]
+        for i, p in enumerate(self._parts):
+            groups[i * n // len(self._parts)].extend(p)
+        return RDD(groups)
+
     def getNumPartitions(self) -> int:
         return len(self._parts)
 

@@ -1,0 +1,545 @@
+"""Elastic gang membership (ISSUE 16): join, retire, stall, crash —
+under live load, and nobody sheds.
+
+The serving-side acceptance surface:
+
+  - **Zero-shed join.** ``RoutingRuntime.add_member`` grows the gang
+    mid-traffic: the joiner replays the lsn-ordered op log before it is
+    ever selectable, so every in-flight request completes bitwise
+    correct and the shed counters never move (event-log proof:
+    ``member_join`` carries the replayed op count and final lsn).
+  - **Drain-then-detach retire** plus gauge hygiene: after a full
+    ramp-up/ramp-down episode the registry holds zero stale
+    ``serving.router.member.depth`` series and the merged member shards
+    zero ``gang.heartbeat.age_seconds`` series — and the merged trace
+    passes ``tools/tpuml_trace.py --validate --strict``.
+  - **Stall retire.** A member frozen by ``ipc.recv=always@K:stall``
+    keeps its socket open but its frame-loop heartbeat age grows; the
+    scaler's liveness check retires it BEFORE any EOF and its orphaned
+    requests redispatch losslessly.
+  - **Death mid-broadcast.** A member killed by a seeded ``ipc.recv``
+    fault while a registry op is in flight is classified SKIPPED
+    (``replicate_skip``), the survivors carry the op, and lsn
+    continuity holds for every later op.
+  - **ElasticScaler votes**: shed pressure scales up through the
+    zero-shed join, sustained idle scales down through the drain path,
+    bounds hold.
+
+Float parity uses the dyadic-rational posture of the serving suites:
+integers/4 make every distance computation exact in f64, so "bitwise
+equal to the sequential model call" holds across process hops and
+membership changes.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+from spark_rapids_ml_tpu.observability import events
+from spark_rapids_ml_tpu.observability import trace as tracelib
+from spark_rapids_ml_tpu.observability.metrics import default_registry
+from spark_rapids_ml_tpu.robustness import faults
+from spark_rapids_ml_tpu.serving import ElasticScaler, RoutingRuntime
+from spark_rapids_ml_tpu.utils.envknobs import env_str
+from spark_rapids_ml_tpu.utils.tracing import bump_counter, counter_value
+
+REPO = Path(__file__).resolve().parents[1]
+TRACE_CLI = REPO / "tools" / "tpuml_trace.py"
+
+D = 8
+
+
+def dyadic(rng, shape, scale=4):
+    return rng.integers(-4 * scale, 4 * scale, size=shape).astype(np.float64) / 4.0
+
+
+_PREV_LOG = env_str(events.EVENT_LOG_ENV)
+
+
+def _restore_sink():
+    events.configure(_PREV_LOG if _PREV_LOG else None)
+
+
+@pytest.fixture
+def telemetry(tmp_path):
+    """A fresh telemetry dir as the active sink, exported to the
+    environment so spawned members inherit it and write their own
+    shards (the tests/test_serving_router.py arrangement)."""
+    d = str(tmp_path / "telemetry")
+    prev = env_str(events.TELEMETRY_DIR_ENV)
+    os.environ[events.TELEMETRY_DIR_ENV] = d
+    events.configure()
+    try:
+        yield Path(d)
+    finally:
+        if prev is None:
+            os.environ.pop(events.TELEMETRY_DIR_ENV, None)
+        else:
+            os.environ[events.TELEMETRY_DIR_ENV] = prev
+        _restore_sink()
+
+
+def _serving_records(telemetry_dir):
+    events.flush_telemetry()
+    merged = tracelib.assemble(str(telemetry_dir))
+    return merged, [r for r in merged["records"] if r.get("event") == "serving"]
+
+
+# ---------------------------------------------------------------------------
+# fault grammar: the @K skip offset and the :stall freeze
+# ---------------------------------------------------------------------------
+
+
+class TestFaultGrammar:
+    def test_skip_offset_parses_and_windows(self):
+        plan = faults.parse_spec("ipc.recv=2@3")
+        sched = plan["ipc.recv"]
+        assert (sched.count, sched.skip) == (2, 3)
+        assert [sched.should_fail(i) for i in range(6)] == [
+            False, False, False, True, True, False,
+        ]
+
+    def test_always_with_skip(self):
+        sched = faults.parse_spec("ipc.send=always@4")["ipc.send"]
+        assert sched.count == faults.ALWAYS and sched.skip == 4
+        assert not sched.should_fail(3)
+        assert sched.should_fail(4) and sched.should_fail(4000)
+
+    def test_stall_suffix_stacks_with_skip(self):
+        sched = faults.parse_spec("ipc.recv=always@3:stall")["ipc.recv"]
+        assert sched.stall and sched.skip == 3 and sched.count == faults.ALWAYS
+        assert not sched.fatal and not sched.torn
+
+    def test_member_sites_known(self):
+        plan = faults.parse_spec("member.launch=1;member.join=1@1")
+        assert plan["member.launch"].count == 1
+        assert plan["member.join"].skip == 1
+
+    def test_malformed_skip_rejected(self):
+        with pytest.raises(ValueError, match="skip offset"):
+            faults.parse_spec("ipc.recv=1@x")
+        with pytest.raises(ValueError, match="skip offset"):
+            faults.parse_spec("ipc.recv=1@-2")
+
+    def test_stall_blocks_until_disarmed(self):
+        """The :stall freeze is the stuck-but-alive mode: the site
+        parks (no raise) and wakes only when the plan goes away."""
+        done = threading.Event()
+
+        def run():
+            faults.fault_point("ipc.recv")
+            done.set()
+
+        with faults.inject("ipc.recv=always:stall") as plan:
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            time.sleep(0.3)
+            assert not done.is_set(), ":stall site returned while armed"
+        assert done.wait(5.0), ":stall site never woke after disarm"
+        assert plan.fired == [("ipc.recv", 0)]
+
+
+# ---------------------------------------------------------------------------
+# the full elastic episode: ramp up -> join -> ramp down -> retire -> drain
+# ---------------------------------------------------------------------------
+
+
+class TestElasticEpisode:
+    N_THREADS = 4
+    PER_THREAD = 25
+
+    def test_join_retire_episode_sheds_nothing_and_leaves_no_stale_series(
+        self, telemetry
+    ):
+        """One member carries the low phase; the gang grows by one under
+        live load (zero shed, event-log join proof), both members carry
+        the burst, the joiner retires on ramp-down, and the drained
+        episode leaves no stale gauge series anywhere — with the merged
+        multi-process trace strict-clean."""
+        rng = np.random.default_rng(61)
+        centers = dyadic(rng, (4, D))
+        model = KMeansModel("elastic-km", centers)
+        n = self.N_THREADS * self.PER_THREAD
+        probes = dyadic(rng, (n, D))
+        expected = model.predict(probes)
+
+        shed0 = counter_value("serving.router.shed")
+        rejected0 = counter_value("serving.router.rejected")
+        rt = RoutingRuntime(workers=1, launch="spawn", max_delay_ms=1.0)
+        rid = rt.router_id
+        errors: list = []
+        try:
+            rt.register("km", model, warm_buckets=(1,))
+
+            # Low phase: the single member carries a trickle.
+            for i in range(8):
+                out = rt.submit("km", probes[i]).result(timeout=60)
+                np.testing.assert_array_equal(
+                    np.asarray(out), expected[i : i + 1]
+                )
+
+            # Ramp up: 4 threads stream rows while the gang grows.
+            collected = []
+            lock = threading.Lock()
+
+            def worker(tid):
+                local = []
+                for j in range(self.PER_THREAD):
+                    i = tid * self.PER_THREAD + j
+                    try:
+                        out = rt.submit("km", probes[i]).result(timeout=120)
+                        local.append((i, np.asarray(out)))
+                    except Exception as exc:  # noqa: BLE001 - asserted below
+                        errors.append((i, repr(exc)))
+                with lock:
+                    collected.extend(local)
+
+            threads = [
+                threading.Thread(target=worker, args=(t,))
+                for t in range(self.N_THREADS)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            new_member = rt.add_member()
+            assert new_member == 1
+            assert rt.live_member_ids() == [0, 1]
+            # A post-join burst guarantees the joiner takes traffic even
+            # if the threads finished while it was connecting.
+            burst = [rt.submit("km", probes[i]) for i in range(8)]
+            for i, fut in enumerate(burst):
+                np.testing.assert_array_equal(
+                    np.asarray(fut.result(timeout=60)), expected[i : i + 1]
+                )
+            for t in threads:
+                t.join()
+
+            # Ramp down: retire the joiner through drain-then-detach.
+            rt.retire_member(new_member)
+            assert rt.live_member_ids() == [0]
+            for i in range(8):
+                out = rt.submit("km", probes[i]).result(timeout=60)
+                np.testing.assert_array_equal(
+                    np.asarray(out), expected[i : i + 1]
+                )
+            snap = rt.snapshot()
+        finally:
+            rt.close()
+
+        # Nobody shed, nothing failed, every bit correct.
+        assert errors == [], errors[:5]
+        assert counter_value("serving.router.shed") == shed0
+        assert counter_value("serving.router.rejected") == rejected0
+        assert len(collected) == n
+        for i, out in collected:
+            np.testing.assert_array_equal(out, expected[i : i + 1])
+
+        # Both members carried load; the joiner's share came post-join.
+        by_id = {m["member"]: m for m in snap["members"]}
+        assert by_id[0]["routed"] > 0 and by_id[1]["routed"] > 0
+        assert by_id[1]["shed"] == 0
+
+        # Event-log join proof: the member replayed the FULL op log
+        # (register + warm) and was admitted at the current lsn; its
+        # retirement is drain (member_retire) then down (reason
+        # "retired"), never "connection lost".
+        merged, recs = _serving_records(telemetry)
+        joins = [r for r in recs if r.get("action") == "member_join"]
+        assert len(joins) == 1
+        assert joins[0]["member"] == new_member
+        assert joins[0]["ops_replayed"] == 2
+        assert joins[0]["lsn"] == 2
+        retires = [r for r in recs if r.get("action") == "member_retire"]
+        assert [r["member"] for r in retires] == [new_member]
+        downs = {
+            r["member"]: r["reason"]
+            for r in recs
+            # The router's view (workers emit their own reason-less
+            # member_down at exit; the classification lives router-side).
+            if r.get("action") == "member_down" and r.get("router")
+        }
+        assert downs[new_member] == "retired"
+        assert not any(r.get("action") == "route_shed" for r in recs)
+
+        # Gauge hygiene, this process: the drained episode retired every
+        # per-member depth series for this router.
+        gsnap = default_registry.snapshot()["gauges"]
+        for name in gsnap:
+            assert rid not in name, f"stale router gauge series {name!r}"
+
+        # Gauge hygiene, member shards: each worker's heartbeat stop()
+        # retired its age series before the shard flushed.
+        merged_gauges = merged["metrics"]["merged"]["gauges"]
+        stale = [
+            name
+            for name in merged_gauges
+            if name.startswith("gang.heartbeat.age_seconds")
+            or name.startswith("serving.router.member.depth")
+        ]
+        assert stale == [], f"stale gauge series in merged shards: {stale}"
+
+        # The CLI is the oracle: ONE strict-clean merged trace across
+        # router + both members, join and retire included.
+        r = subprocess.run(
+            [sys.executable, str(TRACE_CLI), str(telemetry),
+             "--validate", "--strict"],
+            capture_output=True, text=True, cwd=str(REPO),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# stall: frozen frame loop, open socket — retired by heartbeat age
+# ---------------------------------------------------------------------------
+
+
+class TestStallRetire:
+    def test_stalled_member_retired_before_eof_and_requests_survive(
+        self, telemetry, monkeypatch
+    ):
+        """A member whose frame loop freezes mid-conversation (the
+        ``:stall`` fault) keeps its socket open, so EOF detection never
+        fires; its reported heartbeat age grows instead, and the
+        scaler's liveness tick force-retires it. The submit parked on
+        the frozen member redispatches and completes bitwise correct."""
+        rng = np.random.default_rng(62)
+        model = KMeansModel("stall-km", dyadic(rng, (4, D)))
+        probes = dyadic(rng, (12, D))
+        expected = model.predict(probes)
+
+        stall0 = counter_value("serving.elastic.stall")
+        rt = RoutingRuntime(workers=2, launch="spawn", max_delay_ms=1.0)
+        try:
+            rt.register("km", model, warm_buckets=(1,))
+            # Arm ONLY the joiner: members spawned from here inherit the
+            # env and arm at import. Its recv sequence is hello(0),
+            # replay register(1), replay warm(2) — so @3 lets the join
+            # complete cleanly and freezes on the first routed frame.
+            monkeypatch.setenv(faults.FAULTS_ENV, "ipc.recv=always@3:stall")
+            stalled_id = rt.add_member()
+            monkeypatch.delenv(faults.FAULTS_ENV)
+            assert rt.live_member_ids() == [0, 1, stalled_id]
+
+            # A concurrent burst spreads across all three members; the
+            # one that lands on the armed member freezes its frame loop.
+            futs = [rt.submit("km", probes[i]) for i in range(12)]
+
+            scaler = ElasticScaler(
+                rt, min_members=1, max_members=4, hysteresis=1000,
+                cooldown_ms=0.0, stall_after_s=1.0,
+            )
+            deadline = time.monotonic() + 30.0
+            action = None
+            while action is None and time.monotonic() < deadline:
+                action = scaler.tick()
+                time.sleep(0.05)
+            assert action == "stall_retire"
+            assert scaler.decisions == [("stall_retire", (stalled_id,))]
+            assert counter_value("serving.elastic.stall") == stall0 + 1
+
+            # No request was lost: the frozen member's orphans
+            # redispatched through the lost-member ladder.
+            for i, fut in enumerate(futs):
+                np.testing.assert_array_equal(
+                    np.asarray(fut.result(timeout=60)), expected[i : i + 1]
+                )
+            snap = rt.snapshot()
+        finally:
+            rt.close()
+
+        by_id = {m["member"]: m for m in snap["members"]}
+        assert by_id[stalled_id]["dead"]
+        assert rt.live_member_ids() == []  # closed
+
+        _, recs = _serving_records(telemetry)
+        stalls = [r for r in recs if r.get("action") == "member_stalled"]
+        assert [r["member"] for r in stalls] == [stalled_id]
+        assert stalls[0]["age_s"] > 1.0
+        downs = {
+            r["member"]: r["reason"]
+            for r in recs
+            if r.get("action") == "member_down" and r.get("router")
+        }
+        assert downs.get(stalled_id) == "stalled"
+
+        # The router-side depth series for the killed member is gone.
+        gsnap = default_registry.snapshot()["gauges"]
+        for name in gsnap:
+            assert rt.router_id not in name, name
+
+
+# ---------------------------------------------------------------------------
+# crash mid-broadcast: the op survives on the survivors, lsn stays dense
+# ---------------------------------------------------------------------------
+
+
+class TestDeadMemberBroadcast:
+    def test_member_death_mid_broadcast_is_skipped_not_fatal(
+        self, telemetry, monkeypatch
+    ):
+        """A member seeded to die on its next frame receive takes the
+        registry-op broadcast down with it — the router classifies it
+        SKIPPED (``replicate_skip``), the survivors ack with the same
+        version, and every LATER op still sees dense lsns."""
+        rng = np.random.default_rng(63)
+        m1 = KMeansModel("bc-v1", dyadic(rng, (4, D)))
+        m2 = KMeansModel("bc-v2", dyadic(rng, (4, D)) + 32.0)
+        probes = dyadic(rng, (6, D))
+
+        rt = RoutingRuntime(workers=1, launch="spawn", max_delay_ms=1.0)
+        try:
+            rt.register("a", m1)  # oplog: [register a] -> lsn 1
+            # Joiner recv sequence: hello(0), replay register(1); the
+            # NEXT frame it receives (the live broadcast) kills it.
+            monkeypatch.setenv(faults.FAULTS_ENV, "ipc.recv=1@2")
+            victim = rt.add_member()
+            monkeypatch.delenv(faults.FAULTS_ENV)
+            assert rt.live_member_ids() == [0, victim]
+
+            mv2 = rt.register("b", m2)  # the broadcast the victim dies on
+            assert mv2.version == 1
+
+            # The gang shrank but the op landed: the survivor serves the
+            # new model bitwise correct, and another op keeps the lsn
+            # sequence dense (a discontinuity would raise).
+            deadline = time.monotonic() + 10.0
+            while victim in rt.live_member_ids():
+                assert time.monotonic() < deadline, "victim EOF never seen"
+                time.sleep(0.02)
+            out = rt.submit("b", probes).result(timeout=60)
+            np.testing.assert_array_equal(
+                np.asarray(out), m2.predict(probes)
+            )
+            rt.warm("b", buckets=(6,))
+        finally:
+            rt.close()
+
+        _, recs = _serving_records(telemetry)
+        skips = [r for r in recs if r.get("action") == "replicate_skip"]
+        assert len(skips) == 1
+        assert skips[0]["member"] == victim
+        assert skips[0]["op"] == "register"
+        assert skips[0]["lsn"] == 2
+        downs = {
+            r["member"]: r["reason"]
+            for r in recs
+            if r.get("action") == "member_down" and r.get("router")
+        }
+        assert victim in downs
+
+
+# ---------------------------------------------------------------------------
+# the scaler's vote machinery against a live router
+# ---------------------------------------------------------------------------
+
+
+class TestElasticScaler:
+    def test_shed_pressure_scales_up_and_sustained_idle_scales_down(
+        self, telemetry
+    ):
+        """Shed deltas vote up (through the zero-shed join), sustained
+        idle votes down (through drain-then-detach), hysteresis gates
+        both, and the min/max bounds are hard."""
+        rng = np.random.default_rng(64)
+        model = KMeansModel("scale-km", dyadic(rng, (4, D)))
+        up0 = counter_value("serving.elastic.up")
+        down0 = counter_value("serving.elastic.down")
+
+        rt = RoutingRuntime(workers=1, launch="spawn", max_delay_ms=1.0)
+        try:
+            rt.register("km", model, warm_buckets=(1,))
+            # Depth thresholds parked out of reach: shed deltas are the
+            # ONLY pressure signal, idle the only relief — deterministic.
+            scaler = ElasticScaler(
+                rt, min_members=1, max_members=2, hysteresis=2,
+                cooldown_ms=0.0, high=1e9, low=1e9,
+            )
+
+            bump_counter("serving.router.shed")
+            assert scaler.tick() is None  # one vote < hysteresis
+            bump_counter("serving.router.shed")
+            assert scaler.tick() == "scale_up"
+            assert rt.live_member_ids() == [0, 1]
+            assert counter_value("serving.elastic.up") == up0 + 1
+
+            # At max: pressure can't overshoot the bound.
+            bump_counter("serving.router.shed")
+            scaler.tick()
+            bump_counter("serving.router.shed")
+            assert scaler.tick() is None
+            assert rt.live_member_ids() == [0, 1]
+
+            # Sustained idle drains one member back out (tie on load:
+            # the lowest id retires — member 0).
+            assert scaler.tick() is None
+            assert scaler.tick() == "scale_down"
+            assert rt.live_member_ids() == [1]
+            assert counter_value("serving.elastic.down") == down0 + 1
+
+            # At min: idle can't retire the last member.
+            assert scaler.tick() is None
+            assert scaler.tick() is None
+            assert rt.live_member_ids() == [1]
+            assert scaler.decisions == [("scale_up", 1), ("scale_down", 0)]
+        finally:
+            rt.close()
+
+
+# ---------------------------------------------------------------------------
+# loadgen ramp grammar (the CLI that drives these episodes)
+# ---------------------------------------------------------------------------
+
+
+class TestLoadgenRamp:
+    def test_parse_ramp(self):
+        from tools import tpuml_loadgen
+
+        assert tpuml_loadgen._parse_ramp("50:5,400:10,50:5") == [
+            (50.0, 5.0), (400.0, 10.0), (50.0, 5.0),
+        ]
+
+    def test_parse_ramp_rejects_garbage(self):
+        from tools import tpuml_loadgen
+
+        for bad in ("50", "0:5", "50:0", "x:5", ""):
+            with pytest.raises(SystemExit):
+                tpuml_loadgen._parse_ramp(bad)
+
+    @pytest.mark.slow
+    def test_cli_ramp_reports_per_phase(self, tmp_path):
+        import json
+
+        r = subprocess.run(
+            [
+                sys.executable, str(REPO / "tools" / "tpuml_loadgen.py"),
+                "--workers", "2", "--threads", "4", "--rows", "2",
+                "--features", "8", "--ramp", "20:1,60:1.5,20:1",
+                "--warm", "--json",
+            ],
+            capture_output=True, text=True, cwd=str(REPO),
+            env={
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "TPUML_TELEMETRY_DIR": str(tmp_path / "shards"),
+            },
+            timeout=300,
+        )
+        assert r.returncode == 0, r.stderr[-3000:]
+        summary = json.loads(r.stdout.strip().splitlines()[-1])
+        phases = summary["ramp"]
+        assert [p["target_rps"] for p in phases] == [20.0, 60.0, 20.0]
+        assert all(p["completed"] > 0 for p in phases)
+        assert all(p["p95_ms"] >= p["p50_ms"] > 0 for p in phases)
+        # The middle phase offered ~3x the edge phases' rate.
+        assert phases[1]["offered"] > 2 * phases[0]["offered"]
+        assert summary["requests"] == sum(p["offered"] for p in phases)

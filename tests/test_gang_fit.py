@@ -251,3 +251,132 @@ class TestTwoProcessGangFit:
             env={**os.environ, "JAX_PLATFORMS": "cpu"},
         )
         assert r.returncode == 0, r.stdout + r.stderr
+
+
+# --- elastic resize: resume a gang fit on a DIFFERENT member count ------
+
+
+class TestGangResize:
+    """ISSUE 16 training-side acceptance: a 2-process gang fit killed
+    mid-solve resumes SINGLE-process over all rows. The checkpoint's
+    sharding-invariant data fingerprint carries the identity across the
+    member-count change, ``restore_latest`` flags the resize
+    (``gang_resize`` event + counter), and the resumed fit lands centers
+    bit-identical to a cold single-process refit while executing
+    strictly fewer solver iterations — the restored mid-solve state did
+    real work."""
+
+    def _estimator(self, init):
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+
+        return (
+            KMeans(uid="resize-gang")
+            .setK(4)
+            .setMaxIter(10)
+            .setTol(0.0)
+            .setSeed(1)
+            .setInitialModel(init)
+        )
+
+    def test_gang_fit_resumes_on_smaller_world(self, tmp_path, monkeypatch):
+        import glob as globlib
+        import json
+
+        from spark_rapids_ml_tpu.observability import events
+        from spark_rapids_ml_tpu.utils.tracing import (
+            clear_counters,
+            counter_value,
+        )
+
+        rng = np.random.default_rng(7)
+        n, d = 160, 5
+        # Dyadic rows (integers/4): every cross-member sum is exact in
+        # f64, so the 2-process segments and the 1-process segments walk
+        # bit-identical center iterates — the precondition for the
+        # resumed model matching the cold refit bitwise.
+        x = (rng.integers(-64, 64, size=(n, d)) / 4.0).astype(np.float64)
+        init = x[:4].copy()
+        gang_dir = tmp_path / "ckpt-gang"
+
+        # Phase A: the 2-member gang, checkpointing into the shared dir,
+        # dies at the third segment boundary (skip-offset fault grammar)
+        # — AFTER the step-6 snapshot flushed, BEFORE the fit finished.
+        port = _free_port()
+        procs = []
+        for pid in range(2):
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "JAX_ENABLE_X64": "1",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "TPUML_COORDINATOR": f"127.0.0.1:{port}",
+                "TPUML_NUM_PROCESSES": "2",
+                "TPUML_PROCESS_ID": str(pid),
+                "TPUML_GANG_FIT": "1",
+                "TPUML_CHECKPOINT_DIR": str(gang_dir),
+                "TPUML_CHECKPOINT_EVERY": "2",
+                "TPUML_FAULTS": "checkpoint.segment=1@2",
+            }
+            env.pop("TPUML_TELEMETRY_DIR", None)
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        str(REPO / "tests" / "multiproc_resize_worker.py"),
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    env=env,
+                    cwd=str(REPO),
+                )
+            )
+        outs = [p.communicate(timeout=500) for p in procs]
+        for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+            assert p.returncode != 0, f"member {pid} survived the fault"
+            assert "InjectedFault" in err, f"member {pid}:\n{err[-3000:]}"
+            assert "UNEXPECTED_COMPLETE" not in out
+        snaps = globlib.glob(str(gang_dir / "*" / "ckpt-*.npz"))
+        assert snaps, "the dead gang left no shared mid-solve state"
+
+        # Phase B: cold single-process refit (fresh dir) — the iteration
+        # budget a from-scratch fit pays, and the bit-exact reference.
+        monkeypatch.setenv("TPUML_CHECKPOINT_EVERY", "2")
+        monkeypatch.setenv("TPUML_CHECKPOINT_DIR", str(tmp_path / "ckpt-cold"))
+        clear_counters("checkpoint")
+        cold = self._estimator(init).fit(x)
+        cold_iters = counter_value("checkpoint.solver_iters")
+        assert cold.numIter == 10 and cold_iters == 10
+
+        # Phase C: resume from the GANG's dir, world 2 -> 1, over ALL
+        # rows this time.
+        monkeypatch.setenv("TPUML_CHECKPOINT_DIR", str(gang_dir))
+        clear_counters("checkpoint")
+        log = tmp_path / "events.jsonl"
+        events.configure(str(log))
+        try:
+            warm = self._estimator(init).fit(x)
+        finally:
+            events.configure()  # back to the ambient (env-derived) sink
+        assert counter_value("checkpoint.restore") >= 1
+        assert counter_value("checkpoint.gang_resize") == 1
+        warm_iters = counter_value("checkpoint.solver_iters")
+        assert 0 < warm_iters < cold_iters
+        assert warm.numIter == cold.numIter
+        assert (
+            np.asarray(warm.clusterCenters()).tobytes()
+            == np.asarray(cold.clusterCenters()).tobytes()
+        )
+        assert (
+            np.float64(warm.trainingCost).tobytes()
+            == np.float64(cold.trainingCost).tobytes()
+        )
+        resizes = [
+            json.loads(line)
+            for line in open(log)
+            if '"gang_resize"' in line
+        ]
+        assert [
+            (r["event"], r["action"], r["from_members"], r["to_members"])
+            for r in resizes
+        ] == [("gang_resize", "resume", 2, 1)]

@@ -21,12 +21,22 @@ shards (each member flushes ``metrics-<pid>.json`` into the telemetry
 dir on drain), so p50/p95/p99 cover every member's histogram, not just
 the router process's.
 
+``--ramp "rps1:s1,rps2:s2,..."`` switches to piecewise traffic phases
+instead of the fixed per-worker request count: each phase OFFERS the
+target rate for its duration (shared arrival pacer across the worker
+pool; a slot whose turn has passed fires immediately, so a gang slower
+than the offered rate shows the pressure as latency, never as a silent
+backlog), and the report carries per-phase p50/p95/shed — the diurnal
+ramp-up/ramp-down episodes the elastic serving tier scales to.
+
 Examples::
 
     python tools/tpuml_loadgen.py --family kmeans --threads 16 --requests 200
     python tools/tpuml_loadgen.py --family logreg --rows 4 --max-batch 128 \
         --delay-ms 2 --json
     python tools/tpuml_loadgen.py --workers 4 --threads 16 --requests 100
+    python tools/tpuml_loadgen.py --workers 2 --threads 8 \
+        --ramp "50:5,400:10,50:5" --json
 """
 
 from __future__ import annotations
@@ -101,6 +111,133 @@ def _merged_member_metrics(telemetry_dir):
     return hist, merged.get("counters", {})
 
 
+def _parse_ramp(spec: str):
+    """``"rps1:s1,rps2:s2,..."`` -> [(rps, seconds), ...] with loud
+    rejection of malformed phases (a typo'd ramp silently offering the
+    wrong load would invalidate the whole measurement)."""
+    phases = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        rps, sep, secs = part.partition(":")
+        if not sep:
+            raise SystemExit(
+                f"malformed --ramp phase {part!r}: expected <rps>:<seconds>"
+            )
+        try:
+            pair = (float(rps), float(secs))
+        except ValueError:
+            raise SystemExit(
+                f"malformed --ramp phase {part!r}: expected <rps>:<seconds>"
+            )
+        if pair[0] <= 0 or pair[1] <= 0:
+            raise SystemExit(
+                f"--ramp phase {part!r}: rate and duration must be > 0"
+            )
+        phases.append(pair)
+    if not phases:
+        raise SystemExit("--ramp needs at least one <rps>:<seconds> phase")
+    return phases
+
+
+def _run_ramp(rt, args, phases, probe_pool, distributed: bool):
+    """Drive the piecewise phases closed-loop: one shared arrival pacer
+    hands out send slots at the phase's target rate; ``--threads``
+    workers each carry one outstanding request, so in-flight never
+    exceeds the pool and overload surfaces as latency/shed. Latencies
+    are measured at the submit()->result() boundary (per-phase
+    percentiles can't come from the cumulative registry histogram).
+    Returns (per-phase report, completed, error totals)."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.serving import DeadlineExceeded, Overloaded
+    from spark_rapids_ml_tpu.utils.tracing import counter_value
+
+    def shed_total() -> int:
+        if distributed:
+            return int(
+                counter_value("serving.router.shed")
+                + counter_value("serving.router.rejected")
+            )
+        return int(
+            counter_value("serving.shed.queue")
+            + counter_value("serving.shed.memory")
+        )
+
+    report = []
+    completed = 0
+    totals = {"overloaded": 0, "deadline": 0, "other": 0}
+    for i, (rps, secs) in enumerate(phases):
+        interval = 1.0 / rps
+        start = time.perf_counter()
+        t_end = start + secs
+        lock = threading.Lock()
+        state = {"slot": start, "offered": 0, "ok": 0}
+        lats: list = []
+        errs = {"overloaded": 0, "deadline": 0, "other": 0}
+        shed0 = shed_total()
+
+        def worker(tid: int) -> None:
+            while True:
+                with lock:
+                    slot = state["slot"]
+                    if slot >= t_end:
+                        return
+                    state["slot"] = slot + interval
+                    state["offered"] += 1
+                    j = state["offered"]
+                now = time.perf_counter()
+                if slot > now:
+                    time.sleep(slot - now)
+                probe = probe_pool[(tid + j) % len(probe_pool)]
+                t_req = time.perf_counter()
+                try:
+                    rt.submit(args.family, probe, timeout=args.timeout).result()
+                    dt_ms = (time.perf_counter() - t_req) * 1e3
+                    with lock:
+                        state["ok"] += 1
+                        lats.append(dt_ms)
+                except Overloaded as exc:
+                    with lock:
+                        errs["overloaded"] += 1
+                    if exc.retry_after_ms > 0:
+                        time.sleep(min(exc.retry_after_ms, 100.0) / 1e3)
+                except DeadlineExceeded:
+                    with lock:
+                        errs["deadline"] += 1
+                except Exception:  # noqa: BLE001 - loadgen keeps driving
+                    with lock:
+                        errs["other"] += 1
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(args.threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        arr = np.asarray(lats if lats else [0.0])
+        report.append({
+            "phase": i,
+            "target_rps": rps,
+            "duration_s": secs,
+            "offered": state["offered"],
+            "completed": state["ok"],
+            "achieved_rps": round(state["ok"] / wall, 1) if wall > 0 else 0.0,
+            "p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p95_ms": round(float(np.percentile(arr, 95)), 3),
+            "shed": shed_total() - shed0,
+            "errors": dict(errs),
+        })
+        completed += state["ok"]
+        for key in totals:
+            totals[key] += errs[key]
+    return report, completed, totals
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--family", default="kmeans",
@@ -109,6 +246,11 @@ def main() -> None:
                         help="closed-loop workers (one outstanding request each)")
     parser.add_argument("--requests", type=int, default=200,
                         help="requests per worker")
+    parser.add_argument("--ramp", default=None, metavar="RPS:SECS,...",
+                        help="piecewise traffic phases, e.g. '50:5,400:10,"
+                             "50:5' — offer each rate for its duration and "
+                             "report per-phase p50/p95/shed (overrides "
+                             "--requests)")
     parser.add_argument("--rows", type=int, default=1,
                         help="rows per request (1 = single-row scoring)")
     parser.add_argument("--features", type=int, default=32)
@@ -140,9 +282,18 @@ def main() -> None:
     from spark_rapids_ml_tpu.serving.batcher import _latency_hist
     from spark_rapids_ml_tpu.utils.tracing import counter_value
 
+    ramp_phases = _parse_ramp(args.ramp) if args.ramp else None
+
     model = build_model(args.family, args.features, args.k, args.seed)
     rng = np.random.default_rng(args.seed + 1)
-    probes = rng.normal(size=(args.threads, args.requests, args.rows, args.features))
+    if ramp_phases is not None:
+        # Ramp phases are open-ended in request count: cycle a fixed
+        # probe pool instead of pre-allocating one array per request.
+        probes = rng.normal(size=(256, args.rows, args.features))
+    else:
+        probes = rng.normal(
+            size=(args.threads, args.requests, args.rows, args.features)
+        )
 
     telemetry_dir = None
     if args.workers >= 1:
@@ -207,19 +358,30 @@ def main() -> None:
                     errors["other"] += 1
 
     c_dispatch0 = counter_value("serving.batch.dispatch")
-    threads = [
-        threading.Thread(target=worker, args=(t,)) for t in range(args.threads)
-    ]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
+    ramp_report = None
+    if ramp_phases is not None:
+        t0 = time.perf_counter()
+        ramp_report, completed, errors = _run_ramp(
+            rt, args, ramp_phases, probes, distributed=args.workers >= 1
+        )
+        wall = time.perf_counter() - t0
+        requests_offered = sum(p["offered"] for p in ramp_report)
+    else:
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(args.threads)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        completed = sum(ok)
+        requests_offered = args.threads * args.requests
     router_snapshot = rt.snapshot() if args.workers >= 1 else None
     rt.close()  # members drain and flush their metric shards
 
-    completed = sum(ok)
     rows_done = completed * args.rows
     if args.workers >= 1:
         hist, merged_counters = _merged_member_metrics(telemetry_dir)
@@ -236,7 +398,7 @@ def main() -> None:
     summary = {
         "family": args.family,
         "threads": args.threads,
-        "requests": args.threads * args.requests,
+        "requests": requests_offered,
         "completed": completed,
         "rows_per_request": args.rows,
         "rows_per_s": round(rows_done / wall, 1) if wall > 0 else 0.0,
@@ -251,6 +413,8 @@ def main() -> None:
         "deadline_expired": deadline_expired,
         "errors": errors,
     }
+    if ramp_report is not None:
+        summary["ramp"] = ramp_report
     if router_snapshot is not None:
         summary["workers"] = args.workers
         summary["router_shed"] = counter_value("serving.router.shed")
@@ -282,6 +446,14 @@ def main() -> None:
     print(f"  shed:        queue={summary['shed_queue']} "
           f"memory={summary['shed_memory']} "
           f"deadline={summary['deadline_expired']}")
+    if ramp_report is not None:
+        for p in ramp_report:
+            print(f"  phase {p['phase']}: target={p['target_rps']}rps "
+                  f"x {p['duration_s']}s offered={p['offered']} "
+                  f"completed={p['completed']} "
+                  f"achieved={p['achieved_rps']}rps "
+                  f"p50={p['p50_ms']}ms p95={p['p95_ms']}ms "
+                  f"shed={p['shed']}")
     if router_snapshot is not None:
         print(f"  router:      {args.workers} workers, "
               f"shed={summary['router_shed']} "
