@@ -57,16 +57,18 @@ from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-def _assign_kernel(x, centers, *, cosine: bool):
+def _assign_kernel(x, centers, *, cosine: bool, precision: str = "highest"):
     """Serving kernel: nearest-center labels. Centers follow the batch
     dtype (the model-side cast fuses into the distance GEMM); zero padding
     rows normalize to NaN under cosine but assignments are row-wise, so
-    they never reach a real row's label."""
+    they never reach a real row's label. ``precision`` is the resolved
+    serving-family policy mode (ops/precision.py) — part of the static
+    dict, so it keys the AOT program cache."""
     centers = centers.astype(x.dtype)
     if cosine:
         x = normalize_rows(x)
         centers = normalize_rows(centers)
-    labels, _ = assign_clusters(x, centers)
+    labels, _ = assign_clusters(x, centers, precision=precision)
     return labels
 
 
@@ -82,10 +84,13 @@ class _KMeansParams(Params):
     weightCol = Param("_", "weightCol", "per-row weight column name", toString)
     precision = Param(
         "_", "precision",
-        "matmul precision for the Lloyd GEMMs: highest (6 bf16 passes, the "
-        "reference-parity default) | high (3-pass f32-grade) | default "
-        "(1 bf16 pass — bf16-rounded distances flip only Voronoi-boundary "
-        "assignments; measured cost delta ~1e-4 relative at 20Mx16 k=100)",
+        "matmul precision for the Lloyd GEMMs: highest/f32 (6 bf16 passes, "
+        "the reference-parity default) | high (3-pass f32-grade) | bf16x3 "
+        "(3-pass compensated split, ops/precision.py, max rel err <= 2e-4) "
+        "| default/bf16 (1 bf16 pass — bf16-rounded distances flip only "
+        "Voronoi-boundary assignments; measured cost delta ~1e-4 relative "
+        "at 20Mx16 k=100). Unset, the TPUML_PRECISION[_KMEANS] knobs and "
+        "committed autotune decisions apply (resolve_policy layering).",
         toString,
     )
     backend = Param(
@@ -204,11 +209,9 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
         return self
 
     def setPrecision(self, value: str) -> "KMeans":
-        if value not in ("highest", "high", "default"):
-            raise ValueError(
-                f"precision must be highest/high/default, got {value!r}"
-            )
-        self.set(self.precision, value)
+        from spark_rapids_ml_tpu.ops.precision import validate_mode
+
+        self.set(self.precision, validate_mode(value))
         return self
 
     def setBackend(self, value: str) -> "KMeans":
@@ -268,9 +271,21 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
             "kmeans", lambda: self._fit_in_memory(rows, w_host), fallback
         )
 
+    def _train_precision(self) -> str:
+        """Resolve the fit-time GEMM policy (ops/precision.py): an
+        explicit ``setPrecision`` wins, then the TPUML_PRECISION[_KMEANS]
+        knobs, then a committed autotune decision; otherwise the param's
+        default ('highest') stands — bit-identical to the pre-policy
+        behavior."""
+        from spark_rapids_ml_tpu.ops.precision import resolve_policy
+
+        requested = self.getPrecision() if self.isSet(self.precision) else None
+        return resolve_policy("kmeans", requested, default=self.getPrecision())
+
     def _fit_in_memory(self, rows: Any, w_host) -> "KMeansModel":
         k = self.getK()
         cosine = self.getDistanceMeasure() == "cosine"
+        precision = self._train_precision()
         key = jax.random.key(self.getSeed())
 
         with TraceRange("kmeans fit", TraceColor.CYAN):
@@ -329,7 +344,7 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                     xs, mask, init, ckpt,
                     max_iter=self.getMaxIter(), tol=self.getTol(),
                     cosine=cosine, data_shards=shards,
-                    precision=self.getPrecision(), mesh=self.mesh,
+                    precision=precision, mesh=self.mesh,
                 )
                 from spark_rapids_ml_tpu.parallel.distributed import (
                     replicate_for_host,
@@ -365,7 +380,7 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                     max_iter=self.getMaxIter(),
                     tol=self.getTol(),
                     block_n=bn,
-                    precision=self.getPrecision(),
+                    precision=precision,
                     cosine=cosine,
                     # Explicit backend='fused' off-TPU runs the pallas
                     # interpreter (tests); auto never routes here off-TPU.
@@ -380,7 +395,7 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                 centers, cost, n_iter = lloyd(
                     xs, mask, init, max_iter=self.getMaxIter(), tol=self.getTol(),
                     cosine=cosine, data_shards=shards,
-                    precision=self.getPrecision(),
+                    precision=precision,
                 )
 
         # Gang fits can hand back sharded results; host reads (the model's
@@ -521,7 +536,7 @@ class KMeans(_KMeansParams, Estimator, MLReadable):
                 init,
                 max_iter=self.getMaxIter(),
                 tol=self.getTol(),
-                precision=self.getPrecision(),
+                precision=self._train_precision(),
                 cosine=cosine,
                 dtype=dtype,
             )
@@ -602,11 +617,25 @@ class KMeansModel(_KMeansParams, Model, LazyHostState):
         self.set(self.predictionCol, value)
         return self
 
+    def _serving_precision(self) -> str:
+        """The serving-family policy mode (ops/precision.py). An explicit
+        ``setPrecision`` on the estimator survives into the model via
+        param copy and wins; otherwise the TPUML_PRECISION[_SERVING]
+        knobs and committed autotune decisions apply. Part of the
+        serving static dict, hence of the AOT/program cache key."""
+        from spark_rapids_ml_tpu.ops.precision import resolve_policy
+
+        requested = self.getPrecision() if self.isSet(self.precision) else None
+        return resolve_policy("serving", requested)
+
     def predict(self, x) -> np.ndarray:
         if self._centers_raw is None:
             raise RuntimeError("model has no cluster centers")
         x = matrix_like(x)
-        static = {"cosine": self.getDistanceMeasure() == "cosine"}
+        static = {
+            "cosine": self.getDistanceMeasure() == "cosine",
+            "precision": self._serving_precision(),
+        }
         # Large HOST batches stream block by block (double-buffered: the
         # H2D of block k+1 overlaps the assignment GEMM of block k —
         # the PCA transform's discipline) instead of paying one
@@ -657,7 +686,10 @@ class KMeansModel(_KMeansParams, Model, LazyHostState):
         return ServingSignature(
             kernel=_assign_kernel,
             weights=(centers,),
-            static={"cosine": self.getDistanceMeasure() == "cosine"},
+            static={
+                "cosine": self.getDistanceMeasure() == "cosine",
+                "precision": self._serving_precision(),
+            },
             name="kmeans.predict",
             n_features=int(centers.shape[1]),
             output_spec=lambda n, dtype: (

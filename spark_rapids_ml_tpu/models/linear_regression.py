@@ -49,11 +49,14 @@ from spark_rapids_ml_tpu.core.serving import note_device_cache, serve_rows
 from spark_rapids_ml_tpu.utils.tracing import TraceColor, TraceRange
 
 
-def _predict_kernel(x, coef, intercept):
+def _predict_kernel(x, coef, intercept, *, precision: str = "highest"):
     """Serving kernel: X·coef + b. Coefficients follow the batch dtype
-    (the model-side convention; the cast fuses into the GEMM)."""
+    (the model-side convention; the cast fuses into the GEMM).
+    ``precision`` is the resolved serving-family policy mode
+    (ops/precision.py) — static, so it keys the AOT program cache."""
     return predict_linear(
-        x, coef.astype(x.dtype), intercept.astype(x.dtype)
+        x, coef.astype(x.dtype), intercept.astype(x.dtype),
+        precision=precision,
     )
 
 
@@ -234,12 +237,23 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         (mesh, weightCol, FISTA); ``'auto'`` quietly falls back to
         ``'highest'`` for those."""
         from spark_rapids_ml_tpu.linalg.row_matrix import RowMatrix
+        from spark_rapids_ml_tpu.ops.precision import resolve_policy
 
         requested = self.getPrecision()
         # Only "auto" needs the dtype probe; explicit values pass through.
         input_dtype = (
             self._raw_features_dtype(dataset) if requested == "auto" else None
         )
+        # Mixed-precision policy layering (ops/precision.py): explicit
+        # setPrecision > TPUML_PRECISION[_LINEAR] knobs > committed
+        # autotune decision > the param default. fp64 input keeps its
+        # pre-policy "auto" dd routing — the tuner never displaces fp64
+        # emulation.
+        explicit = self.getPrecision() if self.isSet(self.precision) else None
+        wants_f64 = input_dtype is not None and np.dtype(input_dtype) == np.float64
+        if explicit is None and wants_f64:
+            explicit = "auto"
+        requested = resolve_policy("linear", explicit, default=requested)
         resolved = RowMatrix.resolve(
             requested, mesh=self.mesh, input_dtype=input_dtype
         )
@@ -588,8 +602,23 @@ class LinearRegressionModel(_LinearRegressionParams, Model, LazyHostState):
             _predict_kernel,
             matrix_like(x),
             self._coef_serving(),
+            static={"precision": self._serving_precision()},
             name="linreg.predict",
         )
+
+    def _serving_precision(self) -> str:
+        """The serving-family policy mode (ops/precision.py): an explicit
+        estimator ``setPrecision`` survives into the model and wins
+        (non-GEMM modes like 'auto'/'dd' serve at 'highest'); otherwise
+        the TPUML_PRECISION[_SERVING] knobs and committed autotune
+        decisions apply. Part of the static dict, hence of the
+        AOT/program cache key."""
+        from spark_rapids_ml_tpu.ops.precision import resolve_policy
+
+        requested = self.getPrecision() if self.isSet(self.precision) else None
+        if requested in ("auto", "dd"):
+            requested = "highest"
+        return resolve_policy("serving", requested)
 
     def _coef_serving(self):
         """(coefficients, intercept) as ONE device-resident pair reused by
@@ -618,7 +647,7 @@ class LinearRegressionModel(_LinearRegressionParams, Model, LazyHostState):
         return ServingSignature(
             kernel=_predict_kernel,
             weights=(coef, intercept),
-            static={},
+            static={"precision": self._serving_precision()},
             name="linreg.predict",
             n_features=int(coef.shape[0]),
             output_spec=lambda n, dtype: (

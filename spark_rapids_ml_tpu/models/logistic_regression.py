@@ -70,12 +70,16 @@ def _logistic_fused_knob() -> bool:
     return env_choice("TPUML_LOGISTIC_FUSED", ("0", "1"), "1") == "1"
 
 
-def _forward_kernel(x, w, b, *, n_classes: int, threshold: float):
+def _forward_kernel(
+    x, w, b, *, n_classes: int, threshold: float, precision: str = "highest"
+):
     """Serving kernel: one forward pass -> (labels, probs, raw logits).
     The batch follows the weights' dtype (the fitted precision is the
-    numerics contract; the cast fuses into the logits GEMM)."""
+    numerics contract; the cast fuses into the logits GEMM).
+    ``precision`` is the resolved serving-family policy mode
+    (ops/precision.py) — static, so it keys the AOT program cache."""
     labels, probs, raw = predict_logistic(
-        x.astype(w.dtype), w, b, n_classes=n_classes
+        x.astype(w.dtype), w, b, n_classes=n_classes, precision=precision
     )
     if w.shape[1] == 1 and threshold != 0.5:
         labels = (probs[:, 1] > threshold).astype(jnp.int32)
@@ -99,6 +103,15 @@ class _LogisticRegressionParams(Params):
     family = Param("_", "family", "auto, binomial, or multinomial", toString)
     threshold = Param("_", "threshold", "binary decision threshold", toFloat)
     weightCol = Param("_", "weightCol", "per-row weight column name", toString)
+    precision = Param(
+        "_", "precision",
+        "matmul precision for the X-sweep GEMMs (ops/precision.py): "
+        "highest/f32 (reference-parity default) | high | bf16x3 (3-pass "
+        "compensated split, max rel err <= 2e-4) | default/bf16 (1-pass). "
+        "Unset, the TPUML_PRECISION[_LOGISTIC] knobs and committed "
+        "autotune decisions apply (resolve_policy layering).",
+        toString,
+    )
 
     def __init__(self, uid: Optional[str] = None):
         super().__init__(uid)
@@ -116,6 +129,7 @@ class _LogisticRegressionParams(Params):
             standardization=True,
             family="auto",
             threshold=0.5,
+            precision="highest",
         )
 
     def getFeaturesCol(self) -> str:
@@ -156,6 +170,9 @@ class _LogisticRegressionParams(Params):
 
     def getThreshold(self) -> float:
         return self.getOrDefault(self.threshold)
+
+    def getPrecision(self) -> str:
+        return self.getOrDefault(self.precision)
 
     def getWeightCol(self):
         return (
@@ -234,6 +251,12 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
         self.set(self.threshold, value)
         return self
 
+    def setPrecision(self, value: str) -> "LogisticRegression":
+        from spark_rapids_ml_tpu.ops.precision import validate_mode
+
+        self.set(self.precision, validate_mode(value))
+        return self
+
     def setWeightCol(self, value: str) -> "LogisticRegression":
         self.set(self.weightCol, value)
         return self
@@ -297,6 +320,17 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
             "logistic", lambda: self._fit_in_memory(x_in, y_in, w_host), fallback
         )
 
+    def _train_precision(self) -> str:
+        """Resolve the fit-time GEMM policy (ops/precision.py): explicit
+        ``setPrecision`` wins, then TPUML_PRECISION[_LOGISTIC], then a
+        committed autotune decision; the default stays 'highest'."""
+        from spark_rapids_ml_tpu.ops.precision import resolve_policy
+
+        requested = self.getPrecision() if self.isSet(self.precision) else None
+        return resolve_policy(
+            "logistic", requested, default=self.getPrecision()
+        )
+
     def _fit_in_memory(self, x_in, y_in, w_host) -> "LogisticRegressionModel":
         # Device labels validate on device (two scalar readbacks — the
         # class count defines shapes, so a sync is inherent; what never
@@ -333,6 +367,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
             # Knob read OUTSIDE jit; the flag rides into the programs as a
             # static arg (fused one-pass loss+grad vs legacy two-pass AD).
             fused = _logistic_fused_knob()
+            precision = self._train_precision()
             enet = self.getElasticNetParam()
             # regParam == 0 means zero effective penalty whatever enet says:
             # use the L-BFGS path (faster, and it applies the multinomial
@@ -376,6 +411,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                     init_w=init_w,
                     init_b=init_b,
                     fused=fused,
+                    precision=precision,
                     **extra,
                 )
             else:
@@ -402,6 +438,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                     tol=self.getTol(),
                     multinomial=use_multinomial,
                     fused=fused,
+                    precision=precision,
                 )
         # Gang fits can hand back sharded results; replicate them so every
         # member's host reads see identical values.
@@ -487,6 +524,7 @@ class LogisticRegression(_LogisticRegressionParams, Estimator, MLReadable):
                 tol=self.getTol(),
                 multinomial=family == "multinomial",
                 fused=_logistic_fused_knob(),
+                precision=self._train_precision(),
             )
         model = LogisticRegressionModel(
             self.uid,
@@ -626,6 +664,7 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
         static = {
             "n_classes": self.numClasses,
             "threshold": float(self.getThreshold()),
+            "precision": self._serving_precision(),
         }
         x = matrix_like(x)
         if not is_device_array(x):
@@ -645,6 +684,17 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
             static=static,
             name="logreg.predict",
         )
+
+    def _serving_precision(self) -> str:
+        """The serving-family policy mode (ops/precision.py): an explicit
+        estimator ``setPrecision`` survives into the model and wins;
+        otherwise the TPUML_PRECISION[_SERVING] knobs and committed
+        autotune decisions apply. Part of the static dict, hence of the
+        AOT/program cache key."""
+        from spark_rapids_ml_tpu.ops.precision import resolve_policy
+
+        requested = self.getPrecision() if self.isSet(self.precision) else None
+        return resolve_policy("serving", requested)
 
     def _wb_serving(self):
         """Weights/intercepts as ONE device-resident pair reused across
@@ -674,6 +724,7 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
             static={
                 "n_classes": self.numClasses,
                 "threshold": float(self.getThreshold()),
+                "precision": self._serving_precision(),
             },
             name="logreg.predict",
             n_features=int(w.shape[0]),

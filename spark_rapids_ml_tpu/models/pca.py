@@ -331,13 +331,25 @@ class PCA(_PCAParams, Estimator, MLReadable):
                     probe_source = dataset
             except ImportError:  # pragma: no cover
                 pass
+        input_dtype = (
+            infer_input_dtype(probe_source) if requested_prec == "auto" else None
+        )
+        # Mixed-precision policy layering (ops/precision.py): explicit
+        # setPrecision > TPUML_PRECISION[_PCA] knobs > committed autotune
+        # decision > the param default. fp64 input keeps its pre-policy
+        # "auto" dd routing — the tuner never displaces fp64 emulation.
+        from spark_rapids_ml_tpu.ops.precision import resolve_policy
+
+        explicit = self.getPrecision() if self.isSet(self.precision) else None
+        wants_f64 = input_dtype is not None and np.dtype(input_dtype) == np.float64
+        if explicit is None and wants_f64:
+            explicit = "auto"
+        requested_prec = resolve_policy("pca", explicit, default=requested_prec)
         resolved_prec = RowMatrix.resolve(
             requested_prec,
             mesh=self.mesh,
             # Only "auto" needs the raw-dtype probe.
-            input_dtype=(
-                infer_input_dtype(probe_source) if requested_prec == "auto" else None
-            ),
+            input_dtype=input_dtype,
             backend=self.getCovarianceBackend(),
         )
         # 'auto' peeks at the first partition/block only — the covariance
@@ -400,6 +412,16 @@ class PCA(_PCAParams, Estimator, MLReadable):
         model = PCAModel(self.uid, pc, explained)
         return self._copyValues(model)
 
+    def _sketch_precision(self) -> str:
+        """Policy mode for the randomized-sketch GEMMs (ops/precision.py).
+        The sketch is fp32-only, so 'auto' resolves 'highest' here
+        (explicit 'dd' was rejected before routing)."""
+        from spark_rapids_ml_tpu.ops.precision import resolve_policy
+
+        requested = self.getPrecision() if self.isSet(self.precision) else None
+        mode = resolve_policy("pca", requested, default="highest")
+        return "highest" if mode in ("auto", "dd") else mode
+
     def _fit_randomized(self, rows) -> "PCAModel":
         """Wide-feature path: subspace sketch, no (d, d) covariance.
 
@@ -422,6 +444,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
         )
 
         k = self.getK()
+        prec = self._sketch_precision()
         if is_streaming_source(rows):
             from spark_rapids_ml_tpu.core.data import iter_stream_blocks
 
@@ -431,6 +454,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
                 k,
                 jax.random.key(0),
                 center=self.getMeanCentering(),
+                precision=prec,
                 device=jax.local_devices()[gpu_id] if gpu_id >= 0 else None,
             )
             return self._copyValues(PCAModel(self.uid, comps, ratio))
@@ -523,6 +547,7 @@ class PCA(_PCAParams, Estimator, MLReadable):
             center=self.getMeanCentering(),
             mask=mask,
             n_true=n_true,
+            precision=prec,
         )
         # Gang fits can hand back sharded results; the model's lazy host
         # pulls need them fully replicated (no-op otherwise).
@@ -614,6 +639,7 @@ class PCAModel(_PCAParams, Model, LazyHostState):
                     _project_kernel,
                     rows,
                     (self._pc_device(rows.dtype),),
+                    static={"precision": self._serving_precision()},
                     name="pca.transform",
                 )
 
@@ -638,6 +664,7 @@ class PCAModel(_PCAParams, Model, LazyHostState):
                     _project_kernel,
                     dense_blocks(),
                     (pc_dev,),
+                    static={"precision": self._serving_precision()},
                     name="pca.transform",
                     dtype=pc_dev.dtype,
                 )
@@ -648,6 +675,7 @@ class PCAModel(_PCAParams, Model, LazyHostState):
                     _project_kernel,
                     parts,
                     (pc_dev,),
+                    static={"precision": self._serving_precision()},
                     name="pca.transform",
                     dtype=pc_dev.dtype,
                 )
@@ -689,6 +717,20 @@ class PCAModel(_PCAParams, Model, LazyHostState):
 
         return jax.dtypes.canonicalize_dtype(self.pc.dtype)
 
+    def _serving_precision(self) -> str:
+        """The serving-family policy mode (ops/precision.py): an explicit
+        estimator ``setPrecision`` survives into the model and wins
+        (non-GEMM modes like 'auto'/'dd' serve at 'highest'); otherwise
+        the TPUML_PRECISION[_SERVING] knobs and committed autotune
+        decisions apply. Part of the static dict, hence of the
+        AOT/program cache key."""
+        from spark_rapids_ml_tpu.ops.precision import resolve_policy
+
+        requested = self.getPrecision() if self.isSet(self.precision) else None
+        if requested in ("auto", "dd"):
+            requested = "highest"
+        return resolve_policy("serving", requested)
+
     def serving_signature(self):
         """The online-serving contract: the projection kernel, the
         device-resident components at the serving dtype, and the (n, k)
@@ -704,7 +746,7 @@ class PCAModel(_PCAParams, Model, LazyHostState):
         return ServingSignature(
             kernel=_project_kernel,
             weights=(pc,),
-            static={},
+            static={"precision": self._serving_precision()},
             name="pca.transform",
             n_features=d,
             output_spec=lambda n, dtype: (

@@ -602,13 +602,43 @@ class Autotuner:
         *,
         evidence: Iterable[str] = (),
         metric_name: str = "seconds_per_row",
+        ok: bool = True,
+        reason: str = "regression",
     ) -> bool:
         """Commit-or-revert: commit ``value`` as the incumbent for
         (knob, key) iff its measured ``metric`` (lower is better) beats
         the incumbent's; otherwise keep the incumbent and record the
-        rejected candidate. A regression is never accepted."""
+        rejected candidate. A regression is never accepted. A caller
+        that already knows the candidate is disqualified (``ok=False``
+        — e.g. the precision gate's parity probe missed its bound)
+        records it rejected with ``reason`` no matter how fast it ran.
+        """
         metric = float(metric)
         inc = self.store.get(knob, key)
+        if not ok:
+            if inc is None:
+                # Nothing to stand against yet: persist a placeholder so
+                # the rejection (and its reason) is still on the record.
+                inc = {
+                    "knob": knob, "key": key, "value": None,
+                    "metric": None, "metric_name": metric_name,
+                    "evidence": [], "rejected": [], "trials": 0,
+                }
+            inc.setdefault("rejected", []).append({
+                "value": value,
+                "metric": metric,
+                "reason": reason,
+            })
+            inc["trials"] = int(inc.get("trials", 0)) + 1
+            inc["updated"] = time.time()
+            self.store.put(inc)
+            bump_counter("autotune.revert")
+            emit(
+                "autotune", action="revert", knob=knob, key=key,
+                value=value, metric=metric, incumbent=inc.get("value"),
+                reason=reason,
+            )
+            return False
         if inc is not None and inc.get("value") == value:
             # Re-measurement of the incumbent: keep its best evidence.
             if metric < float(inc.get("metric") or float("inf")):

@@ -433,6 +433,10 @@ class Ledger:
             "watermarks": watermarks,
             "retraces": retraces,
             "peaks": device_peaks(),
+            # family -> resolved precision policy mode (ops/precision.py)
+            # — lets offline renderers (tpuml_prof) price each family's
+            # utilization against the mode's peak, not the fp32 ceiling.
+            "precision_modes": _precision_modes(),
         }
 
 
@@ -881,6 +885,14 @@ def attribute_hbm_growth(samples: List[tuple], spans: List[dict]) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _precision_modes() -> Dict[str, str]:
+    """Snapshot of the resolved per-family precision modes (empty when
+    no fit/predict ever resolved a policy this process)."""
+    from spark_rapids_ml_tpu.ops.precision import active_modes
+
+    return dict(sorted(active_modes().items()))
+
+
 def device_peaks() -> Dict[str, Optional[float]]:
     """Operator-declared device ceilings for utilization estimates
     (``TPUML_PEAK_FLOPS`` / ``TPUML_PEAK_BYTES_PER_SEC``; None = not
@@ -919,9 +931,22 @@ def roofline_row(entry_json: dict) -> dict:
         if byts is not None:
             out["achieved_bytes_per_sec"] = byts * inv / wall
     peaks = device_peaks()
+    # Price the flops bound against the ACTIVE precision policy's peak
+    # (ops/precision.py): the declared TPUML_PEAK_FLOPS is the fp32
+    # (6-pass) ceiling, and a family running bf16x3/bf16 has a 2x/6x
+    # higher achievable ceiling. Scale is 1.0 when no mode was ever
+    # resolved for the family — exactly the pre-policy report.
+    from spark_rapids_ml_tpu.ops.precision import active_mode, roofline_peak_scale
+
+    scale = roofline_peak_scale(entry_json.get("family") or "")
+    mode = active_mode(entry_json.get("family") or "")
+    if mode is not None:
+        out["precision_mode"] = mode
     bounds = []
     if peaks["flops_per_sec"] and out["achieved_flops_per_sec"] is not None:
-        bounds.append(out["achieved_flops_per_sec"] / peaks["flops_per_sec"])
+        bounds.append(
+            out["achieved_flops_per_sec"] / (peaks["flops_per_sec"] * scale)
+        )
     if peaks["bytes_per_sec"] and out["achieved_bytes_per_sec"] is not None:
         bounds.append(out["achieved_bytes_per_sec"] / peaks["bytes_per_sec"])
     if bounds:

@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.ops.linalg import _dot_precision, _triu_indices_packed
+from spark_rapids_ml_tpu.ops.linalg import _triu_indices_packed
+from spark_rapids_ml_tpu.ops.precision import make_dot
 
 
 @partial(jax.jit, static_argnames=("precision",))
@@ -33,7 +34,7 @@ def centered_gram(x: jax.Array, mean: jax.Array, precision: str = "highest") -> 
     RapidsRowMatrix.scala:162), and partials are summed by a collective.
     """
     b = x - mean
-    return jnp.matmul(b.T, b, precision=_dot_precision(precision))
+    return make_dot(precision)(b.T, b)
 
 
 @partial(jax.jit, static_argnames=("precision",))
@@ -70,11 +71,11 @@ def centered_gram_blocked(
     pad = nb * block_rows - n
     x = jnp.concatenate([x, jnp.broadcast_to(mean, (pad, d))], axis=0) if pad else x
     blocks = x.reshape(nb, block_rows, d)
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
 
     def body(acc, blk):
         b = blk - mean
-        return acc + jnp.matmul(b.T, b, precision=prec), None
+        return acc + dot(b.T, b), None
 
     acc0 = jnp.zeros((d, d), dtype=x.dtype)
     acc, _ = jax.lax.scan(body, acc0, blocks)
@@ -179,11 +180,11 @@ def _sharded_block_gram(mesh, precision: str):
     per block (the cross-chip reduce of the streamed mesh covariance)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
 
     @partial(jax.jit, out_shardings=NamedSharding(mesh, P()))
     def gram(xs):
-        return jnp.matmul(xs.T, xs, precision=prec)
+        return dot(xs.T, xs)
 
     return gram
 

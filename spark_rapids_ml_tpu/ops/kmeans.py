@@ -30,44 +30,48 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_ml_tpu.ops.linalg import _dot_precision
+from spark_rapids_ml_tpu.ops.precision import as_dot, make_dot
 
 
-def _sq_dists(x, centers, x2, prec):
-    """(n, k) squared euclidean distances via the Gram expansion."""
+def _sq_dists(x, centers, x2, dot):
+    """(n, k) squared euclidean distances via the Gram expansion.
+    ``dot`` is the policy-resolved matmul (ops.precision.make_dot)."""
     c2 = jnp.sum(centers * centers, axis=1)
-    xc = jnp.matmul(x, centers.T, precision=prec)
+    xc = dot(x, centers.T)
     return jnp.maximum(x2[:, None] - 2.0 * xc + c2[None, :], 0.0)
 
 
 @partial(jax.jit, static_argnames=("precision",))
 def assign_clusters(x, centers, precision: str = "highest"):
     """Labels + per-row squared distance to the nearest center."""
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     x2 = jnp.sum(x * x, axis=1)
-    d2 = _sq_dists(x, centers, x2, prec)
+    d2 = _sq_dists(x, centers, x2, dot)
     labels = jnp.argmin(d2, axis=1)
     return labels, jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
 
 
-def _assign_and_accumulate(xb, mb, x2b, centers, k, prec):
+def _assign_and_accumulate(xb, mb, x2b, centers, k, dot):
     """Block-local assignment + sufficient stats: (sums (k,d), counts (k),
     cost) for one row block — everything stays block-sized, so XLA fuses
     the distance GEMM, argmin, and one-hot matmul without ever writing an
     (n, k) array to HBM."""
-    d2 = _sq_dists(xb, centers, x2b, prec)
+    d2 = _sq_dists(xb, centers, x2b, dot)
     labels = jnp.argmin(d2, axis=1)
     min_d2 = jnp.min(d2, axis=1)
     one_hot = jax.nn.one_hot(labels, k, dtype=xb.dtype) * mb[:, None]
-    sums = jnp.matmul(one_hot.T, xb, precision=prec)  # (k, d) on MXU
+    sums = dot(one_hot.T, xb)  # (k, d) on MXU
     counts = jnp.sum(one_hot, axis=0)
     cost = jnp.sum(min_d2 * mb)
     return sums, counts, cost
 
 
-def lloyd_step(x, mask, centers, x2, prec, cosine: bool = False,
+def lloyd_step(x, mask, centers, x2, dot, cosine: bool = False,
                block_rows: int | None = None):
     """One Lloyd iteration. Returns (new_centers, cost).
+
+    ``dot`` is the policy matmul (ops.precision.make_dot); legacy
+    spellings (a mode string or a bare ``lax.Precision``) coerce.
 
     ``cosine``: renormalize updated centers to unit norm (Spark's
     CosineDistanceMeasure.updateClusterCenter) so assignments stay true
@@ -80,16 +84,17 @@ def lloyd_step(x, mask, centers, x2, prec, cosine: bool = False,
     is one read of x. Rows must already be padded (mask=0) to a multiple
     of ``block_rows`` by the caller-facing :func:`lloyd`.
     """
+    dot = as_dot(dot)
     k = centers.shape[0]
     if block_rows is None or x.shape[0] <= block_rows:
-        sums, counts, cost = _assign_and_accumulate(x, mask, x2, centers, k, prec)
+        sums, counts, cost = _assign_and_accumulate(x, mask, x2, centers, k, dot)
     else:
         nb = x.shape[0] // block_rows
 
         def body(carry, blk):
             s, c, j = carry
             xb, mb, x2b = blk
-            sb, cb, jb = _assign_and_accumulate(xb, mb, x2b, centers, k, prec)
+            sb, cb, jb = _assign_and_accumulate(xb, mb, x2b, centers, k, dot)
             return (s + sb, c + cb, j + jb), None
 
         init = (
@@ -176,7 +181,7 @@ def lloyd(
     (n/shards, k) temporary against HBM — a row-sharded multi-chip fit must
     not fall onto the sequential blocked path dp times too early.
     """
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     n = x.shape[0]
     k = init_centers.shape[0]
     block_rows = _auto_block_rows(n, k, data_shards, block_rows)
@@ -196,7 +201,7 @@ def lloyd(
     def body(state):
         centers, _, it, _ = state
         new_centers, cost = lloyd_step(
-            x, mask, centers, x2, prec, cosine=cosine, block_rows=br
+            x, mask, centers, x2, dot, cosine=cosine, block_rows=br
         )
         moved = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
         return new_centers, moved, it + 1, cost
@@ -204,7 +209,7 @@ def lloyd(
     init_state = (init_centers, jnp.asarray(jnp.inf, x.dtype), 0, jnp.asarray(0.0, x.dtype))
     centers, _, n_iter, cost = jax.lax.while_loop(cond, body, init_state)
     # One final cost evaluation against the converged centers.
-    _, final_cost = lloyd_step(x, mask, centers, x2, prec, cosine=cosine, block_rows=br)
+    _, final_cost = lloyd_step(x, mask, centers, x2, dot, cosine=cosine, block_rows=br)
     return centers, final_cost, n_iter
 
 
@@ -224,7 +229,7 @@ def _lloyd_segment(
     (centers, movement, iteration counter, cost) visible as a pytree
     between segments (the checkpointable form). ``x`` must already be
     padded to the block multiple (the driver owns the padding, once)."""
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     x2 = jnp.sum(x * x, axis=1)
     br = block_rows if (block_rows is not None and x.shape[0] > block_rows) else None
 
@@ -237,7 +242,7 @@ def _lloyd_segment(
     def body(state):
         centers, _, it, _, seg = state
         new_centers, cost = lloyd_step(
-            x, mask, centers, x2, prec, cosine=cosine, block_rows=br
+            x, mask, centers, x2, dot, cosine=cosine, block_rows=br
         )
         moved = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1))
         return new_centers, moved, it + 1, cost, seg + 1
@@ -252,10 +257,10 @@ def _lloyd_segment(
 def _lloyd_final_cost(x, mask, centers, precision: str, cosine: bool, block_rows):
     """The converged-centers cost evaluation :func:`lloyd` ends with,
     as its own program for the segmented driver."""
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     x2 = jnp.sum(x * x, axis=1)
     br = block_rows if (block_rows is not None and x.shape[0] > block_rows) else None
-    _, cost = lloyd_step(x, mask, centers, x2, prec, cosine=cosine, block_rows=br)
+    _, cost = lloyd_step(x, mask, centers, x2, dot, cosine=cosine, block_rows=br)
     return cost
 
 
@@ -352,7 +357,7 @@ def assign_clusters_blocked(
     never materializes (one (block, k) buffer per ``lax.map`` step).
     The assignment path for n*k shapes whose full distance matrix would
     blow HBM (e.g. the IVF coarse quantizer at 3M x 2048)."""
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     n = x.shape[0]
     nb = -(-n // block_rows)
     pad = nb * block_rows - n
@@ -360,7 +365,7 @@ def assign_clusters_blocked(
 
     def one(xb):
         x2 = jnp.sum(xb * xb, axis=1)
-        d2 = _sq_dists(xb, centers, x2, prec)
+        d2 = _sq_dists(xb, centers, x2, dot)
         return jnp.argmin(d2, axis=1), jnp.min(d2, axis=1)
 
     labs, d2s = jax.lax.map(one, xp.reshape(nb, block_rows, -1))
@@ -373,10 +378,10 @@ def block_suff_stats(xb: jax.Array, centers: jax.Array, precision: str = "highes
     fixed centers: (sums (k, d), counts (k,), cost). The streaming fit's
     per-block kernel — accumulating these across blocks and dividing is
     exactly one Lloyd iteration at O(block + k*d) memory."""
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     x2 = jnp.sum(xb * xb, axis=1)
     mb = jnp.ones(xb.shape[0], xb.dtype)
-    return _assign_and_accumulate(xb, mb, x2, centers, centers.shape[0], prec)
+    return _assign_and_accumulate(xb, mb, x2, centers, centers.shape[0], dot)
 
 
 def reservoir_sample_rows(blocks, cap: int, seed: int, dtype=None):
@@ -514,7 +519,7 @@ def kmeans_plusplus_init(
     candidate evaluation. Masked (padded) rows are never selected and never
     contribute to the potential.
     """
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     n, d = x.shape
     neg_inf = jnp.asarray(-jnp.inf, x.dtype)
     t = 2 + max(int(np.ceil(np.log2(k))), 0)
@@ -530,7 +535,7 @@ def kmeans_plusplus_init(
     # enters only at the sampling probabilities and the potential — scaling
     # min_d2 itself would compound weights across iterations (w^i) and
     # compare weighted against unweighted candidate distances.
-    min_d2 = jnp.maximum(x2 - 2.0 * jnp.matmul(x, x[first], precision=prec) + x2[first], 0.0)
+    min_d2 = jnp.maximum(x2 - 2.0 * dot(x, x[first]) + x2[first], 0.0)
 
     def body(i, carry):
         centers, min_d2, key = carry
@@ -547,7 +552,7 @@ def kmeans_plusplus_init(
         # Evaluate each candidate: potential = sum_j min(min_d2, d2(x_j, c)).
         xc = x[cand]                                            # (t, d)
         d2c = jnp.maximum(
-            x2[None, :] - 2.0 * jnp.matmul(xc, x.T, precision=prec)
+            x2[None, :] - 2.0 * dot(xc, x.T)
             + jnp.sum(xc * xc, axis=1)[:, None],
             0.0,
         )                                                       # (t, n)

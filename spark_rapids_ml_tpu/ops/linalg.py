@@ -33,7 +33,12 @@ def _dot_precision(precision: str):
     }[precision]
 
 
-PRECISIONS = ("auto", "default", "high", "highest", "dd")
+PRECISIONS = (
+    "auto", "default", "high", "highest", "dd",
+    # named policy modes (ops/precision.py): f32 == highest bit-for-bit,
+    # bf16x3 = 3-pass compensated split, bf16 = 1-pass serving-grade.
+    "f32", "bf16x3", "bf16",
+)
 
 
 def validate_precision(value: str) -> str:
@@ -83,7 +88,9 @@ def gemm_syrk(b: jax.Array, precision: str = "highest") -> jax.Array:
     row-major B as column-major A=Bᵀ into cublasDgemm(OP_N, OP_T). Here it is
     a single dot_general that XLA tiles directly onto the MXU.
     """
-    return jnp.matmul(b.T, b, precision=_dot_precision(precision))
+    from spark_rapids_ml_tpu.ops.precision import make_dot
+
+    return make_dot(precision)(b.T, b)
 
 
 @partial(jax.jit, static_argnames=("precision",))
@@ -93,7 +100,9 @@ def project_rows(x: jax.Array, pc: jax.Array, precision: str = "highest") -> jax
     concrete device array outside jit would materialize a copy, so this
     takes X directly). Same kernel the reference's disabled batch
     transform wanted (``dgemm_b``, rapidsml_jni.cu:269-276)."""
-    return jnp.matmul(x, pc, precision=_dot_precision(precision))
+    from spark_rapids_ml_tpu.ops.precision import make_dot
+
+    return make_dot(precision)(x, pc)
 
 
 @partial(jax.jit, static_argnames=("precision",))
@@ -104,7 +113,9 @@ def gemm_project(a: jax.Array, b: jax.Array, precision: str = "highest") -> jax.
     consumer (GPU batch transform) is disabled as too slow
     (RapidsPCA.scala:172-185); here it is the live transform path.
     """
-    return jnp.matmul(a.T, b, precision=_dot_precision(precision))
+    from spark_rapids_ml_tpu.ops.precision import make_dot
+
+    return make_dot(precision)(a.T, b)
 
 
 @jax.jit

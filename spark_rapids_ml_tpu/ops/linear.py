@@ -23,7 +23,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from spark_rapids_ml_tpu.ops.linalg import _dot_precision, soft_threshold
+from spark_rapids_ml_tpu.ops.linalg import soft_threshold
+from spark_rapids_ml_tpu.ops.precision import make_dot
 
 
 @partial(jax.jit, static_argnames=("precision",))
@@ -39,16 +40,16 @@ def normal_eq_stats(
     multiplies entirely — at small d this config is bytes-bound and the
     x*mask pass would nearly double the HBM traffic for nothing.
     """
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     if mask is None:
-        xtx = jnp.matmul(x.T, x, precision=prec)
-        xty = jnp.matmul(x.T, y, precision=prec)
+        xtx = dot(x.T, x)
+        xty = dot(x.T, y)
         n = jnp.asarray(x.shape[0], x.dtype)
         return (xtx, xty, jnp.sum(x, axis=0), jnp.sum(y), jnp.sum(y * y), n)
     xm = x * mask[:, None]
     ym = y * mask
-    xtx = jnp.matmul(xm.T, x, precision=prec)
-    xty = jnp.matmul(xm.T, y, precision=prec)
+    xtx = dot(xm.T, x)
+    xty = dot(xm.T, y)
     return (
         xtx,
         xty,
@@ -128,7 +129,7 @@ def solve_normal(
 
 @partial(jax.jit, static_argnames=("precision",))
 def predict_linear(x: jax.Array, coef: jax.Array, intercept, precision: str = "highest"):
-    return jnp.matmul(x, coef, precision=_dot_precision(precision)) + intercept
+    return make_dot(precision)(x, coef) + intercept
 
 
 @jax.jit

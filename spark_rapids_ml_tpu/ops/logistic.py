@@ -33,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from spark_rapids_ml_tpu.ops.linalg import _dot_precision, soft_threshold
+from spark_rapids_ml_tpu.ops.linalg import soft_threshold
+from spark_rapids_ml_tpu.ops.precision import as_dot, make_dot
 
 
 class LogisticFit(NamedTuple):
@@ -53,7 +54,7 @@ _FUSED_BLOCK_ROWS = 65536
 
 
 def _make_logistic_loss(
-    x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec,
+    x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, dot,
     fused=False,
 ):
     """The ONE home of the (standardized-space) logistic objective —
@@ -75,11 +76,12 @@ def _make_logistic_loss(
     tolerance (per-block partial sums reduce in a different order);
     every segmented/monolithic pair shares ONE flag, so checkpoint
     bit-identity is preserved in both modes."""
+    dot = as_dot(dot)
 
     def _block_terms(xb, yb, mb, w, b):
         """One row block's (masked loss sum, unnormalized dL/dw, dL/db)."""
         xs = (xb - offset) / scale
-        logits = jnp.matmul(xs, w, precision=prec)
+        logits = dot(xs, w)
         if fit_intercept:
             logits = logits + b
         if c == 1:
@@ -92,7 +94,7 @@ def _make_logistic_loss(
             per_row = -jnp.sum(yb * logp, axis=1)
             dz = (jnp.exp(logp) - yb) * mb[:, None]
         loss_b = jnp.sum(per_row * mb)
-        gw_b = jnp.matmul(xs.T, dz, precision=prec)
+        gw_b = dot(xs.T, dz)
         gb_b = jnp.sum(dz, axis=0)
         return loss_b, gw_b, gb_b
 
@@ -101,7 +103,7 @@ def _make_logistic_loss(
         def loss_fn(params):
             w, b = params
             xs = (x - offset) / scale
-            logits = jnp.matmul(xs, w, precision=prec)
+            logits = dot(xs, w)
             if fit_intercept:
                 logits = logits + b
             if c == 1:
@@ -241,7 +243,7 @@ def fit_logistic(
             dtype = jnp.float64
             x = x.astype(dtype)
             mask = mask.astype(dtype)
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     n = jnp.sum(mask)
 
     mean, sigma = _masked_feature_moments(x, mask)
@@ -265,7 +267,7 @@ def fit_logistic(
         y_target = jax.nn.one_hot(y, c, dtype=dtype)
 
     loss_fn = _make_logistic_loss(
-        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec,
+        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, dot,
         fused=fused,
     )
 
@@ -287,7 +289,7 @@ def fit_logistic(
                 if init_b is not None
                 else jnp.zeros((c,), dtype=dtype)
             )
-            b0 = b_orig0 + jnp.matmul(offset, w_orig0, precision=prec)
+            b0 = b_orig0 + dot(offset, w_orig0)
         else:
             # No intercept in the model: b is never optimized (zero
             # gradient), so a stale nonzero init would leak into predict.
@@ -325,7 +327,7 @@ def fit_logistic(
 
     # Map standardized-space solution back to original feature space.
     w_orig = w / scale[:, None]
-    b_orig = b - jnp.matmul(offset, w_orig, precision=prec) if fit_intercept else b
+    b_orig = b - dot(offset, w_orig) if fit_intercept else b
     final_loss = loss_fn((w, b))
     if out_dtype is not None:  # f64 fallback solve: hand back f32
         w_orig = w_orig.astype(out_dtype)
@@ -367,9 +369,9 @@ def _lbfgs_segment(
     state — exactly :func:`fit_logistic`'s loop body and stopping rule
     plus a segment budget, with the full (params, optax state, iteration,
     gradient norm) carry visible as a pytree between segments."""
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     loss_fn = _make_logistic_loss(
-        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec,
+        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, dot,
         fused=fused,
     )
     solver = optax.lbfgs()
@@ -409,9 +411,9 @@ def _logistic_finalize(
     """:func:`fit_logistic`'s post-solve tail (identifiability pivot,
     back-map to original feature space, final objective) as its own
     program for the segmented driver."""
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     loss_fn = _make_logistic_loss(
-        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, prec,
+        x, y_target, mask, offset, scale, n, reg_param, c, fit_intercept, dot,
         fused=fused,
     )
     if c > 1:
@@ -419,7 +421,7 @@ def _logistic_finalize(
         w = jnp.where(do_center, w - jnp.mean(w, axis=1, keepdims=True), w)
         b = jnp.where(do_center, b - jnp.mean(b), b)
     w_orig = w / scale[:, None]
-    b_orig = b - jnp.matmul(offset, w_orig, precision=prec) if fit_intercept else b
+    b_orig = b - dot(offset, w_orig) if fit_intercept else b
     return w_orig, b_orig, loss_fn((w, b))
 
 
@@ -471,7 +473,7 @@ def fit_logistic_resumable(
             dtype = jnp.float64
             x = x.astype(dtype)
             mask = mask.astype(dtype)
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     offset, scale, n = _logistic_prep(
         x, mask, fit_intercept=fit_intercept, standardization=standardization
     )
@@ -493,7 +495,7 @@ def fit_logistic_resumable(
                 if init_b is not None
                 else jnp.zeros((c,), dtype=dtype)
             )
-            b0 = b_orig0 + jnp.matmul(offset, w_orig0, precision=prec)
+            b0 = b_orig0 + dot(offset, w_orig0)
         else:
             b0 = jnp.zeros((c,), dtype=dtype)
 
@@ -585,7 +587,7 @@ def fit_logistic_elastic_net(
     c = n_classes if (multinomial or n_classes > 2) else 1
     d = x.shape[1]
     dtype = x.dtype
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     n = jnp.sum(mask)
 
     mean, sigma = _masked_feature_moments(x, mask)
@@ -606,10 +608,10 @@ def fit_logistic_elastic_net(
     reg2 = reg_param * (1.0 - elastic_net_param)
 
     def xs_matvec(v):
-        return jnp.matmul((x - offset) / scale, v, precision=prec)
+        return dot((x - offset) / scale, v)
 
     def xs_rmatvec(u):
-        return jnp.matmul(((x - offset) / scale).T, u * mask, precision=prec)
+        return dot(((x - offset) / scale).T, u * mask)
 
     # Spectral norm of the masked standardized design via power iteration:
     # L_data = lambda_max(Xs^T M Xs) * curvature_bound / n, where the
@@ -636,7 +638,7 @@ def fit_logistic_elastic_net(
     if fused:
         smooth_loss = _make_logistic_loss(
             x, y_target, mask, offset, scale, n, reg2, c, fit_intercept,
-            prec, fused=True,
+            dot, fused=True,
         )
 
         def grad_fn(params):
@@ -688,7 +690,7 @@ def fit_logistic_elastic_net(
     w, b, _, _, _, n_iter, _ = jax.lax.while_loop(cond, body, init)
 
     w_orig = w / scale[:, None]
-    b_orig = b - jnp.matmul(offset, w_orig, precision=prec) if fit_intercept else b
+    b_orig = b - dot(offset, w_orig) if fit_intercept else b
     final_loss = smooth_loss((w, b)) + reg1 * jnp.sum(jnp.abs(w))
     return LogisticFit(w_orig, b_orig, n_iter, final_loss)
 
@@ -705,7 +707,7 @@ def _stream_block_value_grad(
     global n and adds the L2 term once). ``fused=True`` computes the
     value and the analytic gradient in one sweep of the block (no AD
     residual); ``fused=False`` keeps the autodiff formulation."""
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     dtype = xb.dtype
     if c == 1:
         y_t = (yb == 1).astype(dtype)
@@ -714,7 +716,7 @@ def _stream_block_value_grad(
 
     if fused:
         xs = (xb - offset) / scale
-        logits = jnp.matmul(xs, w, precision=prec)
+        logits = dot(xs, w)
         if fit_intercept:
             logits = logits + b
         if c == 1:
@@ -726,14 +728,14 @@ def _stream_block_value_grad(
             per_row = -jnp.sum(y_t * logp, axis=1)
             dz = jnp.exp(logp) - y_t
         val = jnp.sum(per_row)
-        gw = jnp.matmul(xs.T, dz, precision=prec)
+        gw = dot(xs.T, dz)
         gb = jnp.sum(dz, axis=0) if fit_intercept else jnp.zeros_like(b)
         return val, gw, gb
 
     def f(params):
         w_, b_ = params
         xs = (xb - offset) / scale
-        logits = jnp.matmul(xs, w_, precision=prec)
+        logits = dot(xs, w_)
         if fit_intercept:
             logits = logits + b_
         if c == 1:
@@ -895,8 +897,8 @@ def predict_logistic(
     precision: str = "highest",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(labels, probabilities (n, n_classes), raw logits (n, n_classes))."""
-    prec = _dot_precision(precision)
-    logits = jnp.matmul(x, weights, precision=prec) + intercepts
+    dot = make_dot(precision)
+    logits = dot(x, weights) + intercepts
     if weights.shape[1] == 1:
         z = logits[:, 0]
         p1 = jax.nn.sigmoid(z)

@@ -38,6 +38,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from spark_rapids_ml_tpu.ops.precision import pallas_precision
+
+# Unused-slot score sentinel. Historically +inf; a FINITE bf16-exact
+# power of two now, because the 3-pass compensated split is undefined on
+# non-finite values (hi(inf) = inf, lo = inf - inf = NaN — and bf16
+# saturates to inf at 3.4e38, earlier than many f32 intermediates). Any
+# real squared-norm score is astronomically below 2^125 ≈ 4.3e37, so the
+# argmin/min semantics are unchanged bit-for-bit.
+_UNUSED_SCORE = 2.0 ** 125
 
 
 def _split_hi_lo(a):
@@ -139,6 +148,7 @@ def assign_stats_fused(
     count from a different cluster than the kernel assigned it to
     (ADVICE r4).
     """
+    precision = pallas_precision(precision)
     d_pad, n_pad = xt.shape
     k = centers.shape[0]
     if centers.shape[1] != d_pad:
@@ -147,10 +157,11 @@ def assign_stats_fused(
     ct = jnp.pad(centers.T, ((0, 0), (0, k_pad - k)))  # (d_pad, k_pad)
     c2 = jnp.sum(ct * ct, axis=0, keepdims=True)  # (1, k_pad)
     # Padded center columns are all-zero -> c2 = 0 would WIN every argmin.
-    # Push them to +inf so no real row ever lands there.
+    # Push them to the finite sentinel so no real row ever lands there
+    # (NOT +inf: the "high" path's hi/lo split turns inf into NaN).
     if k_pad > k:
         col = jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
-        c2 = jnp.where(col < k, c2, jnp.inf)
+        c2 = jnp.where(col < k, c2, _UNUSED_SCORE)
     if precision not in ("highest", "high", "default"):
         raise ValueError(f"precision must be highest|high|default, got {precision!r}")
     nb = n_pad // block_n
@@ -270,6 +281,7 @@ def assign_stats_packed(
     entry records the measured CPU number and the model, not a claimed
     TPU speedup.
     """
+    precision = pallas_precision(precision)
     d_pad, n_pad = xt.shape
     k = centers.shape[0]
     if centers.shape[1] != d_pad:
@@ -304,9 +316,10 @@ def assign_stats_packed(
     # [g*kg, g*kg+k).
     eye = jnp.eye(p, dtype=xt.dtype)  # (P, P)
     cp = jnp.einsum("ab,dk->adbk", eye, jnp.pad(ct, ((0, dg - d_pad), (0, kg - k)))).reshape(p * dg, p * kg)
-    # Unused score slots (k..kg) push to +inf so no row lands there.
+    # Unused score slots (k..kg) push to the finite sentinel so no row
+    # lands there (NOT +inf: the "high" split turns inf into NaN).
     slot = jax.lax.broadcasted_iota(jnp.int32, (kg,), 0)
-    c2_slot = jnp.where(slot < k, jnp.pad(c2_col, (0, kg - k)), jnp.inf)
+    c2_slot = jnp.where(slot < k, jnp.pad(c2_col, (0, kg - k)), _UNUSED_SCORE)
     c2p = jnp.tile(c2_slot, p)[None, :]  # (1, 128)
 
     nb = np_rows // block_n

@@ -31,15 +31,16 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_ml_tpu.ops.eigh import sign_flip
-from spark_rapids_ml_tpu.ops.linalg import _dot_precision
+from spark_rapids_ml_tpu.ops.precision import make_dot
 
 
-def _chol_qr2(y: jax.Array, prec) -> jax.Array:
-    """Orthonormalize the columns of (n, l) via two Cholesky-QR passes."""
+def _chol_qr2(y: jax.Array, dot) -> jax.Array:
+    """Orthonormalize the columns of (n, l) via two Cholesky-QR passes.
+    ``dot`` is the policy-resolved matmul (ops.precision.make_dot)."""
     eps = jnp.finfo(y.dtype).eps
 
     def once(y):
-        g = jnp.matmul(y.T, y, precision=prec)
+        g = dot(y.T, y)
         # Tiny ridge: guards the Cholesky when the sketch is near-rank-
         # deficient (e.g. data with fewer than l independent directions).
         g = g + (eps * jnp.trace(g)) * jnp.eye(g.shape[0], dtype=y.dtype)
@@ -86,7 +87,7 @@ def randomized_pca(
             f"{min(n, d)}, got k={k}"
         )
     l = min(k + oversample, d, n)
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     dtype = x.dtype
     if n_true is None:
         n_true = n
@@ -102,21 +103,21 @@ def randomized_pca(
 
     def center_matmul(v):  # Xc @ v without materializing Xc, padded rows 0
         return apply_mask(
-            jnp.matmul(x, v, precision=prec)
+            dot(x, v)
             - jnp.outer(jnp.ones((n,), dtype), mean @ v)
         )
 
     def center_rmatmul(u):  # Xc^T @ u for ALREADY-masked u
-        return jnp.matmul(x.T, u, precision=prec) - jnp.outer(
+        return dot(x.T, u) - jnp.outer(
             mean, jnp.sum(u, axis=0)
         )
 
     omega = jax.random.normal(key, (d, l), dtype=dtype)
     y = center_matmul(omega)  # (n, l)
-    q = _chol_qr2(y, prec)
+    q = _chol_qr2(y, dot)
     for _ in range(power_iters):  # static unroll; q small
-        z = _chol_qr2(center_rmatmul(q), prec)  # (d, l)
-        q = _chol_qr2(center_matmul(z), prec)
+        z = _chol_qr2(center_rmatmul(q), dot)  # (d, l)
+        q = _chol_qr2(center_matmul(z), dot)
 
     b = center_rmatmul(q).T  # (l, d): Q^T Xc
     # SVD of the small projected matrix: right singular vectors approximate
@@ -144,12 +145,12 @@ def _gram_power_block(z, acc, rsum, xb, mean, precision="highest"):
     (d, d) anything. Returns updated ``(acc (d, l), rsum scalar-vector)``
     where ``rsum`` accumulates Σ rows of Xc·Z (the rank-one mean
     correction of the rmatmul)."""
-    prec = _dot_precision(precision)
-    t = jnp.matmul(xb, z, precision=prec) - jnp.outer(
+    dot = make_dot(precision)
+    t = dot(xb, z) - jnp.outer(
         jnp.ones((xb.shape[0],), xb.dtype), mean @ z
     )  # (b, l) = Xcb Z
     return (
-        acc + jnp.matmul(xb.T, t, precision=prec),
+        acc + dot(xb.T, t),
         rsum + jnp.sum(t, axis=0),
     )
 
@@ -158,11 +159,11 @@ def _gram_power_block(z, acc, rsum, xb, mean, precision="highest"):
 def _sketch_gram_block(z, g, xb, mean, precision="highest"):
     """One block's contribution to (Xc·Z)ᵀ(Xc·Z) — the (l, l) Rayleigh-
     Ritz Gram of the converged sketch basis."""
-    prec = _dot_precision(precision)
-    t = jnp.matmul(xb, z, precision=prec) - jnp.outer(
+    dot = make_dot(precision)
+    t = dot(xb, z) - jnp.outer(
         jnp.ones((xb.shape[0],), xb.dtype), mean @ z
     )
-    return g + jnp.matmul(t.T, t, precision=prec)
+    return g + dot(t.T, t)
 
 
 def randomized_pca_streaming(
@@ -245,7 +246,7 @@ def randomized_pca_streaming(
     total_var = max(raw, 0.0) / (n - 1)
 
     l = min(k + oversample, d, n)
-    prec = _dot_precision(precision)
+    dot = make_dot(precision)
     mean_np = (mean_h if center else np.zeros(d)).astype(
         np.dtype(dtype), copy=False
     )
@@ -278,7 +279,7 @@ def randomized_pca_streaming(
         # Complete the rmatmul's mean correction: Xcᵀ = Xᵀ − mean·1ᵀ, so
         # Xcᵀ(XcZ) = Σ Xᵦᵀtᵦ − mean·Σ rows(t).
         acc = acc - jnp.outer(mean_dev, rsum)
-        z = _chol_qr2(acc, prec)
+        z = _chol_qr2(acc, dot)
 
     # Rayleigh–Ritz pass: G = Zᵀ Xcᵀ Xc Z streamed as (l, l).
     g = jax.device_put(jnp.zeros((l, l), dtype=dtype), device)
@@ -289,6 +290,6 @@ def randomized_pca_streaming(
         g = _sketch_gram_block(z, g, bucketed(b), mean_dev, precision=precision)
     w, u = jnp.linalg.eigh(g / (n - 1))  # ascending
     w = jnp.maximum(w[::-1][:k], 0)
-    comps = sign_flip(jnp.matmul(z, u[:, ::-1][:, :k], precision=prec))
+    comps = sign_flip(dot(z, u[:, ::-1][:, :k]))
     ratio = np.asarray(w, dtype=np.float64) / max(total_var, 1e-300)
     return np.asarray(comps), ratio, mean_h, n

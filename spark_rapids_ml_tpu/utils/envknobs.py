@@ -165,6 +165,38 @@ KNOBS: Dict[str, Knob] = _knob_table(
     Knob("TPUML_AUTOTUNE_HOT_MIN", "int", "autotune",
          "sightings of one exact batch size before the serving ladder "
          "admits it as an exact-fit bucket", default=16),
+    # mixed-precision MXU policy (ops/precision.py)
+    Knob("TPUML_PRECISION", "choice", "precision",
+         "global GEMM precision mode for every policy-aware op family: "
+         "f32 (6-pass, bit-for-bit default) | bf16x3 (3-pass compensated, "
+         "<=2e-4 rel err) | bf16 (1-pass, serving-grade) | the legacy "
+         "highest/high/default names",
+         choices=("f32", "bf16x3", "bf16", "highest", "high", "default")),
+    Knob("TPUML_PRECISION_COVARIANCE", "choice", "precision",
+         "per-family precision override for the covariance GEMMs "
+         "(outranks TPUML_PRECISION)",
+         choices=("f32", "bf16x3", "bf16", "highest", "high", "default")),
+    Knob("TPUML_PRECISION_PCA", "choice", "precision",
+         "per-family precision override for the PCA covariance/"
+         "randomized-sketch GEMMs (outranks TPUML_PRECISION)",
+         choices=("f32", "bf16x3", "bf16", "highest", "high", "default")),
+    Knob("TPUML_PRECISION_KMEANS", "choice", "precision",
+         "per-family precision override for the KMeans distance/stats "
+         "GEMMs incl. the fused/packed pallas kernels (outranks "
+         "TPUML_PRECISION)",
+         choices=("f32", "bf16x3", "bf16", "highest", "high", "default")),
+    Knob("TPUML_PRECISION_LOGISTIC", "choice", "precision",
+         "per-family precision override for the logistic X-sweeps incl. "
+         "the fused loss+grad (outranks TPUML_PRECISION)",
+         choices=("f32", "bf16x3", "bf16", "highest", "high", "default")),
+    Knob("TPUML_PRECISION_LINEAR", "choice", "precision",
+         "per-family precision override for the linear-model normal-"
+         "equation GEMMs (outranks TPUML_PRECISION)",
+         choices=("f32", "bf16x3", "bf16", "highest", "high", "default")),
+    Knob("TPUML_PRECISION_SERVING", "choice", "precision",
+         "per-family precision override for serving/predict forward "
+         "GEMMs; part of the AOT cache key (outranks TPUML_PRECISION)",
+         choices=("f32", "bf16x3", "bf16", "highest", "high", "default")),
     # hot-path kernel backend selection
     Knob("TPUML_UMAP_SCATTER", "choice", "kernels",
          "UMAP tail scatter backend: pallas = bucketed-accumulation "

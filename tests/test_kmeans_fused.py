@@ -142,8 +142,10 @@ class TestFusedEstimator:
         assert est._resolve_backend(None, 10**9) == "xla"
 
     def test_precision_param_validates(self):
+        # "bf16"/"bf16x3"/"f32" are valid policy modes (ops/precision.py);
+        # only genuinely unknown names must raise.
         with pytest.raises(ValueError, match="precision"):
-            KMeans().setPrecision("bf16")
+            KMeans().setPrecision("fp8")
         with pytest.raises(ValueError, match="backend"):
             KMeans().setBackend("cuda")
 

@@ -108,20 +108,44 @@ def render(doc: dict, sort: str = "wall", top: int = 20) -> str:
             f"{e.get('compile_seconds', 0.0):>9.3f}{marker}"
         )
     rollup = costs.family_rollup(doc)
+    # Resolved precision policy per family (ops/precision.py), recorded
+    # into the dump at snapshot time: a family running a cheaper mode is
+    # priced against that mode's peak, so its utilization column here is
+    # comparable across modes.
+    modes = doc.get("precision_modes") or {}
+    passes = {"f32": 6, "highest": 6, "high": 3, "bf16x3": 3,
+              "default": 1, "bf16": 1}
     if rollup:
         lines.append("per-family rollup:")
         lines.append(
             f"  {'family':<28s} {'progs':>5s} {'compiles':>8s} {'calls':>7s} "
-            f"{'total flops':>12s} {'total bytes':>12s} {'wall s':>8s}"
+            f"{'total flops':>12s} {'total bytes':>12s} {'wall s':>8s} "
+            f"{'prec':>6s} {'util':>6s}"
         )
+        peak = (doc.get("peaks") or {}).get("flops_per_sec")
         for fam, cell in sorted(
             rollup.items(), key=lambda kv: -kv[1]["wall_seconds"]
         ):
+            # Forward-pass programs (x.predict / x.transform) run under
+            # the serving policy; other dotted families fall back to
+            # their fit-family prefix (mirrors precision.active_mode).
+            mode = modes.get(fam)
+            if mode is None and "." in fam:
+                if fam.rsplit(".", 1)[1] in ("predict", "transform", "serve"):
+                    mode = modes.get("serving")
+                if mode is None:
+                    mode = modes.get(fam.split(".", 1)[0])
+            util = "n/a"
+            if peak and cell["wall_seconds"] > 0 and cell["total_flops"]:
+                scale = 6.0 / passes[mode] if mode in passes else 1.0
+                frac = cell["total_flops"] / cell["wall_seconds"] / (peak * scale)
+                util = f"{frac:>5.1%}"
             lines.append(
                 f"  {fam[:28]:<28s} {cell['programs']:>5d} "
                 f"{cell['compiles']:>8d} {cell['invocations']:>7d} "
                 f"{cell['total_flops']:>12.4g} {cell['total_bytes']:>12.4g} "
-                f"{cell['wall_seconds']:>8.3f}"
+                f"{cell['wall_seconds']:>8.3f} "
+                f"{(mode or '-'):>6s} {util:>6s}"
             )
     watermarks = doc.get("watermarks") or {}
     for dev, cell in sorted(watermarks.items()):
