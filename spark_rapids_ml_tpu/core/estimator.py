@@ -138,6 +138,19 @@ class Estimator(Params):
     def _fit(self, dataset: Any):
         raise NotImplementedError
 
+    def partial_fit(self, dataset: Any, *, model=None):
+        """Incremental refit: fit over ``dataset`` (the NEW rows only),
+        seeding the segmented solver from ``model``'s solution — the
+        continuous-training entry (lifecycle/partial_fit.py). With
+        ``model=None`` this is the zero state: bit-identical to a
+        from-scratch fit of ``dataset``. Supported for KMeans (center
+        seed), LogisticRegression (L-BFGS seed), LinearRegression
+        (FISTA seed), and PCA (exact streaming-moment merge, where
+        ``dataset`` ACCUMULATES rather than replaces)."""
+        from spark_rapids_ml_tpu.lifecycle.partial_fit import partial_fit
+
+        return partial_fit(self, dataset, model=model)
+
     def _fit_checkpointer(self, solver: str, data=()):
         """Checkpoint/restore handle for this fit (preemption tolerance,
         robustness/checkpoint.py), or None when the ``TPUML_CHECKPOINT_*``
@@ -152,9 +165,18 @@ class Estimator(Params):
         its own snapshots. Resuming across processes (a relaunched gang,
         a resubmitted job) needs a stable uid — pass one to the
         estimator constructor."""
-        from spark_rapids_ml_tpu.robustness.checkpoint import FitCheckpointer
+        from spark_rapids_ml_tpu.robustness.checkpoint import (
+            EphemeralSegmenter,
+            FitCheckpointer,
+        )
 
-        return FitCheckpointer.for_fit(self, solver=solver, data=data)
+        ckpt = FitCheckpointer.for_fit(self, solver=solver, data=data)
+        if ckpt is None and getattr(self, "_force_segment_every", 0):
+            # partial_fit forces the segmented driver (disk-free) so
+            # warm-seed convergence is counter-observable; a real
+            # TPUML_CHECKPOINT_* checkpointer outranks it.
+            return EphemeralSegmenter(self._force_segment_every)
+        return ckpt
 
 
 class Model(Transformer, MLReadable):

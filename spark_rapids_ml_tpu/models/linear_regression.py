@@ -200,6 +200,22 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         self.mesh = mesh
         return self
 
+    _initial_coef = None  # (d,) FISTA warm start, original space
+    _copy_attrs = ("_initial_coef",)
+
+    def setInitialModel(self, value) -> "LinearRegression":
+        """Warm start the FISTA solve from an existing model's
+        coefficients (or a raw ``(d,)`` array) — the incremental-refit
+        seed (lifecycle/partial_fit.py). Applies to the elastic-net
+        path; the exact normal-equation solve has no iteration to seed
+        and rejects it at fit time."""
+        coef = value.coefficients if hasattr(value, "coefficients") else value
+        coef = np.asarray(coef, dtype=np.float64)
+        if coef.ndim != 1:
+            raise ValueError("initial model/coefficients must be a (d,) vector")
+        self._initial_coef = coef
+        return self
+
     def _uses_fista(self) -> bool:
         """True when the fit routes to the proximal (FISTA) solver rather
         than the exact normal-equation solve (see _solve_from_stats)."""
@@ -401,7 +417,19 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
         the one home of the exact-vs-proximal routing (shared by the
         in-memory, mesh, and streaming fit paths)."""
         xtx, xty, x_sum, y_sum, yty, count = stats
+        init_coef = self._initial_coef
+        if init_coef is not None and init_coef.shape[0] != d:
+            raise ValueError(
+                f"initial model has {init_coef.shape[0]} coefficients, "
+                f"data has {d} features"
+            )
         if not self._uses_fista():
+            if init_coef is not None:
+                raise ValueError(
+                    "setInitialModel warm start applies to the elastic-net "
+                    "(FISTA) path (elasticNetParam > 0 and regParam > 0); "
+                    "the exact normal-equation solve has no iteration to seed"
+                )
             # Zero effective penalty: the exact (Cholesky) solve, not a
             # fixed-step proximal approximation of the same objective.
             return solve_normal(
@@ -435,6 +463,7 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
                 checkpointer=ckpt,
                 fit_intercept=self.getFitIntercept(),
                 standardization=self.getStandardization(),
+                init_coef=init_coef,
                 mesh=self.mesh,
             )
             return coef, intercept
@@ -448,6 +477,7 @@ class LinearRegression(_LinearRegressionParams, Estimator, MLReadable):
             elastic_net_param=self.getElasticNetParam(),
             fit_intercept=self.getFitIntercept(),
             standardization=self.getStandardization(),
+            init_coef=init_coef,
         )
         return coef, intercept
 

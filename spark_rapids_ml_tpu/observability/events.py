@@ -102,6 +102,8 @@ SCHEMA: Dict[str, frozenset] = {
     "gang_fit": frozenset({"action"}),
     "elastic": frozenset({"action"}),
     "gang_resize": frozenset({"action", "from_members", "to_members"}),
+    "lifecycle": frozenset({"action"}),
+    "registry_rollback": frozenset({"model", "alias", "version", "previous"}),
     "persistence": frozenset({"action", "path"}),
     "telemetry": frozenset({"action", "path"}),
     "lockcheck": frozenset({"action", "lock"}),

@@ -159,6 +159,7 @@ def solve_elastic_net(
     standardization: bool = True,
     max_iter: int = 2000,
     tol: float = 1e-7,
+    init_coef=None,
 ):
     """Elastic-net least squares from the SAME sufficient statistics.
 
@@ -201,7 +202,15 @@ def solve_elastic_net(
         delta = jnp.max(jnp.abs(c_new - c))
         return c_new, z_new, t_new, it + 1, delta
 
-    c0 = jnp.zeros(d, dtype=a.dtype)
+    # Warm start (partial_fit / regularization-path sweeps): FISTA from
+    # a previous optimum in the ORIGINAL coefficient space — the carry's
+    # own space, so no mapping is needed. Momentum restarts from the
+    # seed (z = c, t = 1): plain FISTA initialization, just not at zero.
+    c0 = (
+        jnp.zeros(d, dtype=a.dtype)
+        if init_coef is None
+        else jnp.asarray(init_coef, dtype=a.dtype)
+    )
     init = (c0, c0, jnp.asarray(1.0, a.dtype), 0, jnp.asarray(jnp.inf, a.dtype))
     coef, _, _, n_iter, _ = jax.lax.while_loop(cond, body, init)
     intercept = jnp.where(fit_intercept, y_mean - jnp.dot(x_mean, coef), 0.0)
@@ -270,6 +279,7 @@ def solve_elastic_net_resumable(
     standardization: bool = True,
     max_iter: int = 2000,
     tol: float = 1e-7,
+    init_coef=None,
     mesh=None,
 ):
     """Preemption-tolerant :func:`solve_elastic_net`: host outer loop
@@ -292,7 +302,13 @@ def solve_elastic_net_resumable(
     )
     d = a_quad.shape[0]
     dt = a_quad.dtype
-    c0 = jnp.zeros(d, dtype=dt)
+    # Same warm-start contract as solve_elastic_net: original-space seed,
+    # momentum restarted at the seed.
+    c0 = (
+        jnp.zeros(d, dtype=dt)
+        if init_coef is None
+        else jnp.asarray(init_coef, dtype=dt)
+    )
     carry = (
         c0, c0, jnp.asarray(1.0, dt), jnp.asarray(0), jnp.asarray(jnp.inf, dt)
     )

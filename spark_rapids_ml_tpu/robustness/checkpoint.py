@@ -455,6 +455,34 @@ def segment_boundary(checkpointer: Optional["FitCheckpointer"] = None) -> None:
     fault_point("checkpoint.segment")
 
 
+class EphemeralSegmenter:
+    """Duck-typed stand-in for :class:`FitCheckpointer` that segments a
+    solve WITHOUT touching disk: ``partial_fit``
+    (lifecycle/partial_fit.py) routes the solve through the PR 3
+    segmented drivers so warm-seed convergence rides the
+    ``checkpoint.solver_iters`` counter even when the
+    ``TPUML_CHECKPOINT_*`` knobs are unset. ``restore_latest`` is always
+    a miss and ``save_async`` a no-op — crash tolerance for a refit
+    comes from the lifecycle journal replaying the whole (short) solve,
+    not from mid-solve snapshots. Bit-identity with the monolithic
+    solver is the PR 3 segmented-equals-monolithic guarantee."""
+
+    def __init__(self, every: int):
+        self.every = max(1, int(every))
+
+    def restore_latest(self, template=None):
+        return None
+
+    def save_async(self, step, state) -> None:
+        pass
+
+    def wait(self) -> None:
+        pass
+
+    def finalize_success(self) -> None:
+        pass
+
+
 def replicate_state_onto_mesh(state, mesh):
     """Reshard a host (or single-device) solver-state pytree onto a mesh
     as fully REPLICATED arrays — the elastic-gang-resume placement: a

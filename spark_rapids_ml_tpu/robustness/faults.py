@@ -37,6 +37,15 @@ Sites (the complete vocabulary — a spec naming anything else is an error):
                                 (serving/router.py ``add_member``)
   - ``member.join``             the join replay/warm protocol for one
                                 elastic member (serving/router.py)
+  - ``refit.ingest``            pulling one batch of fresh rows into a
+                                continuous-training cycle
+                                (lifecycle/controller.py)
+  - ``refit.quality_gate``      scoring candidate vs incumbent on the
+                                held-out slice (lifecycle/controller.py)
+  - ``refit.swap``              the register → warm → alias-flip tail of
+                                a refit cycle (lifecycle/controller.py)
+  - ``drift.tick``              one drift-trigger evaluation over the
+                                metrics registry (lifecycle/drift.py)
 
 Schedules are counters, not random draws — the same spec always fails the
 same invocations, so a chaos test is exactly reproducible:
@@ -93,6 +102,10 @@ KNOWN_SITES = frozenset(
         "ipc.recv",
         "member.launch",
         "member.join",
+        "refit.ingest",
+        "refit.quality_gate",
+        "refit.swap",
+        "drift.tick",
     }
 )
 

@@ -262,6 +262,12 @@ class MicroBatcher:
                 offset = hi
                 latency_ms = (now - req.enqueue_mono) * 1e3
                 _latency_hist().observe(latency_ms)
+                # Freshness attribution: the concrete (name, version)
+                # whose weights answered this request rides the future —
+                # the oracle loadgen joins against the event log to prove
+                # monotone model freshness across a hot swap.
+                req.future.model_name = name
+                req.future.model_version = version
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_result(sliced)
                 with trace_scope(req.trace):

@@ -68,6 +68,10 @@ class ModelRegistry:
         # version number is never reissued to a different model.
         self._next: Dict[str, int] = {}  # guarded-by: _lock
         self._aliases: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
+        # Where each (name, alias) pointed BEFORE its latest move — the
+        # one-op rollback target. A rollback swaps current and previous,
+        # so rolling back twice returns to where you started.
+        self._previous: Dict[Tuple[str, str], Optional[int]] = {}  # guarded-by: _lock
 
     # --- registration / swap ---
 
@@ -135,11 +139,49 @@ class ModelRegistry:
                 raise KeyError(f"model {name!r} has no version {version}")
             previous = self._aliases.setdefault(name, {}).get(alias)
             self._aliases[name][alias] = version
+            self._previous[(name, alias)] = previous
         bump_counter("serving.registry.swap")
         emit(
             "serving", action="swap", model=name, alias=alias,
             version=version, previous=previous,
         )
+
+    def rollback_target(self, name: str, alias: str = "prod") -> int:
+        """The version :meth:`rollback` would re-pin ``name@alias`` to —
+        read-only, so a replicated rollback can warm the target on every
+        member BEFORE any alias moves (the same two-phase discipline as
+        the forward flip)."""
+        with self._lock:
+            if alias not in self._aliases.get(name, {}):
+                raise KeyError(f"model {name!r} has no alias {alias!r}")
+            prev = self._previous.get((name, alias))
+            if prev is None:
+                raise KeyError(
+                    f"model {name!r} alias {alias!r} has no previous "
+                    "version to roll back to"
+                )
+            if prev not in self._versions.get(name, {}):
+                raise KeyError(
+                    f"rollback target v{prev} of {name!r} was retired"
+                )
+            return prev
+
+    def rollback(self, name: str, alias: str = "prod") -> int:
+        """One-op revert: re-pin ``name@alias`` to the version it served
+        before its latest move. The previous-pointer swaps with the
+        current version, so a mistaken rollback is itself rolled back by
+        calling this again. Returns the version now serving."""
+        with self._lock:
+            target = self.rollback_target(name, alias)
+            current = self._aliases[name][alias]
+            self._aliases[name][alias] = target
+            self._previous[(name, alias)] = current
+        bump_counter("serving.registry.rollback")
+        emit(
+            "registry_rollback", model=name, alias=alias,
+            version=target, previous=current,
+        )
+        return target
 
     def retire(self, name: str, version: int) -> None:
         """Remove one version: it resolves no more, its aliases drop, and

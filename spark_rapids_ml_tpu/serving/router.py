@@ -467,6 +467,10 @@ class RoutingRuntime:
                 (time.monotonic() - entry["t0"]) * 1e3
             )
             fut = entry["future"]
+            # Freshness attribution: the member executed exactly the
+            # (name, version) the router resolved at admission.
+            fut.model_name = entry["name"]
+            fut.model_version = entry["version"]
             if fut.set_running_or_notify_cancel():
                 fut.set_result(msg["result"])
             return
@@ -798,6 +802,10 @@ class RoutingRuntime:
 
         t0 = time.monotonic()
         fut = pool.submit(run)
+        # Version resolution already happened at admission: the sharded
+        # path carries the same freshness attribution as a routed reply.
+        fut.model_name = mv.name
+        fut.model_version = mv.version
         fut.add_done_callback(
             lambda f: _routed_latency_hist().observe(
                 (time.monotonic() - t0) * 1e3
@@ -978,6 +986,39 @@ class RoutingRuntime:
                  "dtype": str(dtype) if dtype is not None else None}
             )
         return max((int(r.get("warmed", 0)) for r in replies), default=0)
+
+    def rollback(self, name: str, alias: str = "prod", *,
+                 warm_buckets: Iterable[int] = (1,)) -> int:
+        """The one-op alias revert, replicated with the same zero-shed
+        two-phase shape as the forward flip: (1) warm the rollback
+        TARGET on every member (a swapped-out version may have dropped
+        its programs); (2) replicate the rollback lsn-ordered, then move
+        the ROUTER's alias last — traffic resolves here, so no member
+        ever sees a half-rolled-back gang. Returns the version now
+        serving. Each member re-derives the same target from its own
+        replicated previous-pointer (identical op order ⇒ identical
+        pointer), and the router cross-checks the acks."""
+        with self._op_lock:
+            target = self.registry.rollback_target(name, alias)
+            if warm_buckets:
+                self.warm(name, version=target, buckets=warm_buckets)
+            lsn = self._next_lsn()
+            replies = self._broadcast_op(
+                {"op": "rollback", "lsn": lsn, "name": name, "alias": alias}
+            )
+            got = {int(r["version"]) for r in replies if "version" in r}
+            if got and got != {target}:
+                raise RuntimeError(
+                    f"rollback divergence for {name!r}@{alias}: router "
+                    f"targets v{target}, members reverted to {sorted(got)}"
+                )
+            v = self.registry.rollback(name, alias)
+            self._oplog[-1]["expect_version"] = v
+            emit(
+                "serving", action="replicate", router=self.router_id,
+                op="rollback", lsn=lsn, model=name, alias=alias, version=v,
+            )
+        return v
 
     def retire(self, name: str, version: int) -> None:
         with self._op_lock:

@@ -139,6 +139,9 @@ class ServingRuntime:
     def set_alias(self, name: str, alias: str, version: int) -> None:
         self.registry.set_alias(name, alias, version)
 
+    def rollback(self, name: str, alias: str = "prod") -> int:
+        return self.registry.rollback(name, alias)
+
     def retire(self, name: str, version: int) -> None:
         self.registry.retire(name, version)
 

@@ -178,6 +178,9 @@ class ServingWorker:
         if op == "retire":
             rt.retire(msg["name"], msg["version"])
             return {"ok": True}
+        if op == "rollback":
+            v = rt.rollback(msg["name"], msg.get("alias", "prod"))
+            return {"ok": True, "version": v}
         raise ValueError(f"unknown registry op {op!r}")
 
     def _op_loop(self) -> None:
@@ -224,7 +227,14 @@ class ServingWorker:
                 self._reply(msg_id, {"ok": False, "error": encode_error(exc)})
                 return
             self.served += 1
-            self._reply(msg_id, {"ok": True, "result": result})
+            # The member-side batcher stamped the (name, version) whose
+            # weights actually executed; echo it so the router can
+            # cross-check its admission-time resolution.
+            self._reply(msg_id, {
+                "ok": True, "result": result,
+                "model": getattr(f, "model_name", None),
+                "version": getattr(f, "model_version", None),
+            })
 
         fut.add_done_callback(_done)
 

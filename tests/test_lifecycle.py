@@ -1,0 +1,497 @@
+"""Continuous-training lifecycle: incremental refit + the journaled
+refit→swap controller + drift triggers + gate/rollback.
+
+The contracts under test, per the r18 issue:
+
+- **Zero-state bit-identity**: ``partial_fit(data)`` with no previous
+  model IS a from-scratch fit — byte-equal solutions for all three
+  solver families (the PR 3 segmented ≡ monolithic invariant carries
+  the whole claim).
+- **Warm seeding converges measurably faster**: seeding from the
+  previous solution runs STRICTLY fewer solver segments, asserted
+  through the ``checkpoint.solver_iters`` counter, per family.
+- **PCA accumulates exactly**: split-and-merge moments reproduce the
+  single-shot covariance to fp64 exactness; parity with ``fit`` is
+  bounded only by the fit path's fp32 covariance GEMM.
+- **The controller never flips on a loser** and rolls back one-op when
+  live traffic regresses after a flip; both surface as structured
+  ``lifecycle`` events.
+- **Every stage is a named fault site in a RetryPolicy**: transient
+  faults retry invisibly; fatal ones leave a journal that resumes the
+  SAME cycle with no duplicate registry versions.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.lifecycle import (
+    DriftMonitor,
+    LifecycleController,
+)
+from spark_rapids_ml_tpu.lifecycle.journal import CycleJournal
+from spark_rapids_ml_tpu.models.kmeans import KMeans
+from spark_rapids_ml_tpu.models.linear_regression import LinearRegression
+from spark_rapids_ml_tpu.models.logistic_regression import LogisticRegression
+from spark_rapids_ml_tpu.models.pca import PCA
+from spark_rapids_ml_tpu.observability import events
+from spark_rapids_ml_tpu.robustness import InjectedFault, inject
+from spark_rapids_ml_tpu.robustness.faults import disarm
+from spark_rapids_ml_tpu.serving.server import ServingRuntime
+from spark_rapids_ml_tpu.utils.tracing import clear_counters, counter_value
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    disarm()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("TPUML_RETRY_BASE_DELAY", "0")
+
+
+@pytest.fixture
+def clusters(rng):
+    x = rng.normal(size=(240, 6))
+    x[:120] += 4.0
+    return x
+
+
+@pytest.fixture
+def labeled(rng):
+    x = rng.normal(size=(240, 6))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0.2).astype(float)
+    return x, y
+
+
+def _km_score(model, x, y):
+    centers = np.asarray(model.clusterCenters())
+    d = np.linalg.norm(x[:, None, :] - centers[None], axis=2).min(axis=1)
+    return -float(d.mean())
+
+
+def _runtime():
+    return ServingRuntime(start=False)
+
+
+# --- zero-state bit-identity (all three solver families) ----------------
+
+
+class TestZeroStateBitIdentity:
+    def test_kmeans(self, clusters):
+        cold = KMeans(uid="zs-km").setK(3).setSeed(7).fit(clusters)
+        pf = KMeans(uid="zs-km").setK(3).setSeed(7).partial_fit(clusters)
+        assert np.array_equal(
+            np.asarray(cold.clusterCenters()), np.asarray(pf.clusterCenters())
+        )
+
+    def test_logistic(self, labeled):
+        x, y = labeled
+        cold = LogisticRegression(uid="zs-lr").setMaxIter(50).fit((x, y))
+        pf = LogisticRegression(uid="zs-lr").setMaxIter(50).partial_fit((x, y))
+        assert np.array_equal(
+            np.asarray(cold.coefficients), np.asarray(pf.coefficients)
+        )
+        assert np.array_equal(
+            np.asarray(cold.intercepts), np.asarray(pf.intercepts)
+        )
+
+    def test_linear(self, rng):
+        x = rng.normal(size=(200, 6))
+        y = x @ rng.normal(size=6) + 0.1 * rng.normal(size=200)
+        est = lambda: (
+            LinearRegression(uid="zs-ln").setRegParam(0.05).setElasticNetParam(0.5)
+        )
+        cold = est().fit((x, y))
+        pf = est().partial_fit((x, y))
+        assert np.array_equal(
+            np.asarray(cold.coefficients), np.asarray(pf.coefficients)
+        )
+
+    def test_unsupported_family_raises(self, clusters):
+        from spark_rapids_ml_tpu.models.random_forest import (
+            RandomForestClassifier,
+        )
+
+        with pytest.raises(TypeError, match="partial_fit supports"):
+            RandomForestClassifier().partial_fit(clusters)
+
+
+# --- warm seeding: strictly fewer solver segments -----------------------
+
+
+class TestWarmSeedIterations:
+    def _delta(self, fn):
+        before = counter_value("checkpoint.solver_iters")
+        fn()
+        return counter_value("checkpoint.solver_iters") - before
+
+    def test_kmeans_warm_fewer_iters(self, clusters):
+        est = KMeans(uid="ws-km").setK(3).setSeed(7).setMaxIter(40)
+        prev = est.partial_fit(clusters)
+        cold = self._delta(lambda: est.partial_fit(clusters))
+        warm = self._delta(lambda: est.partial_fit(clusters, model=prev))
+        assert 0 < warm < cold
+
+    def test_logistic_warm_fewer_iters(self, labeled):
+        x, y = labeled
+        est = LogisticRegression(uid="ws-lr").setMaxIter(80)
+        prev = est.partial_fit((x, y))
+        cold = self._delta(lambda: est.partial_fit((x, y)))
+        warm = self._delta(lambda: est.partial_fit((x, y), model=prev))
+        assert 0 < warm < cold
+
+    def test_linear_warm_fewer_iters(self, rng):
+        x = rng.normal(size=(200, 6))
+        y = x @ rng.normal(size=6) + 0.05 * rng.normal(size=200)
+        est = LinearRegression(uid="ws-ln").setRegParam(0.02).setElasticNetParam(0.5)
+        prev = est.partial_fit((x, y))
+        cold = self._delta(lambda: est.partial_fit((x, y)))
+        warm = self._delta(lambda: est.partial_fit((x, y), model=prev))
+        assert 0 < warm < cold
+
+    def test_warm_result_matches_cold_solution(self, clusters):
+        """Fewer segments, same fixed point: the warm-seeded solution
+        converges to the cold one (same data, converged tolerance)."""
+        est = KMeans(uid="ws-eq").setK(3).setSeed(7).setMaxIter(100)
+        prev = est.partial_fit(clusters)
+        cold = est.partial_fit(clusters)
+        warm = est.partial_fit(clusters, model=prev)
+        assert np.allclose(
+            np.sort(np.asarray(warm.clusterCenters()), axis=0),
+            np.sort(np.asarray(cold.clusterCenters()), axis=0),
+            atol=1e-5,
+        )
+
+
+# --- PCA: exact streaming-moment accumulation ---------------------------
+
+
+class TestPCAStreamingMerge:
+    def test_split_merge_matches_single_shot(self, rng):
+        x = rng.normal(size=(300, 8))
+        x[:150] += 2.0
+        est = PCA(uid="sm-pca").setK(3)
+        m1 = est.partial_fit(x[:100])
+        m2 = est.partial_fit(x[100:], model=m1)
+        one = est.partial_fit(x)
+        # The merge is algebraically exact but re-bases about each
+        # block's own shift, so fp64 rounding differs in the last ulps —
+        # tight-tolerance equality, far below the fit path's fp32 gap.
+        assert np.allclose(m2.pc, one.pc, atol=1e-9)
+        assert np.allclose(m2.explainedVariance, one.explainedVariance, atol=1e-12)
+        assert m2._moments.n_rows == 300
+
+    def test_parity_with_fit_within_fp32_covariance(self, rng):
+        x = rng.normal(size=(300, 8))
+        est = PCA(uid="pp-pca").setK(3)
+        m1 = est.partial_fit(x[:130])
+        m2 = est.partial_fit(x[130:], model=m1)
+        full = est.fit(x)
+        assert np.allclose(np.abs(m2.pc), np.abs(full.pc), atol=1e-4)
+        assert np.allclose(
+            m2.explainedVariance, full.explainedVariance, atol=1e-6
+        )
+
+    def test_previous_model_not_mutated(self, rng):
+        x = rng.normal(size=(120, 5))
+        est = PCA(uid="im-pca").setK(2)
+        m1 = est.partial_fit(x[:60])
+        n_before = m1._moments.n_rows
+        est.partial_fit(x[60:], model=m1)
+        assert m1._moments.n_rows == n_before
+
+    def test_plain_fit_model_rejected(self, rng):
+        x = rng.normal(size=(120, 5))
+        est = PCA(uid="rj-pca").setK(2)
+        plain = est.fit(x)
+        with pytest.raises(ValueError, match="streaming moments"):
+            est.partial_fit(x, model=plain)
+
+    def test_width_change_rejected(self, rng):
+        est = PCA(uid="wc-pca").setK(2)
+        m1 = est.partial_fit(rng.normal(size=(60, 5)))
+        with pytest.raises(ValueError, match="width changed"):
+            est.partial_fit(rng.normal(size=(60, 7)), model=m1)
+
+
+# --- the controller ------------------------------------------------------
+
+
+class TestController:
+    def test_first_cycle_registers_and_flips(self, clusters, tmp_path):
+        rt = _runtime()
+        est = KMeans(uid="ct-km").setK(2).setSeed(3)
+        ctrl = LifecycleController(
+            est, rt, "km", score_fn=_km_score, directory=str(tmp_path)
+        )
+        out = ctrl.run_cycle(clusters)
+        assert out.action == "flipped" and out.version == 1
+        assert rt.registry.aliases("km") == {"prod": 1}
+
+    def test_second_cycle_warm_seeds_and_flips(self, clusters, rng, tmp_path):
+        rt = _runtime()
+        est = KMeans(uid="ct2-km").setK(2).setSeed(3)
+        ctrl = LifecycleController(
+            est, rt, "km", score_fn=_km_score, directory=str(tmp_path)
+        )
+        ctrl.run_cycle(clusters)
+        # A genuine shift: the incumbent's centers miss the new modes,
+        # the refit adapts — the gate must prefer the candidate.
+        out = ctrl.run_cycle(clusters + 2.0)
+        assert out.action == "flipped" and out.version == 2
+        assert out.incumbent_score is not None
+        assert rt.registry.aliases("km") == {"prod": 2}
+
+    def test_gate_rejection_keeps_incumbent(self, clusters, tmp_path, event_log):
+        rt = _runtime()
+        est = KMeans(uid="gr-km").setK(2).setSeed(3)
+        ctrl = LifecycleController(
+            est, rt, "km", score_fn=_km_score, directory=str(tmp_path)
+        )
+        ctrl.run_cycle(clusters)
+        # An impossible margin turns the next candidate into a loser.
+        ctrl.gate_margin = 1e9
+        out = ctrl.run_cycle(clusters)
+        assert out.action == "rejected" and out.version is None
+        assert rt.registry.aliases("km") == {"prod": 1}
+        assert len(rt.registry.versions("km")) == 1
+        recs = _events(event_log)
+        assert any(
+            r["event"] == "lifecycle" and r["action"] == "gate_reject"
+            for r in recs
+        )
+
+    def test_watch_triggers_auto_rollback(self, clusters, rng, tmp_path, event_log):
+        rt = _runtime()
+        est = KMeans(uid="ar-km").setK(2).setSeed(3)
+        ctrl = LifecycleController(
+            est, rt, "km", score_fn=_km_score, directory=str(tmp_path),
+            regress_tol=0.1,
+        )
+        ctrl.run_cycle(clusters)
+        out = ctrl.run_cycle(clusters + 2.0)
+        assert out.version == 2
+        healthy = ctrl.watch(out.candidate_score)
+        assert healthy is None
+        rolled = ctrl.watch(out.candidate_score - 10.0)
+        assert rolled == 1
+        assert rt.registry.aliases("km") == {"prod": 1}
+        # one rollback per flip: the trigger disarms itself
+        assert ctrl.watch(-1e9) is None
+        recs = _events(event_log)
+        assert any(
+            r["event"] == "lifecycle" and r["action"] == "auto_rollback"
+            for r in recs
+        )
+        assert any(r["event"] == "registry_rollback" for r in recs)
+
+    def test_transient_faults_at_every_site_retry_through(
+        self, clusters, tmp_path
+    ):
+        """Non-fatal injections at each lifecycle site are absorbed by
+        the named RetryPolicy — the cycle completes as if unfaulted."""
+        rt = _runtime()
+        est = KMeans(uid="tf-km").setK(2).setSeed(3)
+        ctrl = LifecycleController(
+            est, rt, "km", score_fn=_km_score, directory=str(tmp_path)
+        )
+        clear_counters("retry")
+        with inject("refit.ingest=1;refit.quality_gate=1;refit.swap=1"):
+            out = ctrl.run_cycle(clusters)
+        assert out.action == "flipped" and out.version == 1
+        assert counter_value("retry.refit.ingest.attempts") >= 2
+        assert counter_value("retry.refit.quality_gate.attempts") >= 2
+        assert counter_value("retry.refit.swap.attempts") >= 2
+
+    def test_fatal_fault_then_resume_same_cycle_no_duplicates(
+        self, clusters, tmp_path
+    ):
+        """In-process crash/resume at every stage boundary: the resumed
+        controller finishes the SAME cycle and the registry holds exactly
+        one version."""
+        for spec in (
+            "refit.ingest=1:fatal",      # before ingest commits
+            "refit.ingest=2:fatal",      # before refit commits
+            "refit.quality_gate=1:fatal",
+            "refit.swap=1:fatal",        # before register
+            "refit.swap=2:fatal",        # between register and warm
+            "refit.swap=3:fatal",        # between warm and flip
+        ):
+            d = tmp_path / spec.replace(":", "_").replace("=", "_")
+            rt = _runtime()
+            est = KMeans(uid="ff-km").setK(2).setSeed(3)
+            ctrl = LifecycleController(
+                est, rt, "km", score_fn=_km_score, directory=str(d)
+            )
+            with inject(spec):
+                with pytest.raises(InjectedFault):
+                    ctrl.run_cycle(clusters)
+            resumed = LifecycleController(
+                est, rt, "km", score_fn=_km_score, directory=str(d)
+            )
+            out = resumed.run_cycle(clusters)
+            assert out.action == "flipped" and out.cycle == 0, spec
+            assert rt.registry.versions("km") == [1], spec
+            assert rt.registry.aliases("km") == {"prod": 1}, spec
+
+    def test_requires_directory(self, clusters, monkeypatch):
+        monkeypatch.delenv("TPUML_LIFECYCLE_DIR", raising=False)
+        with pytest.raises(ValueError, match="TPUML_LIFECYCLE_DIR"):
+            LifecycleController(
+                KMeans().setK(2), _runtime(), "km", score_fn=_km_score
+            )
+
+
+# --- drift monitor -------------------------------------------------------
+
+
+class TestDriftMonitor:
+    def test_bootstrap_then_stable_then_fire(self, rng, event_log):
+        dm = DriftMonitor("dm", threshold=0.25, min_count=300)
+        dm.observe_many(rng.normal(size=400))
+        assert dm.tick() is None  # first window bootstraps the reference
+        dm.observe_many(rng.normal(size=400))
+        assert dm.tick() is None  # same distribution: quiet
+        dm.observe_many(rng.normal(size=400) + 3.0)
+        psi = dm.tick()
+        assert psi is not None and psi > 0.25
+        recs = _events(event_log)
+        assert any(
+            r["event"] == "lifecycle" and r["action"] == "drift_fire"
+            for r in recs
+        )
+
+    def test_small_window_never_fires(self, rng):
+        dm = DriftMonitor("dm-sm", threshold=0.25, min_count=300)
+        dm.observe_many(rng.normal(size=299) + 50.0)
+        assert dm.tick() is None
+
+    def test_rebaseline_forgets_reference(self, rng):
+        dm = DriftMonitor("dm-rb", threshold=0.25, min_count=100)
+        dm.observe_many(rng.normal(size=200))
+        dm.tick()
+        dm.rebaseline()
+        dm.observe_many(rng.normal(size=200) + 5.0)
+        assert dm.tick() is None  # shifted window is the NEW baseline
+        dm.observe_many(rng.normal(size=200) + 5.0)
+        assert dm.tick() is None  # and stable against itself
+
+    def test_tick_transient_fault_retries(self, rng):
+        dm = DriftMonitor("dm-ft", threshold=0.25, min_count=100)
+        dm.observe_many(rng.normal(size=200))
+        clear_counters("retry")
+        with inject("drift.tick=1"):
+            assert dm.tick() is None  # bootstrap, after one retry
+        assert counter_value("retry.drift.tick.attempts") >= 2
+
+    def test_tick_stall_wakes_on_disarm(self, rng):
+        """The stuck-but-alive mode: an armed :stall freezes the tick;
+        disarming releases it and the tick completes."""
+        from spark_rapids_ml_tpu.robustness import faults
+
+        dm = DriftMonitor("dm-st", threshold=0.25, min_count=10)
+        dm.observe_many(rng.normal(size=20))
+        done = threading.Event()
+        with faults.inject("drift.tick=always:stall"):
+            t = threading.Thread(target=lambda: (dm.tick(), done.set()))
+            t.start()
+            assert not done.wait(0.3), "stalled tick returned while armed"
+        assert done.wait(5.0), "stalled tick never woke after disarm"
+        t.join()
+
+
+# --- registry rollback (satellite 2 unit surface) ------------------------
+
+
+class TestRegistryRollback:
+    def _two_versions(self, clusters):
+        rt = _runtime()
+        m = KMeans(uid="rb-km").setK(2).setSeed(3).fit(clusters)
+        rt.register("km", m, alias="prod")
+        rt.register("km", m, alias="prod")
+        return rt
+
+    def test_rollback_swaps_and_double_rollback_returns(self, clusters):
+        rt = self._two_versions(clusters)
+        assert rt.registry.aliases("km") == {"prod": 2}
+        assert rt.rollback("km") == 1
+        assert rt.registry.aliases("km") == {"prod": 1}
+        assert rt.rollback("km") == 2
+        assert rt.registry.aliases("km") == {"prod": 2}
+
+    def test_rollback_without_history_raises(self, clusters):
+        rt = _runtime()
+        m = KMeans(uid="rb1-km").setK(2).setSeed(3).fit(clusters)
+        rt.register("km", m, alias="prod")
+        with pytest.raises(KeyError):
+            rt.rollback("km")
+
+    def test_rollback_unknown_alias_raises(self, clusters):
+        rt = self._two_versions(clusters)
+        with pytest.raises(KeyError):
+            rt.rollback("km", alias="canary")
+
+    def test_rollback_emits_event_and_counter(self, clusters, event_log):
+        rt = self._two_versions(clusters)
+        clear_counters("serving.registry")
+        rt.rollback("km")
+        assert counter_value("serving.registry.rollback") == 1
+        recs = _events(event_log)
+        ev = [r for r in recs if r["event"] == "registry_rollback"]
+        assert ev and ev[0]["version"] == 1 and ev[0]["previous"] == 2
+
+
+# --- journal unit surface (the process-death matrix lives in
+# test_lifecycle_journal.py) ---------------------------------------------
+
+
+class TestJournalUnit:
+    ID = {"name": "m", "estimator": "KMeans"}
+
+    def test_fresh_then_resume(self, tmp_path):
+        j = CycleJournal.resume_or_start(str(tmp_path), self.ID, 4)
+        j.mark("ingest", {"data": "p"})
+        j2 = CycleJournal.resume_or_start(str(tmp_path), self.ID, 99)
+        assert j2.cycle == 4 and j2.done("ingest")
+        assert j2.payload("ingest") == {"data": "p"}
+
+    def test_finished_journal_starts_fresh(self, tmp_path):
+        j = CycleJournal.resume_or_start(str(tmp_path), self.ID, 0)
+        j.mark("ingest", {})
+        j.finish()
+        j2 = CycleJournal.resume_or_start(str(tmp_path), self.ID, 1)
+        assert j2.cycle == 1 and not j2.done("ingest")
+
+    def test_double_mark_raises(self, tmp_path):
+        j = CycleJournal.resume_or_start(str(tmp_path), self.ID, 0)
+        j.mark("ingest", {})
+        with pytest.raises(RuntimeError, match="already journaled"):
+            j.mark("ingest", {})
+
+    def test_unknown_stage_rejected(self, tmp_path):
+        j = CycleJournal.resume_or_start(str(tmp_path), self.ID, 0)
+        with pytest.raises(ValueError, match="unknown stage"):
+            j.mark("deploy", {})
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture
+def event_log(tmp_path):
+    path = tmp_path / "events.jsonl"
+    events.configure(str(path))
+    try:
+        yield path
+    finally:
+        from spark_rapids_ml_tpu.utils.envknobs import env_str
+
+        prev = env_str(events.EVENT_LOG_ENV)
+        events.configure(prev if prev else None)

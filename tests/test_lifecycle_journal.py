@@ -1,0 +1,237 @@
+"""Crash-safety of the lifecycle journal: genuine ``SIGKILL`` mid-cycle
+at every stage, then a fresh interpreter resumes the SAME cycle and
+lands the SAME model — plus the torn/stale-journal rejection paths.
+
+The kill harness is real: a subprocess arms a fatal fault at one stage
+boundary and converts the injected fault into ``os.kill(getpid(),
+SIGKILL)`` — no atexit handlers, no flushes, exactly the torn-state
+shape a preempted node leaves behind. The restart path is exercised the
+way an operator would run it: rebuild the (in-memory) serving runtime,
+construct a controller over the surviving journal directory, call
+``run_cycle`` again. Acceptance, per stage:
+
+- the resumed cycle id equals the killed cycle's id (same cycle, not a
+  new one);
+- the registry ends with exactly ONE version — the fence makes register
+  idempotent across the kill;
+- the final incumbent is bit-identical to an uninterrupted run of the
+  same cycle (deterministic solvers + journaled ingest split).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.lifecycle import LifecycleController
+from spark_rapids_ml_tpu.lifecycle.journal import CycleJournal
+from spark_rapids_ml_tpu.models.kmeans import KMeans
+from spark_rapids_ml_tpu.serving.server import ServingRuntime
+from spark_rapids_ml_tpu.utils.tracing import clear_counters, counter_value
+
+UID = "jk-km"
+SEED = 3
+
+
+def _data():
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(240, 5))
+    x[:120] += 4.0
+    return x
+
+
+def _km_score(model, x, y):
+    centers = np.asarray(model.clusterCenters())
+    d = np.linalg.norm(x[:, None, :] - centers[None], axis=2).min(axis=1)
+    return -float(d.mean())
+
+
+def _controller(directory):
+    est = KMeans(uid=UID).setK(2).setSeed(SEED)
+    return LifecycleController(
+        est, ServingRuntime(start=False), "km",
+        score_fn=_km_score, directory=str(directory),
+    )
+
+
+_SCRIPT = r"""
+import os, signal, sys
+import jax
+jax.config.update("jax_enable_x64", True)  # match the pytest session
+import numpy as np
+from spark_rapids_ml_tpu.lifecycle import LifecycleController
+from spark_rapids_ml_tpu.models.kmeans import KMeans
+from spark_rapids_ml_tpu.robustness import InjectedFault, faults
+from spark_rapids_ml_tpu.serving.server import ServingRuntime
+
+rng = np.random.default_rng(11)
+x = rng.normal(size=(240, 5)); x[:120] += 4.0
+
+def km_score(model, X, y):
+    c = np.asarray(model.clusterCenters())
+    return -float(np.linalg.norm(X[:, None, :] - c[None], axis=2).min(axis=1).mean())
+
+ctrl = LifecycleController(
+    KMeans(uid="jk-km").setK(2).setSeed(3),
+    ServingRuntime(start=False), "km",
+    score_fn=km_score, directory=os.environ["LIFE_DIR"],
+)
+with faults.inject(os.environ["LIFE_FAULT"]):
+    try:
+        ctrl.run_cycle(x)
+    except InjectedFault:
+        # the real thing: no unwind, no flush, no atexit
+        os.kill(os.getpid(), signal.SIGKILL)
+print("UNEXPECTED-COMPLETION")
+"""
+
+STAGE_SPECS = [
+    ("ingest", "refit.ingest=1:fatal"),
+    ("refit", "refit.ingest=2:fatal"),
+    ("quality_gate", "refit.quality_gate=1:fatal"),
+    ("register", "refit.swap=1:fatal"),
+    ("warm", "refit.swap=2:fatal"),
+    ("flip", "refit.swap=3:fatal"),
+]
+
+
+class TestKillEveryStage:
+    @pytest.fixture(scope="class")
+    def reference_centers(self, tmp_path_factory):
+        """The uninterrupted run this whole matrix must reproduce."""
+        d = tmp_path_factory.mktemp("ref")
+        ctrl = _controller(d)
+        out = ctrl.run_cycle(_data())
+        assert out.action == "flipped" and out.version == 1
+        return np.asarray(ctrl.model.clusterCenters())
+
+    @pytest.mark.parametrize("stage,spec", STAGE_SPECS)
+    def test_sigkill_then_resume_same_cycle(
+        self, stage, spec, tmp_path, reference_centers
+    ):
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+            "LIFE_DIR": str(tmp_path),
+            "LIFE_FAULT": spec,
+            "TPUML_RETRY_BASE_DELAY": "0",
+        })
+        script = tmp_path / "killer.py"
+        script.write_text(_SCRIPT)
+        proc = subprocess.run(
+            [sys.executable, str(script)], env=env,
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == -signal.SIGKILL, (
+            stage, proc.returncode, proc.stdout, proc.stderr,
+        )
+        assert "UNEXPECTED-COMPLETION" not in proc.stdout
+
+        # Fresh interpreter (this one), empty registry — the operator's
+        # restart. Same directory, same cycle.
+        ctrl = _controller(tmp_path)
+        out = ctrl.run_cycle(_data())
+        assert out.action == "flipped", (stage, out)
+        assert out.cycle == 0, f"{stage}: resumed a DIFFERENT cycle"
+        assert ctrl.runtime.registry.versions("km") == [1], (
+            f"{stage}: duplicate registration across the kill"
+        )
+        assert ctrl.runtime.registry.aliases("km") == {"prod": 1}
+        got = np.asarray(ctrl.model.clusterCenters())
+        assert np.array_equal(got, reference_centers), (
+            f"{stage}: resumed cycle diverged from the uninterrupted run"
+        )
+        # the finished journal survives as the cycle's audit record
+        j = json.loads((tmp_path / "cycle.json").read_text())
+        assert j["finished"] and j["cycle"] == 0
+
+
+class TestRegisterFence:
+    def test_kill_between_register_and_mark_adopts_version(self, tmp_path):
+        """The narrowest idempotency window: the registry accepted the
+        candidate but the journal never heard — re-entry must ADOPT the
+        version above the fence, not register a duplicate. Simulated
+        in-process (the registry must survive the 'crash' for the
+        version to still exist: the controller-only-death shape)."""
+        ctrl = _controller(tmp_path)
+        x = _data()
+        clear_counters("lifecycle")
+
+        # Run the cycle normally up to the gate, then hand-play register
+        # without marking the journal — the pre-mark crash state.
+        from spark_rapids_ml_tpu.lifecycle.journal import CycleJournal as CJ
+        from spark_rapids_ml_tpu.robustness import InjectedFault, faults
+
+        with faults.inject("refit.swap=1:fatal"):
+            with pytest.raises(InjectedFault):
+                ctrl.run_cycle(x)
+        journal = CJ.resume_or_start(
+            str(tmp_path), ctrl._identity, 99
+        )
+        assert journal.done("quality_gate") and not journal.done("register")
+        candidate = ctrl.model  # None — load the journaled candidate
+        from spark_rapids_ml_tpu.lifecycle.controller import _load_pickle
+
+        candidate = _load_pickle(journal.payload("refit")["model"])
+        ctrl.runtime.register("km", candidate)  # landed, never journaled
+
+        resumed = LifecycleController(
+            KMeans(uid=UID).setK(2).setSeed(SEED), ctrl.runtime, "km",
+            score_fn=_km_score, directory=str(tmp_path),
+        )
+        out = resumed.run_cycle(x)
+        assert out.action == "flipped" and out.version == 1
+        assert ctrl.runtime.registry.versions("km") == [1]
+        assert counter_value("lifecycle.register.adopted") == 1
+
+
+class TestTornAndStaleJournal:
+    ID = {"name": "km", "estimator": "KMeans"}
+
+    def _write_valid(self, d, cycle=0):
+        j = CycleJournal.resume_or_start(str(d), self.ID, cycle)
+        j.mark("ingest", {"data": "x"})
+        return j
+
+    def test_torn_journal_rejected_with_fallback(self, tmp_path):
+        self._write_valid(tmp_path)
+        path = tmp_path / "cycle.json"
+        raw = path.read_bytes()
+        path.write_bytes(raw[: len(raw) // 2])  # torn mid-record
+        clear_counters("lifecycle")
+        j = CycleJournal.resume_or_start(str(tmp_path), self.ID, 7)
+        assert j.cycle == 7 and not j.done("ingest")  # fresh fallback
+        assert counter_value("lifecycle.journal.rejected") == 1
+        assert (tmp_path / "cycle.json.rejected").exists()  # evidence kept
+
+    def test_stale_identity_rejected(self, tmp_path):
+        self._write_valid(tmp_path)
+        clear_counters("lifecycle")
+        other = {"name": "km", "estimator": "LogisticRegression"}
+        j = CycleJournal.resume_or_start(str(tmp_path), other, 3)
+        assert j.cycle == 3 and not j.done("ingest")
+        assert counter_value("lifecycle.journal.rejected") == 1
+
+    def test_unknown_schema_rejected(self, tmp_path):
+        (tmp_path / "cycle.json").write_text(
+            json.dumps({"schema": 999, "cycle": 0, "stages": {},
+                        "identity": self.ID, "finished": False})
+        )
+        clear_counters("lifecycle")
+        j = CycleJournal.resume_or_start(str(tmp_path), self.ID, 2)
+        assert j.cycle == 2
+        assert counter_value("lifecycle.journal.rejected") == 1
+
+    def test_rejected_journal_never_resumes_controller(self, tmp_path):
+        """End to end: a torn journal must not wedge the controller —
+        it starts a fresh cycle and completes."""
+        (tmp_path / "cycle.json").write_text('{"schema": 1, "cyc')
+        ctrl = _controller(tmp_path)
+        out = ctrl.run_cycle(_data())
+        assert out.action == "flipped" and out.version == 1

@@ -111,6 +111,48 @@ def _merged_member_metrics(telemetry_dir):
     return hist, merged.get("counters", {})
 
 
+class FreshnessTable:
+    """Requests answered per concrete ``(model, version)`` — the
+    freshness column. Every completed future carries the version whose
+    weights executed it (serving/batcher.py, serving/router.py), so a
+    hot swap shows up here as version N's ``last_seen_s`` preceding
+    version N+1's ``first_seen_s``: the oracle for "monotone model
+    freshness, no mixed-version batch" during a refit→swap cycle."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._rows: dict = {}
+
+    def note(self, fut) -> None:
+        version = getattr(fut, "model_version", None)
+        if version is None:
+            return
+        key = (str(getattr(fut, "model_name", "")), int(version))
+        now = time.perf_counter() - self._t0
+        with self._lock:
+            row = self._rows.get(key)
+            if row is None:
+                self._rows[key] = {
+                    "requests": 1, "first_seen_s": now, "last_seen_s": now,
+                }
+            else:
+                row["requests"] += 1
+                row["last_seen_s"] = now
+
+    def report(self) -> list:
+        with self._lock:
+            return [
+                {
+                    "model": name, "version": version,
+                    "requests": row["requests"],
+                    "first_seen_s": round(row["first_seen_s"], 3),
+                    "last_seen_s": round(row["last_seen_s"], 3),
+                }
+                for (name, version), row in sorted(self._rows.items())
+            ]
+
+
 def _parse_ramp(spec: str):
     """``"rps1:s1,rps2:s2,..."`` -> [(rps, seconds), ...] with loud
     rejection of malformed phases (a typo'd ramp silently offering the
@@ -141,7 +183,8 @@ def _parse_ramp(spec: str):
     return phases
 
 
-def _run_ramp(rt, args, phases, probe_pool, distributed: bool):
+def _run_ramp(rt, args, phases, probe_pool, distributed: bool,
+              freshness: FreshnessTable):
     """Drive the piecewise phases closed-loop: one shared arrival pacer
     hands out send slots at the phase's target rate; ``--threads``
     workers each carry one outstanding request, so in-flight never
@@ -193,7 +236,9 @@ def _run_ramp(rt, args, phases, probe_pool, distributed: bool):
                 probe = probe_pool[(tid + j) % len(probe_pool)]
                 t_req = time.perf_counter()
                 try:
-                    rt.submit(args.family, probe, timeout=args.timeout).result()
+                    fut = rt.submit(args.family, probe, timeout=args.timeout)
+                    fut.result()
+                    freshness.note(fut)
                     dt_ms = (time.perf_counter() - t_req) * 1e3
                     with lock:
                         state["ok"] += 1
@@ -334,13 +379,16 @@ def main() -> None:
     errors = {"overloaded": 0, "deadline": 0, "other": 0}
     ok = [0] * args.threads
     err_lock = threading.Lock()
+    freshness = FreshnessTable()
 
     def worker(tid: int) -> None:
         for j in range(args.requests):
             try:
-                rt.submit(
+                fut = rt.submit(
                     args.family, probes[tid, j], timeout=args.timeout
-                ).result()
+                )
+                fut.result()
+                freshness.note(fut)
                 ok[tid] += 1
             except Overloaded as exc:
                 with err_lock:
@@ -362,7 +410,8 @@ def main() -> None:
     if ramp_phases is not None:
         t0 = time.perf_counter()
         ramp_report, completed, errors = _run_ramp(
-            rt, args, ramp_phases, probes, distributed=args.workers >= 1
+            rt, args, ramp_phases, probes, distributed=args.workers >= 1,
+            freshness=freshness,
         )
         wall = time.perf_counter() - t0
         requests_offered = sum(p["offered"] for p in ramp_report)
@@ -412,6 +461,7 @@ def main() -> None:
         "shed_memory": shed_memory,
         "deadline_expired": deadline_expired,
         "errors": errors,
+        "freshness": freshness.report(),
     }
     if ramp_report is not None:
         summary["ramp"] = ramp_report
@@ -464,6 +514,10 @@ def main() -> None:
             print(f"    member {m['member']}: rows/s={m['rows_per_s']} "
                   f"completed={m['completed']} routed={m['routed']} "
                   f"shed={m['shed']}")
+    for row in summary["freshness"]:
+        print(f"  freshness:   {row['model']} v{row['version']}: "
+              f"{row['requests']} requests, "
+              f"first={row['first_seen_s']}s last={row['last_seen_s']}s")
     if any(errors.values()):
         print(f"  errors:      {errors}")
 
