@@ -81,6 +81,7 @@ class DriftMonitor:
         self._window: List[float] = []
         self._edges: Optional[np.ndarray] = None  # (bins+1,) reference edges
         self._reference: Optional[np.ndarray] = None  # (bins+2,) counts w/ tails
+        self._slo_votes = 0  # pending breach votes (consumed on evaluate)
 
     # --- ingestion ---
 
@@ -116,6 +117,26 @@ class DriftMonitor:
         hi = np.count_nonzero(values > self._edges[-1])
         return np.concatenate(([lo], inner, [hi]))
 
+    # --- the SLO vote ---
+
+    def on_slo_breach(self, record: Optional[dict] = None) -> None:
+        """An SLO error-budget breach as a refit vote. Wired as an
+        :class:`~spark_rapids_ml_tpu.observability.slo.SloMonitor`
+        subscriber (recover records are ignored), it does NOT fire a
+        refit by itself — model staleness is only one of the ways a
+        gang burns budget. It lowers the next tick's window floor so
+        the drift evidence already on hand gets evaluated NOW instead
+        of waiting out ``min_count``: a drifted model under a burning
+        SLO refits a window early, a healthy one exonerates itself."""
+        if record is not None and record.get("action") not in (None, "breach"):
+            return
+        self._slo_votes += 1
+        emit(
+            "lifecycle", action="slo_vote", model=self.name,
+            objective=(record or {}).get("objective"),
+            burn=(record or {}).get("burn"), votes=self._slo_votes,
+        )
+
     # --- trigger ---
 
     def tick(self) -> Optional[float]:
@@ -125,8 +146,14 @@ class DriftMonitor:
 
     def _tick_once(self) -> Optional[float]:
         fault_point("drift.tick")
-        if len(self._window) < self.min_count:
+        # A pending SLO vote drops the window floor (PSI needs SOME
+        # mass, so never below 2): evaluate the evidence on hand early.
+        need = (
+            min(self.min_count, 2) if self._slo_votes else self.min_count
+        )
+        if len(self._window) < need:
             return None
+        self._slo_votes = 0
         values = np.asarray(self._window, dtype=np.float64)
         if self._reference is None:
             lo, hi = float(values.min()), float(values.max())

@@ -83,3 +83,22 @@ from spark_rapids_ml_tpu.observability.costs import (  # noqa: F401
     merge_ledger_docs,
     validate_ledger,
 )
+from spark_rapids_ml_tpu.observability import flightrec  # noqa: F401
+from spark_rapids_ml_tpu.observability import opsplane  # noqa: F401
+from spark_rapids_ml_tpu.observability import slo  # noqa: F401
+from spark_rapids_ml_tpu.observability.opsplane import (  # noqa: F401
+    OPS_PORT_ENV,
+    OpsServer,
+)
+from spark_rapids_ml_tpu.observability.slo import (  # noqa: F401
+    SLO_ENV,
+    SloMonitor,
+    parse_slo,
+)
+
+# The live ops plane is env-armed at import (both are no-ops — and
+# allocate nothing — when TPUML_OPS_PORT / TPUML_SLO are unset), so
+# EVERY process of a gang gets its scrape endpoints and SLO evaluation
+# without member-side code.
+opsplane.maybe_start_from_env()
+slo.maybe_start_from_env()

@@ -65,6 +65,7 @@ EVENT_LOG_ENV = "TPUML_EVENT_LOG"
 TELEMETRY_DIR_ENV = "TPUML_TELEMETRY_DIR"
 TRACE_ID_ENV = "TPUML_TRACE_ID"
 TRACE_PARENT_ENV = "TPUML_TRACE_PARENT"
+FLIGHT_ENV = "TPUML_FLIGHT"
 
 #: Spans kept per run context for report building (reports read a window
 #: of this deque; an unbounded long-lived scope must not grow forever).
@@ -107,6 +108,7 @@ SCHEMA: Dict[str, frozenset] = {
     "persistence": frozenset({"action", "path"}),
     "telemetry": frozenset({"action", "path"}),
     "lockcheck": frozenset({"action", "lock"}),
+    "slo": frozenset({"action", "objective"}),
 }
 
 
@@ -331,6 +333,15 @@ _n_emitted = 0  # guarded-by: _sink_lock
 #: Active telemetry-dir sharding: {"dir": <dir>, "shard": <shard path>}.
 _telemetry: Optional[dict] = None  # guarded-by: _sink_lock
 _process_index: Optional[int] = None
+#: Flight-recorder ring (``TPUML_FLIGHT=<N>``): the last N record dicts,
+#: captured EVEN when no sink is configured — the crash dump's evidence.
+#: None (the default) keeps the disabled emit() path allocation-free.
+_flight_ring: Optional[deque] = None
+
+
+def flight_ring() -> Optional[deque]:
+    """The live flight ring (None when ``TPUML_FLIGHT`` is off)."""
+    return _flight_ring
 
 
 def set_process_index(idx: int) -> None:
@@ -387,6 +398,9 @@ def configure(path: Optional[str] = None) -> Optional[str]:
             else:
                 dest = env_str(EVENT_LOG_ENV)
         if not dest:
+            # No sink — but the flight ring arms regardless: the crash
+            # dump must work in processes that never configured a log.
+            _configure_flight()
             return None
         if dest == "stderr":
             _sink = sys.stderr
@@ -396,9 +410,33 @@ def configure(path: Optional[str] = None) -> Optional[str]:
             _sink = open(dest, "a", buffering=1)
             _sink_owned = True
         shard_opened = dest if _telemetry is not None else None
+    _configure_flight()
     if shard_opened is not None:
         emit("telemetry", action="shard_open", path=shard_opened)
     return dest
+
+
+def _configure_flight() -> None:
+    """Arm (or disarm) the flight-recorder ring from ``TPUML_FLIGHT``.
+    Armed, the ring captures every emit() — sink or no sink — and
+    ``observability.flightrec`` hooks fatal exceptions and lockcheck
+    stall strikes to dump it."""
+    global _flight_ring
+    try:
+        n = env_int(FLIGHT_ENV, 0, minimum=0)
+    except EnvKnobError:
+        n = 0
+    if not n:
+        _flight_ring = None
+        return
+    if _flight_ring is None or _flight_ring.maxlen != n:
+        _flight_ring = deque(maxlen=int(n))
+    try:
+        from spark_rapids_ml_tpu.observability import flightrec
+
+        flightrec.arm()
+    except Exception:  # pragma: no cover - recorder must never break emit
+        pass
 
 
 def enabled() -> bool:
@@ -412,10 +450,14 @@ def emitted_count() -> int:
 
 
 def emit(etype: str, **fields) -> None:
-    """Write one record. With no sink configured this returns after ONE
-    module-global check — the disabled path allocates nothing."""
+    """Write one record. With no sink configured (and no flight ring
+    armed) this returns after one module-global check — the disabled
+    path allocates nothing. An armed ``TPUML_FLIGHT`` ring captures the
+    record dict even when the sink is off: the crash dump works without
+    an event log configured."""
     sink = _sink
-    if sink is None:
+    ring = _flight_ring
+    if sink is None and ring is None:
         return
     global _n_emitted
     ctx = _CTX.get()
@@ -430,6 +472,10 @@ def emit(etype: str, **fields) -> None:
         "trace": tc.trace_id if tc is not None else None,
     }
     rec.update(fields)
+    if ring is not None:
+        ring.append(rec)  # deque.append is atomic; maxlen bounds it
+    if sink is None:
+        return
     line = json.dumps(rec, default=str)
     with _sink_lock:
         if _sink is None:  # reconfigured under us
@@ -475,12 +521,22 @@ def flush_telemetry() -> Optional[str]:
             )
     except Exception:  # pragma: no cover - best-effort shard
         costs_path = None
+    # The live ops port (when the ops server is up) rides the manifest so
+    # post-hoc tooling and gang aggregators can find the scrape endpoint.
+    ops_port = None
+    try:
+        from spark_rapids_ml_tpu.observability import opsplane
+
+        ops_port = opsplane.active_port()
+    except Exception:  # pragma: no cover - manifest must always write
+        ops_port = None
     manifest = {
         "pid": pid,
         "process": _resolve_process_index(),
         "shard": os.path.basename(tele["shard"]),
         "metrics": os.path.basename(metrics_path) if metrics_path else None,
         "costs": os.path.basename(costs_path) if costs_path else None,
+        "ops_port": ops_port,
         "trace_roots": roots,
         "emitted": emitted,
         # One (wall, mono) sample at a single instant — the merger's
@@ -496,6 +552,44 @@ def flush_telemetry() -> Optional[str]:
     except OSError:  # pragma: no cover - best-effort manifest
         return None
     return path
+
+
+def install_sigterm_flush():
+    """Install a SIGTERM handler that dumps the flight ring and flushes
+    this process's telemetry shard (manifest + metrics) BEFORE raising
+    ``SystemExit(143)`` — a SIGTERM'd gang member must not leave a
+    manifest-less shard behind (the default handler kills the process
+    before any atexit flush runs). Returns an undo callable.
+    ``signal.signal`` is main-thread-only (barrier-stub members run on
+    driver threads): there the normal exit-path flush already covers
+    retirement, so a failed install degrades to a no-op undo."""
+    import signal
+
+    def _handler(signum, frame):
+        try:
+            from spark_rapids_ml_tpu.observability import flightrec
+
+            flightrec.dump("sigterm")
+        except Exception:
+            pass
+        try:
+            flush_telemetry()
+        except Exception:
+            pass
+        raise SystemExit(143)
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # not the main thread
+        return lambda: None
+
+    def _undo() -> None:
+        try:
+            signal.signal(signal.SIGTERM, prev)
+        except (ValueError, TypeError):
+            pass
+
+    return _undo
 
 
 def _close_at_exit() -> None:  # pragma: no cover - interpreter teardown

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import re
 import threading
 import time
 from typing import Callable, Dict, Iterable, Optional, Tuple, Union
@@ -307,29 +308,12 @@ class Registry:
 
     def render_prometheus(self) -> str:
         """The Prometheus text exposition format (metric names prefixed
-        ``tpuml_``, dots to underscores)."""
-        lines = []
-        for name, m in sorted(self.metrics().items()):
-            pname = _prom_name(name)
-            if m.help:
-                lines.append(f"# HELP {pname} {m.help}")
-            lines.append(f"# TYPE {pname} {m.kind}")
-            series = m._snapshot_series()
-            if isinstance(m, Histogram):
-                for key, v in sorted(series.items()):
-                    base = dict(key)
-                    for le, c in v["buckets"].items():
-                        le_s = "+Inf" if le == float("inf") else repr(le)
-                        labels = _label_key({**base, "le": le_s})
-                        inner = ",".join(f'{k}="{val}"' for k, val in labels)
-                        lines.append(f"{pname}_bucket{{{inner}}} {c}")
-                    suffix = _series_name("", key)
-                    lines.append(f"{pname}_sum{suffix} {v['sum']}")
-                    lines.append(f"{pname}_count{suffix} {v['count']}")
-            else:
-                for key, v in sorted(series.items()):
-                    lines.append(f"{pname}{_series_name('', key)} {float(v)}")
-        return "\n".join(lines) + "\n"
+        ``tpuml_``, dots to underscores). Delegates to the ONE shared
+        renderer (:func:`render_prometheus_snapshot`) so ``/metrics``,
+        ``TPUML_METRICS_DUMP`` and ``tools/tpuml_metrics.py snapshot``
+        all emit byte-identical exposition for the same state."""
+        helps = {name: m.help for name, m in self.metrics().items() if m.help}
+        return render_prometheus_snapshot(self.snapshot(), helps=helps)
 
 
 default_registry = Registry()
@@ -363,27 +347,182 @@ def observe_segment_seconds(solver: str, seconds: float) -> None:
     ).observe(seconds, solver=solver)
 
 
-def percentile_from_histogram(hist_value: dict, q: float) -> float:
+def percentile_from_histogram(hist_value: dict, q: float) -> Optional[float]:
     """Linear-interpolated percentile from a fixed-bucket histogram
-    snapshot (``{"buckets": {le: cumulative}, "count": n}``). The +Inf
-    bucket reports its lower edge (the histogram's resolution limit).
-    Shared by the loadgen report and the serving shed-backoff hint
+    snapshot (``{"buckets": {le: cumulative}, "count": n}``). Returns
+    ``None`` when the histogram holds no usable signal — zero
+    observations, or every observation in the +Inf overflow bucket —
+    so callers (``Overloaded.retry_after_ms``, the batcher deadline)
+    fall back to their static defaults instead of trusting the top
+    bucket edge. When the percentile itself lands in +Inf but finite
+    buckets hold mass, the top finite edge is reported (the
+    histogram's resolution limit). Shared by the loadgen report and
+    the serving shed-backoff hint
     (``serving.admission.retry_after_hint_ms``)."""
     count = hist_value["count"]
     if count == 0:
-        return float("nan")
+        return None
     target = q * count
     prev_le, prev_cum = 0.0, 0
     for le, cum in sorted(hist_value["buckets"].items()):
         if cum >= target:
             if le == float("inf"):
-                return prev_le
+                return prev_le if prev_cum > 0 else None
             if cum == prev_cum:
                 return le
             frac = (target - prev_cum) / (cum - prev_cum)
             return prev_le + frac * (le - prev_le)
         prev_le, prev_cum = le, cum
-    return prev_le
+    return prev_le if prev_cum > 0 else None
+
+
+# --- the ONE Prometheus exposition renderer ---
+#
+# Three surfaces used to carry three renderers (Registry.render_prometheus,
+# tools/tpuml_metrics.render_snapshot_prometheus, and what /metrics would
+# have added); they drifted on HELP lines and label escaping. Everything
+# now renders a Registry.snapshot()-shaped dict through the functions
+# below.
+
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _split_series_name(series: str) -> Tuple[str, list]:
+    """``name{a="x",b="y"}`` -> ``("name", [("a", "x"), ("b", "y")])``.
+    Snapshot keys store raw (unescaped) label values; escaping is a
+    render-time concern."""
+    base, brace, rest = series.partition("{")
+    if not brace:
+        return series, []
+    return base, [(k, v) for k, v in _LABEL_RE.findall(rest)]
+
+
+def _render_labels(pairs) -> str:
+    if not pairs:
+        return ""
+    # Sorted, matching the registry's series-key order (`_label_key`),
+    # so an appended ``le`` lands where the in-registry renderer always
+    # put it and exposition stays byte-stable across render paths.
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in sorted(pairs)
+    )
+    return f"{{{inner}}}"
+
+
+def render_prometheus_snapshot(
+    snapshot: dict, helps: Optional[Dict[str, str]] = None
+) -> str:
+    """Render a :meth:`Registry.snapshot` dict as Prometheus text
+    exposition: ``# HELP``/``# TYPE`` per metric, ``tpuml_`` prefix,
+    dots to underscores, label values escaped. This is the single
+    renderer behind ``/metrics`` scrapes, ``TPUML_METRICS_DUMP``
+    ``.prom`` dumps, and ``tools/tpuml_metrics.py snapshot``."""
+    helps = helps or {}
+    lines = []
+    by_metric: Dict[str, list] = {}
+    kinds: Dict[str, str] = {}
+    for group, kind in (("counters", "counter"), ("gauges", "gauge")):
+        for series, value in sorted(snapshot.get(group, {}).items()):
+            base, labels = _split_series_name(series)
+            kinds.setdefault(base, kind)
+            by_metric.setdefault(base, []).append((labels, value))
+    for base in sorted(by_metric):
+        pname = _prom_name(base)
+        if helps.get(base):
+            lines.append(f"# HELP {pname} {_escape_help(helps[base])}")
+        lines.append(f"# TYPE {pname} {kinds[base]}")
+        for labels, value in by_metric[base]:
+            lines.append(f"{pname}{_render_labels(labels)} {float(value)}")
+    for name, series_map in sorted(snapshot.get("histograms", {}).items()):
+        pname = _prom_name(name)
+        if helps.get(name):
+            lines.append(f"# HELP {pname} {_escape_help(helps[name])}")
+        lines.append(f"# TYPE {pname} histogram")
+        for series, cell in sorted(series_map.items()):
+            _, labels = _split_series_name(series)
+            for le, c in cell["buckets"].items():
+                le_s = "+Inf" if le in ("inf", "Infinity") else le
+                lines.append(
+                    f"{pname}_bucket"
+                    f"{_render_labels(labels + [('le', le_s)])} {c}"
+                )
+            suffix = _render_labels(labels)
+            lines.append(f"{pname}_sum{suffix} {cell['sum']}")
+            lines.append(f"{pname}_count{suffix} {cell['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_exposition(text: str) -> Dict[str, dict]:
+    """Parse Prometheus text exposition back into
+    ``{metric: {"type", "help", "series": {display_name: value}}}`` —
+    the conformance oracle for the round-trip test and the CI scrape
+    validation gate. Raises :class:`MetricError` on a malformed line."""
+    out: Dict[str, dict] = {}
+
+    def cell(pname: str) -> dict:
+        return out.setdefault(
+            pname, {"type": None, "help": None, "series": {}}
+        )
+
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            pname, _, help_text = rest.partition(" ")
+            cell(pname)["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            pname, _, kind = rest.partition(" ")
+            if kind not in ("counter", "gauge", "histogram"):
+                raise MetricError(f"line {i}: unknown metric type {kind!r}")
+            cell(pname)["type"] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = re.match(
+            r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})?\s+(\S+)$", line
+        )
+        if m is None:
+            raise MetricError(f"line {i}: malformed series line {line!r}")
+        name, braces, raw = m.group(1), m.group(2) or "", m.group(3)
+        labels = [
+            (k, v.replace('\\"', '"').replace("\\n", "\n").replace("\\\\", "\\"))
+            for k, v in _LABEL_RE.findall(braces)
+        ]
+        try:
+            value = float(raw)
+        except ValueError:
+            raise MetricError(f"line {i}: non-numeric value {raw!r}")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and out.get(name[: -len(suffix)], {}).get(
+                "type"
+            ) == "histogram":
+                base = name[: -len(suffix)]
+        series = name + (
+            "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+            if labels
+            else ""
+        )
+        cell(base)["series"][series] = value
+    return out
 
 
 def dump_snapshot(path: str, registry: Optional[Registry] = None) -> None:

@@ -45,22 +45,32 @@ from spark_rapids_ml_tpu.observability.events import validate_record
 SHARD_GLOB = "events-*.jsonl"
 MANIFEST_GLOB = "manifest-*.json"
 METRICS_GLOB = "metrics-*.json"
+FLIGHT_GLOB = "flight-*.json"
 
 
 def read_shards(telemetry_dir: str) -> dict:
     """Load every shard under ``telemetry_dir``.
 
-    Returns ``{"records", "manifests", "metrics", "problems"}`` —
-    ``records`` in shard order with line provenance kept out-of-band in
-    ``problems`` strings (``shard:line: <why>``), ``metrics`` as
-    ``{"file", "snapshot"}`` pairs, ``manifests`` as decoded dicts."""
+    Returns ``{"records", "manifests", "metrics", "flights",
+    "problems"}`` — ``records`` in shard order with line provenance kept
+    out-of-band in ``problems`` strings (``shard:line: <why>``),
+    ``metrics`` as ``{"file", "snapshot"}`` pairs, ``manifests`` as
+    decoded dicts.
+
+    A ``flight-<pid>.json`` crash dump (``observability/flightrec``) is
+    a merge SOURCE: for a pid that left no manifest (killed before its
+    atexit flush — PR 7's documented hole) the flight doc stands in as
+    its manifest; its metrics snapshot joins the merge when that pid
+    wrote no ``metrics-<pid>.json``; and its event ring joins the record
+    stream when that pid left no event shard at all. A pid that DID
+    flush contributes nothing from its dump — the ring is a suffix of
+    the shard, and double-merging would double-count."""
     records: List[dict] = []
     problems: List[str] = []
     manifests: List[dict] = []
     metrics: List[dict] = []
+    flights: List[dict] = []
     shard_paths = sorted(glob.glob(os.path.join(telemetry_dir, SHARD_GLOB)))
-    if not shard_paths:
-        problems.append(f"no {SHARD_GLOB} shards under {telemetry_dir}")
     for path in shard_paths:
         name = os.path.basename(path)
         with open(path) as f:
@@ -91,10 +101,61 @@ def read_shards(telemetry_dir: str) -> dict:
                 )
         except (OSError, json.JSONDecodeError) as exc:
             problems.append(f"{os.path.basename(path)}: unreadable ({exc})")
+    shard_pids = {rec.get("pid") for rec in records}
+    manifest_pids = {m.get("pid") for m in manifests}
+    metrics_pids = set()
+    for m in metrics:
+        stem = m["file"][len("metrics-"):-len(".json")]
+        if stem.isdigit():
+            metrics_pids.add(int(stem))
+    for path in sorted(glob.glob(os.path.join(telemetry_dir, FLIGHT_GLOB))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            problems.append(f"{name}: unreadable ({exc})")
+            continue
+        if not isinstance(doc, dict) or doc.get("kind") != "tpuml-flight":
+            problems.append(f"{name}: not a flight-recorder dump")
+            continue
+        flights.append({"file": name, "doc": doc})
+        pid = doc.get("pid")
+        if pid not in shard_pids:
+            for i, rec in enumerate(doc.get("ring") or [], start=1):
+                if not isinstance(rec, dict):
+                    problems.append(f"{name}:ring[{i}]: not an object")
+                    continue
+                for p in validate_record(rec):
+                    problems.append(f"{name}:ring[{i}]: {p}")
+                rec = dict(rec)
+                rec["_shard"] = name
+                records.append(rec)
+        if pid not in manifest_pids:
+            manifests.append(
+                {
+                    "pid": pid,
+                    "process": doc.get("process"),
+                    "shard": name,
+                    "metrics": name if doc.get("metrics") else None,
+                    "costs": None,
+                    "ops_port": None,
+                    "trace_roots": doc.get("trace_roots", []),
+                    "emitted": doc.get("emitted"),
+                    "ts": doc.get("ts"),
+                    "mono": doc.get("mono"),
+                    "flight": doc.get("reason", True),
+                }
+            )
+        if doc.get("metrics") and pid not in metrics_pids:
+            metrics.append({"file": name, "snapshot": doc["metrics"]})
+    if not shard_paths and not flights:
+        problems.append(f"no {SHARD_GLOB} shards under {telemetry_dir}")
     return {
         "records": records,
         "manifests": manifests,
         "metrics": metrics,
+        "flights": flights,
         "problems": problems,
     }
 
@@ -359,6 +420,7 @@ def assemble(telemetry_dir: str) -> dict:
         "records": records,
         "record_count": len(records),
         "manifests": bundle["manifests"],
+        "flights": [f["file"] for f in bundle["flights"]],
         "traces": {
             tid: _trace_summary(cell)
             for tid, cell in traces.items()

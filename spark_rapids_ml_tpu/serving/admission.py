@@ -115,7 +115,7 @@ def retry_after_hint_ms(default_ms: float = DEFAULT_RETRY_AFTER_MS) -> float:
         p95 = percentile_from_histogram(_latency_hist().value(), 0.95)
     except Exception:  # pragma: no cover - metrics registry unavailable
         return float(default_ms)
-    if not (p95 > 0):  # NaN (empty histogram) or degenerate zero
+    if p95 is None or not (p95 > 0):  # empty histogram or degenerate zero
         return float(default_ms)
     return float(p95)
 

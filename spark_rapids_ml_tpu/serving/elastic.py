@@ -140,8 +140,21 @@ class ElasticScaler:
         value = hist.value()
         if not value or value.get("count", 0) < 8:
             return None
-        p95 = percentile_from_histogram(value, 0.95)
-        return None if p95 != p95 else p95  # NaN -> None
+        return percentile_from_histogram(value, 0.95)  # None when empty
+
+    @staticmethod
+    def _slo_burn() -> float:
+        """Worst live SLO error-budget burn rate (0.0 when no monitor is
+        active or nothing is burning). Burn > 1.0 on ANY objective is a
+        capacity statement with the operator's own numbers in it, so it
+        votes scale-up alongside depth/shed/deadline."""
+        try:
+            from spark_rapids_ml_tpu.observability import slo as _slo
+
+            rates = _slo.burn_rates()
+        except Exception:  # noqa: BLE001 - the vote is optional
+            return 0.0
+        return max(rates.values(), default=0.0)
 
     def _deadline_budget_ms(self) -> Optional[float]:
         """Explicit budget wins; else derive one from the autotuner's
@@ -207,10 +220,16 @@ class ElasticScaler:
             p95 is not None and budget is not None and p95 > budget
         )
 
-        pressured = depth > self.high or shed_delta > 0 or over_deadline
+        slo_burn = self._slo_burn()
+        slo_breach = slo_burn > 1.0
+
+        pressured = (
+            depth > self.high or shed_delta > 0 or over_deadline
+            or slo_breach
+        )
         idle = (
             depth < self.low and shed_delta == 0
-            and not over_deadline
+            and not over_deadline and not slo_breach
         )
         if pressured:
             self._up_votes += 1
@@ -234,6 +253,7 @@ class ElasticScaler:
                 "elastic", action="scale_up", member=member,
                 members=live + 1, depth=round(depth, 3),
                 shed_delta=shed_delta, over_deadline=over_deadline,
+                slo_burn=round(slo_burn, 4),
             )
             self.decisions.append(("scale_up", member))
             return "scale_up"

@@ -101,12 +101,17 @@ def member_path(rendezvous: str, member: int) -> str:
     return os.path.join(rendezvous, f"member-{int(member)}.json")
 
 
-def publish_member(rendezvous: str, member: int, host: str, port: int) -> str:
+def publish_member(rendezvous: str, member: int, host: str, port: int,
+                   ops_port: Optional[int] = None) -> str:
     """Atomically publish one member's contact card (tmp + rename, the
-    same torn-write posture the checkpoint layer uses)."""
+    same torn-write posture the checkpoint layer uses). ``ops_port``
+    (when the member runs an ops server) rides the card so the router
+    can scrape the member's live ``/varz`` for the gang ``/statusz``."""
     os.makedirs(rendezvous, exist_ok=True)
     card = {"member": int(member), "pid": os.getpid(), "host": host,
             "port": int(port)}
+    if ops_port is not None:
+        card["ops_port"] = int(ops_port)
     fd, tmp = tempfile.mkstemp(dir=rendezvous, prefix=f".member-{member}-")
     try:
         with os.fdopen(fd, "w") as f:

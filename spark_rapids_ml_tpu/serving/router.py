@@ -262,6 +262,16 @@ class RoutingRuntime:
                 self._launch_barrier(rdd)
             self._connect_members()
         _ROUTERS.add(self)
+        # The gang-wide scrape: if this process runs an ops server, the
+        # router claims /statusz on it (dynamic lookup — registration
+        # order vs server start doesn't matter).
+        self._statusz_endpoint = lambda: _statusz_body(self)
+        try:
+            from spark_rapids_ml_tpu.observability import opsplane
+
+            opsplane.add_endpoint("/statusz", self._statusz_endpoint)
+        except Exception:  # pragma: no cover - scrape wiring is best-effort
+            pass
 
     # --- launch ---------------------------------------------------------
 
@@ -1301,6 +1311,12 @@ class RoutingRuntime:
         if self._closed:
             return
         self._closed = True
+        try:
+            from spark_rapids_ml_tpu.observability import opsplane
+
+            opsplane.remove_endpoint("/statusz", self._statusz_endpoint)
+        except Exception:  # pragma: no cover
+            pass
         with self._lock:
             members = list(self._members.values())
         for member in members:
@@ -1398,3 +1414,68 @@ class RoutingRuntime:
             "members": members,
             "models": self.registry.snapshot(),
         }
+
+    def statusz(self) -> dict:
+        """The gang-merged live view: this process's own registry
+        snapshot plus every live member's ``/varz`` metrics (scraped via
+        the ops port its contact card published), folded with the EXACT
+        merge semantics the post-hoc ``tpuml_trace`` merge uses
+        (:func:`observability.trace.merge_metrics`: counters sum, gauges
+        max, histograms bucket-wise sum) — a live scrape of a quiesced
+        gang and a post-mortem assemble of its telemetry dir agree to
+        the counter."""
+        import json as _json
+        import urllib.request
+
+        from spark_rapids_ml_tpu.observability import slo as _slo
+        from spark_rapids_ml_tpu.observability.metrics import default_registry
+        from spark_rapids_ml_tpu.observability.trace import merge_metrics
+
+        with self._lock:
+            cards = {
+                m.id: dict(m.card)
+                for m in self._members.values()
+                if not m.dead
+            }
+        snapshots = [default_registry.snapshot()]
+        members: Dict[str, dict] = {}
+        for mid, card in sorted(cards.items()):
+            ops_port = card.get("ops_port")
+            cell: dict = {"pid": card.get("pid"), "ops_port": ops_port}
+            if ops_port:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://{card.get('host', '127.0.0.1')}:"
+                        f"{ops_port}/varz",
+                        timeout=5.0,
+                    ) as resp:
+                        doc = _json.loads(resp.read().decode("utf-8"))
+                    cell["ok"] = True
+                    cell["process"] = doc.get("process")
+                    snap = doc.get("metrics")
+                    if isinstance(snap, dict):
+                        snapshots.append(snap)
+                except Exception as exc:  # noqa: BLE001 - a dead member
+                    cell["ok"] = False  # must not 500 the gang scrape
+                    cell["error"] = type(exc).__name__
+            else:
+                cell["ok"] = False
+                cell["error"] = "no ops_port on contact card"
+            members[str(mid)] = cell
+        return {
+            "router": self.snapshot(),
+            "members": members,
+            "slo": _slo.burn_rates(),
+            "merged": merge_metrics(snapshots),
+        }
+
+
+def _statusz_body(router: "RoutingRuntime"):
+    """The /statusz endpoint body (registered on the ops server)."""
+    import json as _json
+
+    return (
+        200,
+        "application/json",
+        _json.dumps(router.statusz(), indent=2, default=str) + "\n",
+    )

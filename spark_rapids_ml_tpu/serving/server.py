@@ -154,6 +154,18 @@ class ServingRuntime:
         if self._closed:
             raise RuntimeError("serving runtime is closed")
         self._batcher.start()
+        # Dispatcher-thread aliveness folds into this process's
+        # /healthz: a runtime whose dispatcher died (or never restarted
+        # after a stop) is unhealthy even while its socket still answers.
+        try:
+            from spark_rapids_ml_tpu.observability import opsplane
+
+            opsplane.add_probe(
+                f"dispatcher.{self.runtime_id}",
+                lambda: self._closed or self._batcher.running,
+            )
+        except Exception:  # pragma: no cover - probe wiring is best-effort
+            pass
 
     @property
     def running(self) -> bool:
@@ -177,6 +189,12 @@ class ServingRuntime:
         # inflight series in the merged snapshot.
         gauge("serving.queue.depth", "").remove(runtime=self.runtime_id)
         gauge("serving.inflight", "").remove(runtime=self.runtime_id)
+        try:
+            from spark_rapids_ml_tpu.observability import opsplane
+
+            opsplane.remove_probe(f"dispatcher.{self.runtime_id}")
+        except Exception:  # pragma: no cover
+            pass
         emit("serving", action="close", runtime=self.runtime_id, drain=drain)
 
     def __enter__(self) -> "ServingRuntime":

@@ -45,6 +45,7 @@ from typing import Any, Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.observability import events as _ev
+from spark_rapids_ml_tpu.observability import opsplane
 from spark_rapids_ml_tpu.observability.heartbeat import heartbeat_scope
 from spark_rapids_ml_tpu.serving import ipc
 from spark_rapids_ml_tpu.serving.admission import DeadlineExceeded, Overloaded
@@ -366,13 +367,22 @@ def serve_member(
     )
     rt = runtime if runtime is not None else ServingRuntime()
     worker = ServingWorker(member, rt)
+    # A SIGTERM'd member (preemption, a kill-based retire) must still
+    # publish its manifest — the flush rides the signal handler, not
+    # just the happy-path finally below.
+    undo_sigterm = _ev.install_sigterm_flush()
+    # The ops plane, if armed: each spawned member inherits
+    # TPUML_OPS_PORT (0 = ephemeral, the only collision-free gang
+    # setting) and publishes its bound port on the contact card below.
+    ops = opsplane.maybe_start_from_env()
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     try:
         srv.bind(("127.0.0.1", 0))
         srv.listen(1)
         srv.settimeout(timeout)
         port = srv.getsockname()[1]
-        ipc.publish_member(rendezvous, member, "127.0.0.1", port)
+        ipc.publish_member(rendezvous, member, "127.0.0.1", port,
+                           ops_port=ops.port if ops is not None else None)
         _ev.emit("serving", action="member_up", member=member, port=port,
                  mem_budget=rt.mem_budget)
         # Manual-mode heartbeat: the FRAME LOOP beats it, so the age is
@@ -406,6 +416,7 @@ def serve_member(
         _ev.emit("serving", action="member_down", member=member,
                  drain=worker.drain, served=worker.served)
         _ev.flush_telemetry()
+        undo_sigterm()
     return {"member": int(member), "served": worker.served,
             "drain": worker.drain}
 

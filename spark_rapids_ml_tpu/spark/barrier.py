@@ -134,6 +134,12 @@ def barrier_gang_run(
             # this stage's trace into the next job's.
             saved = {k: os.environ.get(k) for k in carrier}
             os.environ.update(carrier)
+            # A SIGTERM'd member (executor decommission, preemption)
+            # flushes its shard + manifest from the handler — the
+            # manifest-less-shard WARNING in the post-hoc merge is for
+            # SIGKILL-class deaths only. On the driver-local stub this
+            # is a no-op (not the main thread).
+            undo_sigterm = _ev.install_sigterm_flush()
             try:
                 if not _ev.enabled():
                     # A fresh executor process: wire its own telemetry
@@ -169,6 +175,7 @@ def barrier_gang_run(
                             result = list(result)
                         return result
             finally:
+                undo_sigterm()
                 for k, v in saved.items():
                     if v is None:
                         os.environ.pop(k, None)

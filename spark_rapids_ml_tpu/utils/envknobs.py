@@ -321,6 +321,31 @@ KNOBS: Dict[str, Knob] = _knob_table(
     Knob("TPUML_LOCKCHECK_GRAPH", "str", "lockcheck",
          "write the runtime acquisition-order graph + violation log "
          "here at interpreter exit"),
+    # live ops plane (observability/opsplane.py, slo.py, flightrec.py)
+    Knob("TPUML_OPS_PORT", "int", "ops-plane",
+         "per-process ops HTTP server port exposing /metrics /healthz "
+         "/varz /tracez (and /statusz on a routing process); 0 binds an "
+         "ephemeral port published in the telemetry manifest and on "
+         "serving contact cards (unset: no server)"),
+    Knob("TPUML_OPS_STALL_S", "float", "ops-plane",
+         "gang-heartbeat age (seconds) above which /healthz reports the "
+         "process unhealthy (0 = heartbeat probe off)", default=30.0),
+    Knob("TPUML_SLO", "str", "ops-plane",
+         "declared service-level objectives, e.g. "
+         "'serving.p95_ms<=50;shed.rate<=0.01;freshness.age_s<=600'; "
+         "evaluated on rolling windows, published as slo.burn_rate "
+         "gauges + slo events (unset: SLO layer off)"),
+    Knob("TPUML_SLO_EVERY_MS", "float", "ops-plane",
+         "milliseconds between background SLO evaluation ticks when "
+         "the monitor thread is started", default=1000.0),
+    Knob("TPUML_FLIGHT", "int", "ops-plane",
+         "flight-recorder ring size: keep the last N event records in "
+         "memory (even with no event sink configured) and dump them as "
+         "flight-<pid>.json on fatal exception, SIGTERM, or a lockcheck "
+         "stall strike (0 = recorder off)", default=0),
+    Knob("TPUML_FLIGHT_DIR", "str", "ops-plane",
+         "directory for flight-recorder dumps (default: the active "
+         "TPUML_TELEMETRY_DIR, else the process working directory)"),
     # benchmark shape overrides (benchmarks/ only)
     Knob("TPUML_BENCH_ROWS", "int", "benchmarks",
          "row-count override for serving benchmarks"),

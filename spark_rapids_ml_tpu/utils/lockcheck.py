@@ -180,12 +180,33 @@ def reset() -> None:
         _violation_log.clear()
 
 
+#: Stall-strike observers (``add_stall_hook``): called with the violation
+#: record on every watchdog strike. The flight recorder registers one so
+#: a wedged process dumps its ring BEFORE anyone has to kill it. Plain
+#: list appends/iteration — lockcheck must not depend on observability
+#: (the metrics registry's locks are built by THIS module).
+_stall_hooks: List = []
+
+
+def add_stall_hook(fn) -> None:
+    """Register ``fn(record: dict)`` to run on every stall strike.
+    Idempotent per function object."""
+    if fn not in _stall_hooks:
+        _stall_hooks.append(fn)
+
+
 def _report(kind: str, lock_name: str, detail: str,
             fatal_in_strict: bool = True, **extra) -> None:
     """Record one violation; emit under warn, raise under strict."""
     rec = {"kind": kind, "lock": lock_name, "detail": detail, **extra}
     with _state_lock:
         _violation_log.append(rec)
+    if kind == "stall":
+        for fn in list(_stall_hooks):
+            try:
+                fn(rec)
+            except Exception:  # pragma: no cover - hooks must never kill
+                pass
     if not _busy():  # a violation seen DURING telemetry is logged only —
         # reporting it through telemetry again would recurse
         with _quiet():

@@ -96,6 +96,38 @@ def clear_events() -> None:
         _events.clear()
 
 
+#: Per-thread mirror of the open-range stacks — (span_id, name, start)
+#: tuples keyed by thread ident. The thread-local stack answers "what is
+#: MY innermost span"; this global answers the ops plane's ``/tracez``
+#: question: "what is every thread doing RIGHT NOW".
+_open_stacks: dict = {}  # guarded-by: _events_lock
+
+
+def open_spans() -> dict:
+    """Currently-open span stacks per live thread (outermost first):
+    ``{ident: {"thread": name, "spans": [{span,name,depth,open_s}]}}``."""
+    now = time.perf_counter()
+    with _events_lock:
+        items = {i: list(s) for i, s in _open_stacks.items() if s}
+    alive = {t.ident: t.name for t in threading.enumerate()}
+    return {
+        ident: {
+            "thread": alive[ident],
+            "spans": [
+                {
+                    "span": sid,
+                    "name": name,
+                    "depth": depth,
+                    "open_s": round(now - start, 6),
+                }
+                for depth, (sid, name, start) in enumerate(stack)
+            ],
+        }
+        for ident, stack in items.items()
+        if ident in alive
+    }
+
+
 # --- the RAII range ---
 
 _span_ids = itertools.count(1)
@@ -167,6 +199,11 @@ class TraceRange:
         self.span_id = _new_span_id()
         stack.append(self.span_id)
         self._start = time.perf_counter()
+        ident = threading.get_ident()
+        with _events_lock:
+            _open_stacks.setdefault(ident, []).append(
+                (self.span_id, self.name, self._start)
+            )
         self._annotation.__enter__()
         return self
 
@@ -180,8 +217,17 @@ class TraceRange:
             stack.remove(self.span_id)
         self.ok = exc_type is None
         self.exc_type = getattr(exc_type, "__name__", None)
+        ident = threading.get_ident()
         with _events_lock:
             _events.append((self.name, self._start, end))
+            mirror = _open_stacks.get(ident)
+            if mirror is not None:
+                for i in range(len(mirror) - 1, -1, -1):
+                    if mirror[i][0] == self.span_id:
+                        del mirror[i]
+                        break
+                if not mirror:
+                    del _open_stacks[ident]
         # Everything below is inert unless a run scope or event sink is
         # active — the production disabled path allocates one dict at most
         # when a report is actually being recorded.

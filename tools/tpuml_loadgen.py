@@ -91,6 +91,13 @@ from spark_rapids_ml_tpu.observability.metrics import (  # noqa: E402,F401
 )
 
 
+def _round_pct(hist, q):
+    """percentile_from_histogram returns None on an empty histogram; a
+    zero-completion run reports null percentiles, not a crash."""
+    p = percentile_from_histogram(hist, q)
+    return round(p, 3) if p is not None else None
+
+
 def _merged_member_metrics(telemetry_dir):
     """The gang's ``serving.request.latency_ms`` histogram and summed
     counters, merged across every member's flushed metric shard
@@ -452,9 +459,9 @@ def main() -> None:
         "rows_per_request": args.rows,
         "rows_per_s": round(rows_done / wall, 1) if wall > 0 else 0.0,
         "wall_s": round(wall, 3),
-        "p50_ms": round(percentile_from_histogram(hist, 0.50), 3),
-        "p95_ms": round(percentile_from_histogram(hist, 0.95), 3),
-        "p99_ms": round(percentile_from_histogram(hist, 0.99), 3),
+        "p50_ms": _round_pct(hist, 0.50),
+        "p95_ms": _round_pct(hist, 0.95),
+        "p99_ms": _round_pct(hist, 0.99),
         "batches": dispatches,
         "mean_batch_requests": round(completed / dispatches, 2) if dispatches else 0,
         "shed_queue": shed_queue,

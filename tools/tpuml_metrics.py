@@ -116,28 +116,25 @@ def _render_summary(summary: dict) -> str:
 
 
 def render_snapshot_prometheus(snapshot: dict) -> str:
-    """A ``metrics.Registry.snapshot()`` JSON dict as Prometheus text."""
-    def prom(name: str) -> str:
-        base, _, labels = name.partition("{")
-        out = "".join(c if (c.isalnum() or c == "_") else "_" for c in base)
-        return f"tpuml_{out}" + (f"{{{labels}" if labels else "")
+    """A ``metrics.Registry.snapshot()`` JSON dict as Prometheus text.
 
-    lines = []
-    for kind, metrics in (("counter", snapshot.get("counters", {})),
-                          ("gauge", snapshot.get("gauges", {}))):
-        for name, value in sorted(metrics.items()):
-            lines.append(f"# TYPE {prom(name).partition('{')[0]} {kind}")
-            lines.append(f"{prom(name)} {float(value)}")
-    for name, series in sorted(snapshot.get("histograms", {}).items()):
-        pname = prom(name).partition("{")[0]
-        lines.append(f"# TYPE {pname} histogram")
-        for sname, cell in sorted(series.items()):
-            for le, c in cell["buckets"].items():
-                le_s = "+Inf" if le in ("inf", "Infinity") else le
-                lines.append(f'{pname}_bucket{{le="{le_s}"}} {c}')
-            lines.append(f"{pname}_sum {cell['sum']}")
-            lines.append(f"{pname}_count {cell['count']}")
-    return "\n".join(lines) + "\n"
+    Delegates to THE exposition renderer
+    (``metrics.render_prometheus_snapshot``) — the same function behind
+    the live ``/metrics`` endpoint and ``TPUML_METRICS_DUMP``, so every
+    surface emits byte-identical series for the same snapshot."""
+    try:
+        from spark_rapids_ml_tpu.observability.metrics import (
+            render_prometheus_snapshot,
+        )
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from spark_rapids_ml_tpu.observability.metrics import (
+            render_prometheus_snapshot,
+        )
+
+    return render_prometheus_snapshot(snapshot)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""tpuml_top — a curses-free `top` over a live serving gang's /statusz.
+
+Polls the router's gang-merged ``/statusz`` (served by the per-process
+ops server, ``TPUML_OPS_PORT``; the router registers the endpoint when
+it starts) and renders one plain-text frame per poll: per-member queue
+depth / shed / retries / heartbeat age, the gang-merged p95 routed
+latency, SLO error-budget burn per objective, and model freshness
+(registered versions + alias pointers). No curses, no clearing — each
+frame is append-only text, so it works piped to a file or a pager.
+
+Examples::
+
+    python tools/tpuml_top.py http://127.0.0.1:8321
+    python tools/tpuml_top.py 8321 --interval 2 --iterations 5
+    python tools/tpuml_top.py http://127.0.0.1:8321 --once --format json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import List, Optional
+
+
+def _import_percentile():
+    """The shared interpolated-percentile helper — importable both with
+    the package installed and straight from a checkout."""
+    try:
+        from spark_rapids_ml_tpu.observability.metrics import (
+            percentile_from_histogram,
+        )
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from spark_rapids_ml_tpu.observability.metrics import (
+            percentile_from_histogram,
+        )
+    return percentile_from_histogram
+
+
+def normalize_url(target: str) -> str:
+    """Accept a full URL, ``host:port``, or a bare port."""
+    if target.isdigit():
+        target = f"127.0.0.1:{target}"
+    if not target.startswith("http://") and not target.startswith("https://"):
+        target = f"http://{target}"
+    return target.rstrip("/") + "/statusz"
+
+
+def fetch_statusz(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _merged_pct(doc: dict, name: str, q: float) -> Optional[float]:
+    """An interpolated percentile over the gang-MERGED histogram (bucket
+    keys arrive stringified in snapshots: "inf" -> +Inf)."""
+    series = doc.get("merged", {}).get("histograms", {}).get(name)
+    if not series:
+        return None
+    percentile = _import_percentile()
+    buckets: dict = {}
+    count = 0
+    for cell in series.values():
+        for le, c in cell.get("buckets", {}).items():
+            fle = (
+                float("inf") if le in ("inf", "Infinity", "+Inf")
+                else float(le)
+            )
+            buckets[fle] = buckets.get(fle, 0) + c
+        count += cell.get("count", 0)
+    return percentile({"buckets": buckets, "count": count}, q)
+
+
+def _counter_total(doc: dict, name: str) -> float:
+    """Sum every label-series of a merged counter family."""
+    total = 0.0
+    for series, v in doc.get("merged", {}).get("counters", {}).items():
+        if series == name or series.startswith(name + "{"):
+            total += v
+    return total
+
+
+def render_frame(doc: dict) -> str:
+    router = doc.get("router", {})
+    lines: List[str] = []
+    lines.append(
+        f"=== {router.get('router', '?')}  "
+        f"{time.strftime('%H:%M:%S')}  "
+        f"launch={router.get('launch')}  "
+        f"rejected={router.get('rejected', 0)}  "
+        f"oversized={router.get('oversized', 0)}"
+    )
+    p95 = _merged_pct(doc, "serving.router.latency_ms", 0.95)
+    shed = _counter_total(doc, "serving.router.shed")
+    routed = _counter_total(doc, "serving.router.requests")
+    lines.append(
+        "gang: "
+        + (f"p95={p95:.1f}ms" if p95 is not None else "p95=–")
+        + f"  requests={routed:.0f}  shed={shed:.0f}"
+    )
+    burns = doc.get("slo") or {}
+    if burns:
+        lines.append("slo budget burn:")
+        for objective, burn in sorted(burns.items()):
+            flag = "  BREACH" if burn > 1.0 else ""
+            lines.append(f"  {objective:<28} burn={burn:6.3f}{flag}")
+    lines.append(
+        f"{'member':>6} {'pid':>8} {'depth':>6} {'outst':>6} {'shed':>6} "
+        f"{'retry':>6} {'routed':>8} {'done':>8} {'hb_age':>8} state"
+    )
+    scraped = doc.get("members", {})
+    for m in router.get("members", []):
+        state = (
+            "dead" if m.get("dead")
+            else "joining" if m.get("joining")
+            else "retiring" if m.get("retiring")
+            else "live"
+        )
+        cell = scraped.get(str(m.get("member")), {})
+        if cell and not cell.get("ok") and state == "live":
+            state += f" (scrape: {cell.get('error')})"
+        age = m.get("heartbeat_age_s")
+        lines.append(
+            f"{m.get('member'):>6} {m.get('pid') or '?':>8} "
+            f"{m.get('depth', 0):>6} {m.get('outstanding', 0):>6} "
+            f"{m.get('shed', 0):>6} {m.get('retries', 0):>6} "
+            f"{m.get('routed', 0):>8} {m.get('completed', 0):>8} "
+            f"{(f'{age:.2f}s' if age is not None else '–'):>8} {state}"
+        )
+    models = router.get("models", {})
+    if isinstance(models, dict) and models:
+        lines.append("models (freshness):")
+        for name, cell in sorted(models.items()):
+            if not isinstance(cell, dict):
+                continue
+            versions = cell.get("versions", cell.get("live", []))
+            aliases = cell.get("aliases", {})
+            alias_s = " ".join(
+                f"{a}->v{v}" for a, v in sorted(aliases.items())
+            ) if isinstance(aliases, dict) else str(aliases)
+            lines.append(
+                f"  {name:<24} versions={versions} {alias_s}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "target",
+        help="router ops endpoint: full URL, host:port, or bare port",
+    )
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="seconds between polls (default 2)")
+    parser.add_argument("--iterations", type=int, default=0,
+                        help="stop after N frames (0 = forever)")
+    parser.add_argument("--once", action="store_true",
+                        help="one frame, then exit (== --iterations 1)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="json dumps the raw /statusz document")
+    parser.add_argument("--timeout", type=float, default=5.0)
+    args = parser.parse_args(argv)
+
+    url = normalize_url(args.target)
+    iterations = 1 if args.once else args.iterations
+    n = 0
+    while True:
+        try:
+            doc = fetch_statusz(url, timeout=args.timeout)
+        except Exception as exc:  # noqa: BLE001 - a dead gang is an answer
+            print(f"tpuml_top: scrape of {url} failed: {exc}",
+                  file=sys.stderr)
+            return 1
+        if args.format == "json":
+            print(json.dumps(doc, indent=2, default=str))
+        else:
+            print(render_frame(doc))
+            print()
+        n += 1
+        if iterations and n >= iterations:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,7 +65,10 @@ def _render_text(merged: dict) -> str:
             f"  member pid={m.get('pid')} process={m.get('process')} "
             f"shard={m.get('shard')} emitted={m.get('emitted')} "
             f"trace_roots={len(m.get('trace_roots', []))}"
+            + (f" [flight: {m['flight']}]" if m.get("flight") else "")
         )
+    for f in merged.get("flights", []):
+        lines.append(f"  flight recorder dump merged: {f}")
     for tid, cell in sorted(merged["traces"].items(), key=lambda kv: str(kv[0])):
         lines.append(
             f"trace {tid}  spans={cell['spans']} events={cell['events']} "
@@ -129,8 +132,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.format == "json":
         out = {
             k: merged[k]
-            for k in ("dir", "record_count", "manifests", "traces",
-                      "problems", "warnings", "orphan_problems")
+            for k in ("dir", "record_count", "manifests", "flights",
+                      "traces", "problems", "warnings", "orphan_problems")
         }
         out["merged_metrics"] = merged["metrics"]["merged"]
         print(json.dumps(out, indent=2, default=str))
