@@ -1,0 +1,205 @@
+"""Config 21: pipeline fusion — staged two-hop serving vs ONE fused program.
+
+The pipeline-fusion claim (ISSUE 20): a multi-stage pipeline served as
+separate per-stage models pays one round-trip through the serving
+runtime PER STAGE — queue, coalesce, dispatch, host egress, re-ingest —
+while a fused ``PipelineModel`` serves the whole chain as one composite
+AOT program with host contact only at ingest and egress. Two closed-loop
+runs over the SAME fitted PCA -> logistic pipeline and the same request
+stream, one JSON line:
+
+  - ``staged_p95_ms``: the two stage models registered separately; every
+    request hops ``pca`` then ``logreg`` (output of hop 1 resubmitted as
+    hop 2's input — the microservice-chaining baseline).
+  - ``value`` (fused p95 ms): the ``PipelineModel`` registered once; one
+    submit runs the fused program.
+
+Both runs are warmed over the same buckets; the script asserts fused
+p95 beats staged p95. The bytes claim is then measured
+DETERMINISTICALLY — one staged and one fused transform of the same
+fixed-shape block under the cost ledger — and asserted: the fused
+family's analyzed bytes land STRICTLY below the staged stages' sum (the
+in-program transform-contract selection makes dead stage outputs dead
+code to XLA). ``--ledger-out DIR`` writes both ledger documents — the
+staged one with its stage families folded into the fused family name —
+so CI gates the same claim with ``tpuml_prof --diff OLD NEW
+--max-regress 0``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import emit
+from spark_rapids_ml_tpu.utils.envknobs import env_int
+
+THREADS = env_int("TPUML_BENCH_THREADS", 8)
+REQUESTS = env_int("TPUML_BENCH_REQUESTS", 80)
+D = env_int("TPUML_BENCH_COLS", 24)
+K = env_int("TPUML_BENCH_K", 6)
+
+WARM_BUCKETS = tuple(1 << p for p in range(6))  # 1..32
+
+
+def closed_loop(submit_one, probes):
+    """THREADS workers, one outstanding request each; returns the list
+    of per-request round-trip latencies (seconds) and the wall clock."""
+    lats = []
+    lock = threading.Lock()
+
+    def worker(tid: int) -> None:
+        local = []
+        for j in range(REQUESTS):
+            t0 = time.perf_counter()
+            submit_one(probes[tid, j])
+            local.append(time.perf_counter() - t0)
+        with lock:
+            lats.extend(local)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(THREADS)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lats, time.perf_counter() - t0
+
+
+def _family_bytes(doc: dict, families) -> float:
+    from spark_rapids_ml_tpu.observability import costs
+
+    rollup = costs.family_rollup(doc)
+    return sum(rollup[f]["total_bytes"] for f in families if f in rollup)
+
+
+def main() -> None:
+    import numpy as np
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.observability import costs
+    from spark_rapids_ml_tpu.pipeline import Pipeline
+    from spark_rapids_ml_tpu.serving import ServingRuntime
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--ledger-out", default=None,
+        help="directory for the staged-baseline and fused ledger dumps "
+        "(the tpuml_prof --diff gate inputs)",
+    )
+    opts = parser.parse_args()
+
+    rng = np.random.default_rng(21)
+    x = rng.normal(size=(512, D))
+    y = (x[:, 0] + x[:, 1] - x[:, 2] > 0).astype(np.int64)
+    model = Pipeline(
+        stages=[PCA().setK(K), LogisticRegression().setMaxIter(30)]
+    ).fit((x, y))
+    pca_model, clf_model = model.stages
+    stage_families = (
+        model.stages[0].serving_signature().name,
+        model.stages[1].serving_signature().name,
+    )
+    fused_family = model.serving_signature().name
+    probes = rng.normal(size=(THREADS, REQUESTS, D))
+    total = THREADS * REQUESTS
+
+    # --- staged baseline: one serving hop per stage ---
+    ledger = costs.configure(enable=True)
+    rt = ServingRuntime(queue_limit=4 * total)
+    rt.register("pca", pca_model, warm_buckets=WARM_BUCKETS)
+    rt.register("logreg", clf_model, warm_buckets=WARM_BUCKETS)
+
+    def staged_one(row):
+        mid = rt.submit("pca", row).result(timeout=120)
+        return rt.submit("logreg", np.asarray(mid)).result(timeout=120)
+
+    staged_lats, staged_wall = closed_loop(staged_one, probes)
+    rt.close()
+    costs.reset_for_tests()
+
+    # --- fused: the PipelineModel is ONE servable ---
+    rt = ServingRuntime(queue_limit=4 * total)
+    rt.register("pipe", model, warm_buckets=WARM_BUCKETS)
+
+    def fused_one(row):
+        return rt.submit("pipe", row).result(timeout=120)
+
+    fused_lats, fused_wall = closed_loop(fused_one, probes)
+    rt.close()
+
+    staged_p95 = float(np.percentile(staged_lats, 95) * 1e3)
+    fused_p95 = float(np.percentile(fused_lats, 95) * 1e3)
+    assert fused_p95 < staged_p95, (
+        f"fused p95 {fused_p95:.2f}ms not below staged {staged_p95:.2f}ms"
+    )
+
+    # --- the bytes claim, measured DETERMINISTICALLY: one staged and
+    # one fused transform of the same fixed-shape block (closed-loop
+    # ledger totals vary with coalescing timing — bucket sizes and
+    # invocation counts wobble — which would flap a strict gate) ---
+    from spark_rapids_ml_tpu.core.serving import clear_program_cache
+
+    probe = rng.normal(size=(256, D))
+    ledger = costs.configure(enable=True)
+    clear_program_cache()
+    os.environ["TPUML_PIPELINE_FUSION"] = "off"
+    try:
+        model.transform(probe)
+    finally:
+        del os.environ["TPUML_PIPELINE_FUSION"]
+    staged_gate_doc = ledger.snapshot()
+    costs.reset_for_tests()
+    ledger = costs.configure(enable=True)
+    clear_program_cache()
+    model.transform(probe)
+    fused_gate_doc = ledger.snapshot()
+    costs.reset_for_tests()
+
+    staged_bytes = _family_bytes(staged_gate_doc, stage_families)
+    fused_bytes = _family_bytes(fused_gate_doc, [fused_family])
+    assert fused_bytes > 0 and staged_bytes > 0, "ledger saw no programs"
+    assert fused_bytes < staged_bytes, (
+        f"fused bytes {fused_bytes:.4g} not strictly below staged "
+        f"{staged_bytes:.4g}"
+    )
+
+    if opts.ledger_out:
+        os.makedirs(opts.ledger_out, exist_ok=True)
+        # Fold the staged stage families into the fused family name so
+        # tpuml_prof --diff gates fused-vs-staged as ONE family's totals.
+        for e in staged_gate_doc["entries"]:
+            if e.get("family") in stage_families:
+                e["family"] = fused_family
+        for fname, doc in (
+            ("staged_baseline.json", staged_gate_doc),
+            ("fused.json", fused_gate_doc),
+        ):
+            with open(os.path.join(opts.ledger_out, fname), "w") as fh:
+                json.dump(doc, fh)
+
+    emit(
+        f"pipeline_fused_p95_{THREADS}x{REQUESTS}_d{D}_k{K}",
+        round(fused_p95, 3),
+        "ms",
+        staged_p95_ms=round(staged_p95, 3),
+        p95_speedup=round(staged_p95 / fused_p95, 2),
+        fused_rows_s=round(total / fused_wall, 1),
+        staged_rows_s=round(total / staged_wall, 1),
+        fused_bytes=int(fused_bytes),
+        staged_bytes=int(staged_bytes),
+        bytes_ratio=round(fused_bytes / staged_bytes, 3),
+    )
+
+
+if __name__ == "__main__":
+    main()

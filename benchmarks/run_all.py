@@ -38,6 +38,7 @@ CONFIGS = [
     ("config18_router.py", {}),
     ("config19_autotune.py", {}),
     ("config20_gang_fit.py", {}),
+    ("config21_pipeline.py", {}),
     ("precision_sweep.py", {}),
 ]
 

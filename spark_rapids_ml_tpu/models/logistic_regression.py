@@ -86,6 +86,15 @@ def _forward_kernel(
     return labels, probs, raw
 
 
+def _select_labels(outs):
+    """Transform-contract selection for the fuser: a pipeline ending in a
+    classifier yields LABELS (``transform`` on a plain array returns
+    ``predict``'s labels); probabilities and raw margins are downstream-
+    dead, so selecting in-program lets XLA eliminate their writes."""
+    labels, _probs, _raw = outs
+    return labels
+
+
 class _LogisticRegressionParams(Params):
     featuresCol = Param("_", "featuresCol", "features column name", toString)
     labelCol = Param("_", "labelCol", "label column name", toString)
@@ -733,6 +742,7 @@ class LogisticRegressionModel(_LogisticRegressionParams, Model, LazyHostState):
                 jax.ShapeDtypeStruct((n, n_out), w.dtype),
                 jax.ShapeDtypeStruct((n, n_out), w.dtype),
             ),
+            select=_select_labels,
         )
 
     def transform(self, dataset: Any) -> Any:

@@ -74,7 +74,16 @@ def _forest_device(model):
     return model._forest_dev
 
 
-def _forest_signature(model, kernel, name, output_spec):
+def _select_argmax(outs):
+    """Transform-contract selection for the fuser: the classifier's
+    ``transform`` on a plain array yields argmax labels, not the class
+    distribution — selecting in-program lets XLA drop the probability
+    writes when a fused pipeline ends in a forest classifier."""
+    probs = outs[0] if isinstance(outs, tuple) else outs
+    return jnp.argmax(probs, axis=1)
+
+
+def _forest_signature(model, kernel, name, output_spec, select=None):
     """Shared ``serving_signature()`` body for the two forest models."""
     from spark_rapids_ml_tpu.serving.signature import ServingSignature
 
@@ -87,6 +96,7 @@ def _forest_signature(model, kernel, name, output_spec):
         name=name,
         n_features=int(model.numFeatures),
         output_spec=output_spec,
+        select=select,
     )
 
 
@@ -573,6 +583,7 @@ class RandomForestClassificationModel(_RandomForestParams, Model):
             lambda n, dtype: (
                 jax.ShapeDtypeStruct((n, n_classes), np.float32),
             ),
+            select=_select_argmax,
         )
 
     def transform(self, dataset: Any) -> Any:

@@ -108,6 +108,7 @@ SCHEMA: Dict[str, frozenset] = {
     "persistence": frozenset({"action", "path"}),
     "telemetry": frozenset({"action", "path"}),
     "lockcheck": frozenset({"action", "lock"}),
+    "pipeline_fusion": frozenset({"action", "pipeline"}),
     "slo": frozenset({"action", "objective"}),
 }
 

@@ -5,12 +5,25 @@ fitting each estimator on the current dataset and transforming the dataset
 forward through every fitted stage; the result is a ``PipelineModel`` of
 pure transformers. Persistence stores each stage under ``stages/<i>_<uid>``
 with its import path, so heterogeneous stage types round-trip.
+
+Beyond the Spark contract, fitted pipelines FUSE (``pipeline_fusion/``):
+``PipelineModel.transform`` on a plain array executes the whole stage
+chain as ONE bucketed AOT program — device-resident, host contact only
+at ingest and egress — and ``PipelineModel.serving_signature()`` makes a
+pipeline a single versioned servable. ``Pipeline.fit`` on plain arrays
+places the dataset on device once so every stage (and every tuning fold
+sliced by ``tuning._DeviceFolds``) consumes device-resident rows with no
+host hop between a feature stage and the downstream estimator.
+DataFrame / pandas datasets keep the stage-at-a-time path exactly: their
+contract is the intermediate columns each stage appends.
 """
 
 from __future__ import annotations
 
 import os
 from typing import Any, List, Optional
+
+import numpy as np
 
 from spark_rapids_ml_tpu.core.estimator import Estimator, Model, Transformer
 from spark_rapids_ml_tpu.core.persistence import (
@@ -20,6 +33,7 @@ from spark_rapids_ml_tpu.core.persistence import (
     resolve_persisted_class,
     save_metadata,
 )
+from spark_rapids_ml_tpu.observability.events import emit
 
 
 def save_stages(owner, path: str, stages: List[Any], class_name: str) -> None:
@@ -66,6 +80,31 @@ def load_stages(path: str, expected_class: str):
     return metadata, stages
 
 
+def _stage_device_capable(stage: Any) -> bool:
+    """Whether a stage consumes/produces device arrays in place: the
+    ``_device_foldable`` estimator families, and every fitted model that
+    declares a serving signature (their transforms keep device inputs
+    device-resident)."""
+    return bool(getattr(stage, "_device_foldable", False)) or (
+        getattr(stage, "serving_signature", None) is not None
+    )
+
+
+def _supervised(stage: Any) -> bool:
+    """A stage whose fit consumes labels (Spark: it declares labelCol)."""
+    has = getattr(stage, "hasParam", None)
+    return bool(has and has("labelCol"))
+
+
+def _plain_matrix(x: Any) -> bool:
+    """A 2-D numeric host array (the fusable/device-placeable shape)."""
+    return (
+        isinstance(x, np.ndarray)
+        and x.ndim == 2
+        and np.issubdtype(x.dtype, np.number)
+    )
+
+
 class Pipeline(Estimator, MLReadable):
     """``Pipeline(stages=[...]).fit(df)`` — Spark's sequential composition."""
 
@@ -80,6 +119,41 @@ class Pipeline(Estimator, MLReadable):
     def getStages(self) -> List[Any]:
         return self.stages
 
+    def copy(self, extra=None) -> "Pipeline":
+        """Stage-aware copy (Spark's Pipeline.copy): stages are copied
+        too, each receiving the ``extra`` entries addressed to it (Param
+        identity is (owner uid, name) — a tuning grid targets INNER
+        stage params, which the flat ``Params.copy`` could never land).
+        """
+        extra = dict(extra or {})
+        stages = []
+        for stage in self.stages:
+            if hasattr(stage, "copy"):
+                sub = {
+                    p: v for p, v in extra.items()
+                    if getattr(p, "parent", None) == stage.uid
+                }
+                stages.append(stage.copy(sub))
+            else:  # pragma: no cover - foreign stage objects pass through
+                stages.append(stage)
+        that = Pipeline(self.uid, stages)
+        own = {
+            p: v for p, v in extra.items()
+            if getattr(p, "parent", None) == self.uid
+        }
+        return self._copyValues(that, own)
+
+    @property
+    def _device_foldable(self) -> bool:
+        """Tuning loops (``tuning._device_fold_prep``) may hand this
+        pipeline device-resident fold slices when EVERY stage consumes
+        device arrays in place — the CrossValidator/TrainValidationSplit
+        inner transform→fit chain then runs fold-to-model with no host
+        hop between the feature stages and the downstream estimator."""
+        return bool(self.stages) and all(
+            _stage_device_capable(s) for s in self.stages
+        )
+
     def _save_impl(self, path: str) -> None:
         save_stages(self, path, self.stages, "org.apache.spark.ml.Pipeline")
 
@@ -88,19 +162,77 @@ class Pipeline(Estimator, MLReadable):
         metadata, stages = load_stages(path, "Pipeline")
         return cls(metadata["uid"], stages)
 
+    def _device_ingest(self, dataset: Any) -> Any:
+        """Place a plain-array dataset on device ONCE for the whole fit
+        (the fit-side fusion): every stage then fits and transforms
+        device-resident rows through the families' device-input funnel,
+        and the intermediate features never touch the host. Anything
+        that isn't a plain numeric array (or an (X, y) pair of them) —
+        DataFrames, pandas, streaming sources — is returned unchanged."""
+        from spark_rapids_ml_tpu.pipeline_fusion import fusion_fit_enabled
+
+        if not fusion_fit_enabled() or not self._device_foldable:
+            return dataset
+        import jax.numpy as jnp
+
+        placed = None
+        if _plain_matrix(dataset):
+            placed = jnp.asarray(dataset)
+        elif (
+            isinstance(dataset, tuple)
+            and len(dataset) == 2
+            and _plain_matrix(dataset[0])
+            and isinstance(dataset[1], np.ndarray)
+            and np.issubdtype(np.asarray(dataset[1]).dtype, np.number)
+        ):
+            placed = (
+                jnp.asarray(dataset[0]),
+                jnp.asarray(np.asarray(dataset[1]).ravel()),
+            )
+        if placed is None:
+            return dataset
+        emit(
+            "pipeline_fusion", action="fit_device_ingest",
+            pipeline=self.uid, stages=len(self.stages),
+        )
+        return placed
+
+    @staticmethod
+    def _stage_fit_input(stage: Any, current: Any) -> Any:
+        """What ``stage.fit`` consumes: supervised stages see the whole
+        (X, y) pair, unsupervised feature stages see the features alone
+        (a labeled dataset flowing through a PCA stage must not hand the
+        labels to the eigensolver)."""
+        if (
+            isinstance(current, tuple)
+            and len(current) == 2
+            and not _supervised(stage)
+        ):
+            return current[0]
+        return current
+
+    @staticmethod
+    def _advance(transformer: Any, current: Any) -> Any:
+        """Transform the dataset forward one stage. For (X, y) pairs only
+        the features transform; the labels ride along for the downstream
+        supervised stages."""
+        if isinstance(current, tuple) and len(current) == 2:
+            return (transformer.transform(current[0]), current[1])
+        return transformer.transform(current)
+
     def fit(self, dataset: Any) -> "PipelineModel":
         fitted: List[Transformer] = []
-        current = dataset
+        current = self._device_ingest(dataset)
         for i, stage in enumerate(self.stages):
             if isinstance(stage, Estimator):
-                model = stage.fit(current)
+                model = stage.fit(self._stage_fit_input(stage, current))
                 fitted.append(model)
                 if i < len(self.stages) - 1:
-                    current = model.transform(current)
+                    current = self._advance(model, current)
             elif isinstance(stage, Transformer):
                 fitted.append(stage)
                 if i < len(self.stages) - 1:
-                    current = stage.transform(current)
+                    current = self._advance(stage, current)
             else:
                 raise TypeError(
                     f"pipeline stage {i} is neither Estimator nor Transformer: "
@@ -110,13 +242,67 @@ class Pipeline(Estimator, MLReadable):
 
 
 class PipelineModel(Model):
-    """Fitted pipeline: transform passes the dataset through every stage."""
+    """Fitted pipeline: transform passes the dataset through every stage.
+
+    Plain-array transforms FUSE: when every stage declares a serving
+    signature and the chain's widths line up, the whole pipeline runs as
+    ONE bucketed AOT program (``pipeline_fusion/``) — same results as
+    the staged loop, one program dispatch, no intermediate host arrays.
+    An unfusable chain warns a structured
+    :class:`~spark_rapids_ml_tpu.pipeline_fusion.FusionFallbackWarning`
+    once and keeps the stage-at-a-time loop. ``TPUML_PIPELINE_FUSION=off``
+    disables the fused path entirely.
+    """
 
     def __init__(self, uid: Optional[str] = None, stages: Optional[List[Transformer]] = None):
         super().__init__(uid)
         self.stages = list(stages or [])
 
+    def copy(self, extra=None) -> "PipelineModel":
+        """Model.copy preserves fitted stages (Spark's contract)."""
+        that = PipelineModel(self.uid, list(self.stages))
+        return self._copyValues(that, extra)
+
+    def serving_signature(self):
+        """The fused pipeline's serving contract: ONE composite kernel
+        over every stage's serving kernel, weights and static config —
+        a :class:`~spark_rapids_ml_tpu.pipeline_fusion.CompositeSignature`
+        the registry, micro-batcher and router treat exactly like a
+        single model's. Raises ``TypeError`` when any stage lacks a
+        signature or the chain's widths do not line up (the registry's
+        contract for non-servable models)."""
+        from spark_rapids_ml_tpu.pipeline_fusion import fuse_pipeline_stages
+
+        return fuse_pipeline_stages(self.stages, pipeline=self.uid, strict=True)
+
+    def _fusable_input(self, dataset: Any):
+        """The 2-D array to feed the fused program, or None when this
+        dataset keeps the staged loop (DataFrame/pandas contracts carry
+        intermediate columns; 1-D rows, tuples and streams stay staged)."""
+        from spark_rapids_ml_tpu.pipeline_fusion import fusion_mode
+
+        if fusion_mode() == "off" or len(self.stages) < 2:
+            return None
+        if _plain_matrix(dataset):
+            return dataset
+        from spark_rapids_ml_tpu.core.data import is_device_array
+
+        if is_device_array(dataset) and getattr(dataset, "ndim", 0) == 2:
+            return dataset
+        return None
+
     def transform(self, dataset: Any) -> Any:
+        x = self._fusable_input(dataset)
+        if x is not None:
+            from spark_rapids_ml_tpu.core.serving import serve_rows
+            from spark_rapids_ml_tpu.pipeline_fusion import fuse_pipeline_stages
+
+            sig = fuse_pipeline_stages(self.stages, pipeline=self.uid)
+            if sig is not None and int(x.shape[1]) == sig.n_features:
+                return serve_rows(
+                    sig.kernel, x, sig.weights,
+                    static=sig.static, name=sig.name,
+                )
         current = dataset
         for stage in self.stages:
             current = stage.transform(current)

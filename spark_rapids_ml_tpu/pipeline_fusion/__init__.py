@@ -1,0 +1,34 @@
+"""Pipeline fusion — multi-stage pipelines compiled as ONE XLA program.
+
+A fitted ``PipelineModel`` used to transform operator-at-a-time: each
+stage ran its own cached program and the intermediate features bounced
+through host arrays between stages — the Spark shape Flare (PAPERS.md)
+shows losing an order of magnitude to whole-query native compilation.
+This package is the whole-query compiler for the serving plane: the
+fuser composes the stages' ``serving_signature()`` kernels into one
+jitted/AOT composite program (keyed into the bucketed program cache and
+the cost ledger like any single-model kernel), so the chain executes
+device-resident with host contact only at ingest and egress, and a
+fused pipeline registers/warms/hot-swaps/routes through the serving
+runtime as a single versioned model.
+"""
+
+from spark_rapids_ml_tpu.pipeline_fusion.fuser import (
+    CompositeSignature,
+    FusionFallbackWarning,
+    composite_kernel,
+    fuse_pipeline_stages,
+    fuse_signatures,
+    fusion_fit_enabled,
+    fusion_mode,
+)
+
+__all__ = [
+    "CompositeSignature",
+    "FusionFallbackWarning",
+    "composite_kernel",
+    "fuse_pipeline_stages",
+    "fuse_signatures",
+    "fusion_fit_enabled",
+    "fusion_mode",
+]

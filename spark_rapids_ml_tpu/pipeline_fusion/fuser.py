@@ -1,0 +1,262 @@
+"""The fuser: stage serving signatures -> one composite XLA program.
+
+Three pieces:
+
+- :func:`composite_kernel` builds (and caches, per chain of stage
+  kernels) ONE Python callable that traces the whole stage chain. The
+  cache makes the function object stable, so the bucketed AOT program
+  cache in ``core/serving.py`` — whose key leads with the kernel's
+  identity — hits across repeated ``serving_signature()`` calls and
+  across distinct pipelines that share a chain shape.
+- :func:`fuse_signatures` packs the per-stage signatures into a
+  :class:`CompositeSignature`: prefixed static dicts (``s0_precision``,
+  ``s1_n_classes``, ...) so every stage's config stays part of the
+  program key, nested weight pytrees passed positionally, and an output
+  spec derived by ``jax.eval_shape`` through the terminal stage's
+  transform-contract selection.
+- :func:`fuse_pipeline_stages` applies the chain rules to a
+  ``PipelineModel``'s stages and either returns the composite or
+  (non-strict) warns a structured :class:`FusionFallbackWarning` and
+  returns None so the caller keeps the stage-at-a-time path.
+
+The composite applies each stage's ``select`` (the stage's
+transform-on-array contract — e.g. labels out of the logistic forward
+triple) INSIDE the program: outputs the pipeline contract never exposes
+are dead code to XLA, which is where the fused program's ledgered bytes
+drop strictly below the sum of its staged parts.
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from spark_rapids_ml_tpu.observability.events import emit
+from spark_rapids_ml_tpu.serving.signature import ServingSignature
+from spark_rapids_ml_tpu.utils.envknobs import env_choice
+from spark_rapids_ml_tpu.utils.lockcheck import make_lock
+from spark_rapids_ml_tpu.utils.tracing import bump_counter
+
+FUSION_ENV = "TPUML_PIPELINE_FUSION"
+FUSION_FIT_ENV = "TPUML_PIPELINE_FUSION_FIT"
+
+
+def fusion_mode() -> str:
+    """``auto`` (fuse array transforms when the whole chain is fusable)
+    or ``off`` (always stage-at-a-time)."""
+    return env_choice(FUSION_ENV, ("auto", "off"), "auto")
+
+
+def fusion_fit_enabled() -> bool:
+    """Whether ``Pipeline.fit`` may place a plain-array dataset on device
+    once and feed every stage device-resident intermediates."""
+    return env_choice(FUSION_FIT_ENV, ("auto", "off"), "auto") == "auto"
+
+
+class FusionFallbackWarning(UserWarning):
+    """A pipeline could not fuse; transform falls back stage-at-a-time.
+
+    Structured: ``pipeline`` (uid), ``stage`` (index or None for
+    chain-level reasons), ``reason`` — so callers and tests can assert
+    WHY a chain degraded instead of pattern-matching message text.
+    """
+
+    def __init__(self, pipeline: str, reason: str, stage: Optional[int] = None):
+        self.pipeline = pipeline
+        self.reason = reason
+        self.stage = stage
+        where = f" (stage {stage})" if stage is not None else ""
+        super().__init__(
+            f"pipeline {pipeline} not fused{where}: {reason}; "
+            "transform runs stage-at-a-time"
+        )
+
+
+@dataclass
+class CompositeSignature(ServingSignature):
+    """A fused pipeline's serving contract — a :class:`ServingSignature`
+    (it slots into the registry/batcher/router unchanged) plus the chain
+    provenance: which stage families it composes. The ``weights`` field
+    is a tuple of per-stage weight pytrees, passed positionally to the
+    composite kernel; ``static`` is the prefixed union of the stages'
+    static dicts."""
+
+    stage_names: Tuple[str, ...] = ()
+
+
+#: Composite kernels by (stage kernels, stage selects): ONE function
+#: object per chain shape, ever — the AOT program cache keys on it.
+_COMPOSITE_KERNELS: Dict[tuple, Callable] = {}  # guarded-by: _KERNEL_LOCK
+_KERNEL_LOCK = make_lock("pipeline_fusion.kernels")
+
+
+def _demux_static(static: Dict[str, Any], n_stages: int) -> List[Dict[str, Any]]:
+    """Split ``{"s0_precision": ..., "s1_n_classes": ...}`` back into
+    per-stage static dicts (the inverse of the fuse-time prefixing)."""
+    per: List[Dict[str, Any]] = [{} for _ in range(n_stages)]
+    for key, value in static.items():
+        idx, _, inner = key.partition("_")
+        per[int(idx[1:])][inner] = value
+    return per
+
+
+def composite_kernel(
+    kernels: Tuple[Callable, ...], selects: Tuple[Optional[Callable], ...]
+) -> Callable:
+    """The one traced callable for a stage chain: runs ``kernels[i]`` on
+    the previous stage's (selected) output, applying each stage's
+    transform-contract ``select`` in-program so downstream-dead outputs
+    are eliminated by XLA rather than materialized and sliced on host."""
+    key = (tuple(kernels), tuple(selects))
+    with _KERNEL_LOCK:
+        fused = _COMPOSITE_KERNELS.get(key)
+        if fused is not None:
+            return fused
+
+    def _fused_pipeline(x, *stage_weights, **static):
+        import jax
+
+        per_stage = _demux_static(static, len(kernels))
+        out: Any = x
+        for i, kernel in enumerate(kernels):
+            feed = out if i == 0 else jax.tree_util.tree_leaves(out)[0]
+            out = kernel(feed, *stage_weights[i], **per_stage[i])
+            if selects[i] is not None:
+                out = selects[i](out)
+        return out
+
+    _fused_pipeline.__name__ = "fused_" + "__".join(
+        getattr(k, "__name__", "kernel").lstrip("_") for k in kernels
+    )
+    _fused_pipeline.__qualname__ = _fused_pipeline.__name__
+    with _KERNEL_LOCK:
+        return _COMPOSITE_KERNELS.setdefault(key, _fused_pipeline)
+
+
+def _feed_spec(sig: ServingSignature):
+    """The (leaves, width) a stage hands its successor: the first leaf
+    of its transform-contract output for a probe batch, or (None, None)
+    when the stage cannot feed a downstream kernel (non-2-D, or a
+    multi-leaf contract with no defined feed)."""
+    import jax
+
+    probe = sig.output_spec(8, sig.weights_dtype())
+    if sig.select is not None:
+        probe = jax.eval_shape(sig.select, probe)
+    leaves = jax.tree_util.tree_leaves(probe)
+    if len(leaves) != 1 or len(leaves[0].shape) != 2:
+        return None, None
+    return leaves[0], int(leaves[0].shape[1])
+
+
+def fuse_signatures(
+    sigs: Sequence[ServingSignature], *, name: Optional[str] = None
+) -> CompositeSignature:
+    """Compose stage signatures into one :class:`CompositeSignature`.
+
+    Chain rules (the caller is expected to have verified them via
+    :func:`fuse_pipeline_stages`; violations raise ``ValueError``):
+    every non-terminal stage must yield a single 2-D output whose width
+    matches the next stage's ``n_features``.
+    """
+    import jax
+
+    if not sigs:
+        raise ValueError("cannot fuse an empty stage chain")
+    for i, sig in enumerate(sigs[:-1]):
+        _, width = _feed_spec(sig)
+        if width is None:
+            raise ValueError(
+                f"stage {i} ({sig.name}) does not produce a single 2-D "
+                "feature block; it cannot feed a downstream stage"
+            )
+        if width != sigs[i + 1].n_features:
+            raise ValueError(
+                f"stage {i} ({sig.name}) emits width {width} but stage "
+                f"{i + 1} ({sigs[i + 1].name}) expects "
+                f"{sigs[i + 1].n_features} features"
+            )
+
+    kernels = tuple(s.kernel for s in sigs)
+    selects = tuple(s.select for s in sigs)
+    static = {
+        f"s{i}_{k}": v for i, s in enumerate(sigs) for k, v in s.static.items()
+    }
+    last = sigs[-1]
+    if last.select is None:
+        out_spec = last.output_spec
+    else:
+        def out_spec(n, dtype, _last=last):
+            return jax.eval_shape(_last.select, _last.output_spec(n, dtype))
+
+    return CompositeSignature(
+        kernel=composite_kernel(kernels, selects),
+        weights=tuple(s.weights for s in sigs),
+        static=static,
+        name=name or ("fused:" + "+".join(s.name for s in sigs)),
+        n_features=int(sigs[0].n_features),
+        output_spec=out_spec,
+        stage_names=tuple(s.name for s in sigs),
+    )
+
+
+def fuse_pipeline_stages(
+    stages: Sequence[Any], *, pipeline: str, strict: bool = False
+) -> Optional[CompositeSignature]:
+    """Resolve every stage's ``serving_signature()`` and fuse the chain.
+
+    Non-strict (the transform path): any unfusable link warns ONE
+    structured :class:`FusionFallbackWarning` and returns None — the
+    caller keeps the stage-at-a-time loop. Strict (the registry path,
+    where a pipeline must BE a servable): the same condition raises
+    ``TypeError``, matching the registry's contract for models without
+    a serving signature.
+    """
+
+    def bail(reason: str, stage: Optional[int] = None):
+        bump_counter("pipeline.fusion.fallback")
+        emit(
+            "pipeline_fusion", action="fallback", pipeline=pipeline,
+            stage=stage, reason=reason,
+        )
+        if strict:
+            raise TypeError(f"pipeline {pipeline} is not fusable: {reason}")
+        warnings.warn(
+            FusionFallbackWarning(pipeline, reason, stage), stacklevel=3
+        )
+        return None
+
+    if not stages:
+        return bail("pipeline has no stages")
+    sigs: List[ServingSignature] = []
+    for i, stage in enumerate(stages):
+        sig_fn = getattr(stage, "serving_signature", None)
+        if sig_fn is None:
+            return bail(
+                f"{type(stage).__name__} declares no serving_signature()", i
+            )
+        try:
+            sigs.append(sig_fn())
+        except Exception as exc:
+            return bail(
+                f"{type(stage).__name__}.serving_signature() failed: {exc}", i
+            )
+    for i, sig in enumerate(sigs[:-1]):
+        _, width = _feed_spec(sig)
+        if width is None:
+            return bail(
+                f"{sig.name} does not produce a single 2-D feature block", i
+            )
+        if width != sigs[i + 1].n_features:
+            return bail(
+                f"{sig.name} emits width {width} but {sigs[i + 1].name} "
+                f"expects {sigs[i + 1].n_features} features", i,
+            )
+    fused = fuse_signatures(sigs)
+    bump_counter("pipeline.fusion.fused")
+    emit(
+        "pipeline_fusion", action="fused", pipeline=pipeline,
+        stages=list(fused.stage_names), name=fused.name,
+    )
+    return fused

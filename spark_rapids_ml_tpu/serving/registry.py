@@ -117,14 +117,24 @@ class ModelRegistry:
         self,
         name: str,
         path: str,
-        model_cls: Type,
+        model_cls: Optional[Type] = None,
         *,
         alias: Optional[str] = None,
         warm_buckets: Iterable[int] = (),
         warm_dtype: Any = None,
     ) -> ModelVersion:
         """Load an ``MLWriter``-saved model from ``path`` (via
-        ``model_cls.load``) and register it in one step."""
+        ``model_cls.load``) and register it in one step. ``model_cls``
+        may be omitted: the persisted metadata's ``class`` field resolves
+        it (``core/persistence.py::resolve_component_class``), so a
+        directory saved by ANY servable — including a fused
+        ``PipelineModel`` — round-trips by path alone."""
+        if model_cls is None:
+            from spark_rapids_ml_tpu.core.persistence import (
+                resolve_component_class,
+            )
+
+            model_cls = resolve_component_class(path)
         with TraceRange(f"registry load {name}", TraceColor.WHITE):
             model = model_cls.load(path)
         return self.register(

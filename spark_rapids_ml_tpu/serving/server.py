@@ -133,7 +133,7 @@ class ServingRuntime:
     def register(self, name: str, model: Any, **kwargs) -> ModelVersion:
         return self.registry.register(name, model, **kwargs)
 
-    def load(self, name: str, path: str, model_cls, **kwargs) -> ModelVersion:
+    def load(self, name: str, path: str, model_cls=None, **kwargs) -> ModelVersion:
         return self.registry.load(name, path, model_cls, **kwargs)
 
     def set_alias(self, name: str, alias: str, version: int) -> None:

@@ -39,6 +39,15 @@ class ServingSignature:
     name: str
     n_features: int
     output_spec: Callable[[int, Any], Any]
+    # The stage's transform-on-array contract as a TRACEABLE function of
+    # the kernel's output pytree (None = the output IS the contract).
+    # E.g. the logistic forward kernel yields (labels, probs, raw) but
+    # ``transform`` on a plain array yields labels: select picks them.
+    # The pipeline fuser applies it INSIDE the composite program, so
+    # outputs the pipeline contract never exposes are dead code to XLA.
+    # Must be a module-level function (stable identity — it is part of
+    # the composite-kernel cache key), not a per-call lambda.
+    select: Optional[Callable[[Any], Any]] = None
     # Host copies of the weights for the degraded CPU path, built lazily
     # on first fallback and reused (the "cached CPU path").
     _cpu_weights: Optional[Tuple[Any, ...]] = field(

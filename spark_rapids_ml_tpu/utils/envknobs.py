@@ -222,6 +222,17 @@ KNOBS: Dict[str, Knob] = _knob_table(
     Knob("TPUML_SERVE_STREAM_BLOCK", "int", "serving",
          "rows per block for double-buffered host-batch streaming",
          default=65536),
+    # pipeline fusion (whole-pipeline composite programs)
+    Knob("TPUML_PIPELINE_FUSION", "choice", "pipeline-fusion",
+         "auto = PipelineModel.transform on plain arrays runs the whole "
+         "stage chain as ONE composite AOT program (stage-at-a-time when "
+         "any stage is unfusable); off = always stage-at-a-time",
+         default="auto", choices=("auto", "off")),
+    Knob("TPUML_PIPELINE_FUSION_FIT", "choice", "pipeline-fusion",
+         "auto = Pipeline.fit places plain-array datasets on device once "
+         "so stages (and CV/TVS folds) chain device-resident; off = host "
+         "datasets flow stage-at-a-time unmodified",
+         default="auto", choices=("auto", "off")),
     # online-serving runtime
     Knob("TPUML_SERVE_MAX_BATCH", "int", "serving-runtime",
          "rows per coalesced micro-batch dispatch", default=256),

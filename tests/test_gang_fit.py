@@ -177,6 +177,7 @@ def _free_port() -> int:
 
 
 class TestTwoProcessGangFit:
+    @pytest.mark.slow  # ~15 s, 2 jax bring-ups; runs full-file in CI's Gang fit step
     def test_two_process_gang_fit_matches_single_process(self, tmp_path):
         """ISSUE 15 acceptance: 2 OS processes (jax.distributed, gloo on
         CPU), each feeding only ITS slice through the PUBLIC fit() with

@@ -148,12 +148,14 @@ class TestMultiProcess:
             },
         )
 
+    @pytest.mark.slow  # ~9 s spawn; runs full-file in CI's Multi-process step
     def test_empty_executor_does_not_strand_peers(self):
         """One process holds zero local rows; the fit must still complete
         on every process with the identical oracle-checked model (the
         asymmetric-failure/deadlock case)."""
         self._run(3, extra_env={"TPUML_TEST_EMPTY_LAST": "1"})
 
+    @pytest.mark.slow  # ~8 s spawn; runs full-file in CI's Multi-process step
     def test_streaming_executors(self):
         """Each process STREAMS its local rows (one-shot block generator):
         per-process shifted scans merge through one allgather of the
@@ -161,6 +163,7 @@ class TestMultiProcess:
         against the full-dataset oracle in every process."""
         self._run(3, extra_env={"TPUML_TEST_STREAMING": "1"})
 
+    @pytest.mark.slow  # ~8 s spawn; runs full-file in CI's Multi-process step
     def test_streaming_with_empty_executor(self):
         self._run(
             3,
@@ -273,6 +276,7 @@ class TestMultiProcess:
             },
         )
 
+    @pytest.mark.slow  # ~5 s spawn; runs full-file in CI's Multi-process step
     def test_streaming_without_x64(self):
         """The real-TPU configuration: fp32 compute, and the fp64 moment
         payload crosses the allgather as a double-float (hi, lo) pair —

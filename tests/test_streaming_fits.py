@@ -186,6 +186,7 @@ class TestStreamingBoundedMemory:
         growth_kb = int(out.stdout.decode().strip().splitlines()[-1].split()[-1])
         return growth_kb
 
+    @pytest.mark.slow  # ~11 s; runs full-file in CI's Streamed-fit memory bounds step
     def test_kmeans_streaming_bounded_rss(self):
         # 48 x 32768 x 64 f64 = 0.75 GB if materialized; blocks are
         # recomputed on demand so RSS growth must stay a small multiple
@@ -215,6 +216,7 @@ print("GROWTH_KB", peak - base)
             f"RSS grew {growth_kb} kB (dataset is 0.75 GB)"
         )
 
+    @pytest.mark.slow  # ~31 s; runs full-file in CI's Streamed-fit memory bounds step
     def test_logreg_streaming_bounded_rss(self):
         # 48 x 32768 x 64 f64 = 0.75 GB if materialized; the L-BFGS path
         # re-streams every block per evaluation, so iteration count is

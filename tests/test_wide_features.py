@@ -190,6 +190,7 @@ class TestRandomizedMesh:
 
 
 class TestWideBoundedMemory:
+    @pytest.mark.slow  # ~21 s; runs full-file in CI's Streamed-fit memory bounds step
     def test_16kx8192_streamed_sketch_bounded_rss(self):
         """A 16384 x 8192 fit (1.0 GB as f64 — the matrix is NEVER
         materialized: blocks are computed on demand) at bounded RSS, with
